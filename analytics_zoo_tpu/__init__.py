@@ -1,0 +1,23 @@
+"""analytics_zoo_tpu — a TPU-native framework with the capabilities of
+Analytics Zoo (reference: charlieJ107/analytics-zoo).
+
+The reference stacks Python over Py4J over a Scala/Spark/BigDL engine
+(see /root/reference/pyzoo/zoo/__init__.py); this framework is single-language
+Python on JAX/XLA, with SPMD sharding over a TPU device mesh replacing the
+reference's eight data-parallel backends (SURVEY.md §2.3).
+
+Top-level convenience re-exports mirror the reference's public entry points:
+
+    from analytics_zoo_tpu import init_orca_context, OrcaContext
+    from analytics_zoo_tpu.orca.data import XShards
+    from analytics_zoo_tpu.orca.learn import Estimator
+"""
+
+__version__ = "0.1.0"
+
+from analytics_zoo_tpu.common.context import (  # noqa: F401
+    OrcaContext,
+    init_orca_context,
+    init_nncontext,
+    stop_orca_context,
+)

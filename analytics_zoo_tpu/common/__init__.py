@@ -1,0 +1,6 @@
+from analytics_zoo_tpu.common.context import (  # noqa: F401
+    OrcaContext,
+    init_orca_context,
+    init_nncontext,
+    stop_orca_context,
+)

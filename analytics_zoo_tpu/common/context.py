@@ -1,0 +1,281 @@
+"""Cluster runtime / context layer (L1').
+
+TPU-native replacement for the reference's `init_orca_context` /
+`init_nncontext` / RayOnSpark stack
+(/root/reference/pyzoo/zoo/orca/common.py:161, pyzoo/zoo/common/nncontext.py:335,
+pyzoo/zoo/ray/raycontext.py:325).
+
+Where the reference bootstraps a SparkContext (and optionally a Ray cluster
+inside the Spark cluster) to get N worker processes, a TPU program is SPMD:
+one Python process per host, all hosts running the same program, with the
+devices of the whole pod visible as one `jax.sharding.Mesh`.  So
+`init_orca_context` here:
+
+  * `cluster_mode="local"`  — single-process JAX (1 real chip, or N CPU
+    devices under `--xla_force_host_platform_device_count=N`),
+  * `cluster_mode="tpu_pod"` — calls `jax.distributed.initialize()` so every
+    host sees the global device set (the control-plane analog of RayOnSpark's
+    barrier-job gang bootstrap, raycontext.py:560-589),
+
+then builds the global device mesh that every training engine in the framework
+shards over.  There is no Py4J bridge and no per-backend cluster (SURVEY.md
+§2.3): DP-1..DP-8 collapse into shardings on this one mesh.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+from typing import Dict, Optional, Sequence
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+#: Canonical mesh axis order.  Data-like axes come first so that
+#: batch sharding over ("dp", "fsdp") composes with parameter sharding
+#: over ("fsdp", "tp") the way the scaling playbook prescribes.
+MESH_AXES = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+#: Axes a batch dimension is sharded over by default.
+DATA_AXES = ("dp", "fsdp")
+
+
+class OrcaContextMeta(type):
+    """Class-level config properties, mirroring the reference's
+    `OrcaContextMeta` (pyzoo/zoo/orca/common.py:21-134): global knobs that
+    user code reads/writes as `OrcaContext.<knob>`."""
+
+    _pandas_read_backend = "pandas"
+    _serialize_data_creator = False
+    _shard_size = None
+    _log_output = False
+    _train_data_store = "DRAM"
+
+    # --- TPU runtime state ---
+    _mesh = None
+    _cluster_mode = None
+    _initialized = False
+    _auto_initialized = False
+    _lock = threading.Lock()
+
+    @property
+    def pandas_read_backend(cls):
+        """Backend for `orca.data.pandas.read_csv` ("pandas" only; the
+        reference also offered "spark", pyzoo/zoo/orca/common.py:36)."""
+        return cls._pandas_read_backend
+
+    @pandas_read_backend.setter
+    def pandas_read_backend(cls, value):
+        value = str(value).lower()
+        if value not in ("pandas",):
+            raise ValueError(f"unsupported pandas_read_backend: {value}")
+        cls._pandas_read_backend = value
+
+    @property
+    def serialize_data_creator(cls):
+        """Whether to wrap data-creator calls in an inter-process file lock
+        (reference: orca/common.py:72-84, used to serialize downloads)."""
+        return cls._serialize_data_creator
+
+    @serialize_data_creator.setter
+    def serialize_data_creator(cls, value):
+        cls._serialize_data_creator = bool(value)
+
+    @property
+    def shard_size(cls):
+        """Target rows per XShards shard (reference orca/common.py:100)."""
+        return cls._shard_size
+
+    @shard_size.setter
+    def shard_size(cls, value):
+        if value is not None and int(value) <= 0:
+            raise ValueError("shard_size must be positive or None")
+        cls._shard_size = None if value is None else int(value)
+
+    @property
+    def log_output(cls):
+        return cls._log_output
+
+    @log_output.setter
+    def log_output(cls, value):
+        cls._log_output = bool(value)
+        logger.setLevel(logging.DEBUG if cls._log_output else logging.INFO)
+
+    @property
+    def train_data_store(cls):
+        """"DRAM" or "DISK_n" — whether host-side datasets are kept in RAM or
+        spilled to disk and streamed (reference FeatureSet tiers,
+        zoo/src/main/scala/.../feature/FeatureSet.scala:233,557)."""
+        return cls._train_data_store
+
+    @train_data_store.setter
+    def train_data_store(cls, value):
+        value = str(value).upper()
+        if value != "DRAM" and not value.startswith("DISK"):
+            raise ValueError("train_data_store must be 'DRAM' or 'DISK_n'")
+        cls._train_data_store = value
+
+    @property
+    def mesh(cls):
+        """The global `jax.sharding.Mesh` everything shards over.  Reading
+        it before `init_orca_context` auto-initializes local mode; a later
+        *explicit* `init_orca_context` call overrides an auto-init."""
+        if cls._mesh is None:
+            init_orca_context(cluster_mode="local")
+            cls._auto_initialized = True
+        return cls._mesh
+
+    @property
+    def cluster_mode(cls):
+        return cls._cluster_mode
+
+    @property
+    def initialized(cls):
+        return cls._initialized
+
+    @property
+    def num_devices(cls):
+        return cls.mesh.devices.size
+
+    @property
+    def devices(cls):
+        return list(cls.mesh.devices.flat)
+
+
+class OrcaContext(metaclass=OrcaContextMeta):
+    pass
+
+
+def _build_mesh(devices, mesh_shape: Optional[Dict[str, int]]):
+    """Build the global mesh.  `mesh_shape` maps axis name → size, e.g.
+    ``{"dp": 2, "tp": 4}``; unspecified devices fold into "dp".  Default is
+    all devices on "dp" (pure data parallelism, the only strategy the
+    reference implements — SURVEY.md §2.3)."""
+    import numpy as np
+    import jax
+
+    n = len(devices)
+    if not mesh_shape:
+        mesh_shape = {"dp": n}
+    unknown = set(mesh_shape) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {unknown}; valid: {MESH_AXES}")
+    sizes = dict(mesh_shape)
+    prod = 1
+    for v in sizes.values():
+        prod *= v
+    if prod != n:
+        if n % prod != 0:
+            raise ValueError(
+                f"mesh_shape {mesh_shape} (={prod}) does not divide "
+                f"device count {n}")
+        if "dp" in sizes:
+            # the user pinned dp explicitly — never silently resize it
+            raise ValueError(
+                f"mesh_shape {mesh_shape} covers {prod} of {n} devices; "
+                "either make the axis sizes multiply to the device count "
+                "or omit 'dp' to let it absorb the remainder")
+        sizes["dp"] = n // prod
+    axis_names = [a for a in MESH_AXES if a in sizes]
+    shape = [sizes[a] for a in axis_names]
+    dev_array = np.asarray(devices).reshape(shape)
+    return jax.sharding.Mesh(dev_array, axis_names)
+
+
+def init_orca_context(cluster_mode: str = "local",
+                      cores: Optional[int] = None,
+                      num_nodes: int = 1,
+                      mesh_shape: Optional[Dict[str, int]] = None,
+                      coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None,
+                      **kwargs):
+    """One-call runtime bootstrap (reference: pyzoo/zoo/orca/common.py:161).
+
+    cluster_mode:
+      * "local" — this process's devices only (the real TPU chip(s) attached,
+        or host-platform CPU devices in tests).
+      * "tpu_pod" / "distributed" — multi-host: runs
+        `jax.distributed.initialize(coordinator_address, num_processes,
+        process_id)` (args optional on Cloud TPU, where they are inferred
+        from the metadata server) so `jax.devices()` is the whole pod.
+
+    mesh_shape: axis name → size over `MESH_AXES`; default all-"dp".
+    cores: optional cap on host CPU threading for data loading.
+    Returns the global `jax.sharding.Mesh`.
+    """
+    import jax
+
+    cluster_mode = cluster_mode.lower()
+    with OrcaContextMeta._lock:
+        if OrcaContextMeta._initialized:
+            if OrcaContextMeta._auto_initialized:
+                # implicit local auto-init must never mask an explicit init
+                _stop_locked()
+            elif (cluster_mode == OrcaContextMeta._cluster_mode
+                    and mesh_shape is None):
+                logger.warning("init_orca_context called twice; returning "
+                               "the existing mesh")
+                return OrcaContextMeta._mesh
+            else:
+                raise RuntimeError(
+                    "runtime already initialized with cluster_mode="
+                    f"'{OrcaContextMeta._cluster_mode}'; call "
+                    "stop_orca_context() before re-initializing with a "
+                    "different configuration")
+
+        if cluster_mode in ("tpu_pod", "distributed"):
+            dist_kwargs = {}
+            if coordinator_address is not None:
+                dist_kwargs["coordinator_address"] = coordinator_address
+            if num_processes is not None:
+                dist_kwargs["num_processes"] = num_processes
+            if process_id is not None:
+                dist_kwargs["process_id"] = process_id
+            jax.distributed.initialize(**dist_kwargs)
+        elif cluster_mode not in ("local",):
+            raise ValueError(
+                f"unsupported cluster_mode '{cluster_mode}'; the TPU build "
+                "supports 'local' and 'tpu_pod' (Spark modes like 'yarn'/'k8s' "
+                "do not apply — hosts are provisioned by the TPU platform)")
+
+        if cores is not None:
+            os.environ.setdefault("OMP_NUM_THREADS", str(cores))
+
+        devices = jax.devices()
+        mesh = _build_mesh(devices, mesh_shape)
+        OrcaContextMeta._mesh = mesh
+        OrcaContextMeta._cluster_mode = cluster_mode
+        OrcaContextMeta._initialized = True
+        atexit.register(stop_orca_context)
+        logger.info("init_orca_context: %d device(s), mesh axes %s shape %s",
+                    len(devices), mesh.axis_names, mesh.devices.shape)
+        return mesh
+
+
+def init_nncontext(*args, **kwargs):
+    """Alias preserved from the reference
+    (pyzoo/zoo/common/nncontext.py:335)."""
+    return init_orca_context(*args, **kwargs)
+
+
+def _stop_locked():
+    if not OrcaContextMeta._initialized:
+        return
+    if OrcaContextMeta._cluster_mode in ("tpu_pod", "distributed"):
+        import jax
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # already down / never fully up
+            pass
+    OrcaContextMeta._mesh = None
+    OrcaContextMeta._cluster_mode = None
+    OrcaContextMeta._initialized = False
+    OrcaContextMeta._auto_initialized = False
+    logger.info("stop_orca_context: runtime stopped")
+
+
+def stop_orca_context():
+    """Tear down the runtime (reference: pyzoo/zoo/orca/common.py:269)."""
+    with OrcaContextMeta._lock:
+        _stop_locked()

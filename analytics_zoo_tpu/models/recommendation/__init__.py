@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF  # noqa: F401

@@ -1,0 +1,59 @@
+"""Neural Collaborative Filtering.
+
+Reference: scala `models/recommendation/NeuralCF.scala:45-110` and python
+`pyzoo/zoo/models/recommendation/neuralcf.py:30` — GMF (elementwise product
+of user/item matrix-factorization embeddings) fused with an MLP tower over
+concatenated embeddings, ending in a class_num softmax (or sigmoid).
+
+TPU notes: embedding lookups are gathers XLA lays out on HBM efficiently;
+the MLP is MXU work in bfloat16.  For large user/item vocabularies the
+embedding tables shard over the "tp" axis via the estimator's shard_rules
+({"embed": "tp"}).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class NeuralCF(nn.Module):
+    user_count: int
+    item_count: int
+    class_num: int = 2
+    user_embed: int = 20
+    item_embed: int = 20
+    hidden_layers: Sequence[int] = (40, 20, 10)
+    include_mf: bool = True
+    mf_embed: int = 20
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, user_ids, item_ids, training: bool = False):
+        user_ids = user_ids.astype(jnp.int32).reshape(-1)
+        item_ids = item_ids.astype(jnp.int32).reshape(-1)
+        # the reference indexes users/items from 1 (LookupTable semantics)
+        u = jnp.clip(user_ids - 1, 0, self.user_count - 1)
+        i = jnp.clip(item_ids - 1, 0, self.item_count - 1)
+
+        mlp_u = nn.Embed(self.user_count, self.user_embed,
+                         name="mlp_user_embed")(u)
+        mlp_i = nn.Embed(self.item_count, self.item_embed,
+                         name="mlp_item_embed")(i)
+        h = jnp.concatenate([mlp_u, mlp_i], axis=-1).astype(self.compute_dtype)
+        for width in self.hidden_layers:
+            h = nn.relu(nn.Dense(width, dtype=self.compute_dtype)(h))
+
+        if self.include_mf:
+            mf_u = nn.Embed(self.user_count, self.mf_embed,
+                            name="mf_user_embed")(u)
+            mf_i = nn.Embed(self.item_count, self.mf_embed,
+                            name="mf_item_embed")(i)
+            mf = (mf_u * mf_i).astype(self.compute_dtype)
+            h = jnp.concatenate([h, mf], axis=-1)
+
+        logits = nn.Dense(self.class_num, dtype=jnp.float32,
+                          name="head")(h)
+        return logits

@@ -1,0 +1,2 @@
+from analytics_zoo_tpu.orca.data.shard import XShards  # noqa: F401
+from analytics_zoo_tpu.orca.data import pandas  # noqa: F401

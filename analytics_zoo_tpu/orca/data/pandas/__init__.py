@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.orca.data.pandas.preprocessing import (  # noqa: F401
+    read_csv,
+    read_json,
+    read_parquet,
+)

@@ -1,0 +1,77 @@
+"""File readers → XShards (reference:
+/root/reference/pyzoo/zoo/orca/data/pandas/preprocessing.py — Spark- or
+pandas-backend CSV/JSON readers producing one DataFrame per partition).
+
+TPU-native: each file (or row-group) becomes one shard, read in parallel on a
+thread pool.  On a multi-host pod every host reads a disjoint stride of the
+file list (host i takes files i, i+H, i+2H, ...), which is the SPMD analog of
+Spark assigning input splits to executors.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import List
+
+from analytics_zoo_tpu.orca.data.shard import XShards, _pool_size
+
+
+def _list_files(path: str, ext: str) -> List[str]:
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, f"*{ext}")))
+        if not files:  # fall back to all files in the dir
+            files = sorted(
+                os.path.join(path, f) for f in os.listdir(path)
+                if not f.startswith(("_", ".")))
+    elif any(c in path for c in "*?["):
+        files = sorted(glob.glob(path))
+    else:
+        files = [path]
+    if not files:
+        raise FileNotFoundError(f"no input files at {path}")
+    return files
+
+
+def _read(path: str, ext: str, reader, num_shards=None, **kwargs) -> XShards:
+    import jax
+
+    files = _list_files(path, ext)
+    # multi-host split (no-op single host): when there are enough files each
+    # host takes a disjoint stride; otherwise every host reads all files and
+    # takes a disjoint *row* stride, so no rows are ever duplicated.
+    idx, n_hosts = jax.process_index(), jax.process_count()
+    row_stride = n_hosts > len(files)
+    if not row_stride:
+        files = files[idx::n_hosts]
+
+    with ThreadPoolExecutor(_pool_size()) as ex:
+        dfs = list(ex.map(lambda f: reader(f, **kwargs), files))
+    if row_stride:
+        dfs = [df.iloc[idx::n_hosts] for df in dfs]
+
+    shards = XShards(dfs)
+    if num_shards and num_shards != len(dfs):
+        shards = shards.repartition(num_shards)
+    elif len(dfs) == 1 and (num_shards is None):
+        # single file: split for parallelism like the spark backend would
+        n = min(_pool_size(), max(1, len(dfs[0])))
+        if n > 1:
+            shards = shards.repartition(n)
+    return shards
+
+
+def read_csv(file_path: str, num_shards=None, **kwargs) -> XShards:
+    import pandas as pd
+    return _read(file_path, ".csv", pd.read_csv, num_shards, **kwargs)
+
+
+def read_json(file_path: str, num_shards=None, **kwargs) -> XShards:
+    import pandas as pd
+    return _read(file_path, ".json", pd.read_json, num_shards, **kwargs)
+
+
+def read_parquet(file_path: str, num_shards=None, **kwargs) -> XShards:
+    import pandas as pd
+    return _read(file_path, ".parquet", pd.read_parquet, num_shards, **kwargs)

@@ -1,0 +1,343 @@
+"""XShards — sharded distributed data (L3').
+
+TPU-native re-design of the reference's `XShards`/`SparkXShards`
+(/root/reference/pyzoo/zoo/orca/data/shard.py:25,129): a sharded collection of
+Python objects (dicts of numpy arrays, pandas DataFrames, or arbitrary
+picklables) with functional per-shard transforms.
+
+Where the reference stores shards in Spark RDD partitions (JVM heap, Py4J
+round-trips to touch them), here shards are *process-local host memory* on
+each TPU host: under SPMD every host runs this same program and holds the
+slice of the dataset it will feed to its own devices, so there is no shuffle
+service and no serialization boundary.  Shard transforms run on a thread pool
+(numpy/pandas release the GIL) — the moral equivalent of Spark's
+`mapPartitions` without the JVM.  A "DISK" tier (OrcaContext.train_data_store,
+mirroring the reference FeatureSet's DRAM/DISK storage levels,
+zoo/src/main/scala/.../feature/FeatureSet.scala:557) spills shards to pickle
+files and loads them lazily.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import pickle
+import shutil
+import tempfile
+import weakref
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.common.context import OrcaContext
+
+
+def _pool_size() -> int:
+    # floor of 4: shard transforms/reads are often IO-bound, and real TPU
+    # host VMs have dozens of cores even when a sandbox reports few
+    return min(32, max(4, os.cpu_count() or 8))
+
+
+class _ShardStore:
+    """Storage backend for one XShards: DRAM (list) or disk spill.
+
+    Under the DISK tier, shards are written as they stream in (so a chained
+    transform never holds the whole dataset), `iter()` loads one shard at a
+    time, and the spill directory is removed when the store is garbage
+    collected.  Merge-type operations (`all()`, `merged`, `repartition`)
+    necessarily materialize everything.
+    """
+
+    def __init__(self, shards, tier: Optional[str] = None):
+        tier = tier or OrcaContext.train_data_store
+        self._disk = tier.upper().startswith("DISK")
+        if self._disk:
+            self._dir = tempfile.mkdtemp(prefix="xshards_")
+            self._finalizer = weakref.finalize(
+                self, shutil.rmtree, self._dir, True)
+            self._paths = []
+            for i, s in enumerate(shards):
+                p = os.path.join(self._dir, f"shard_{i}.pkl")
+                with open(p, "wb") as f:
+                    pickle.dump(s, f, protocol=pickle.HIGHEST_PROTOCOL)
+                self._paths.append(p)
+        else:
+            self._shards = list(shards)
+
+    def __len__(self):
+        return len(self._paths) if self._disk else len(self._shards)
+
+    def get(self, i: int) -> Any:
+        if self._disk:
+            with open(self._paths[i], "rb") as f:
+                return pickle.load(f)
+        return self._shards[i]
+
+    def iter(self):
+        for i in range(len(self)):
+            yield self.get(i)
+
+    def all(self) -> List[Any]:
+        return [self.get(i) for i in range(len(self))]
+
+
+def _parallel_map(func: Callable, items: Iterable):
+    """Generator mapping `func` over `items` on a thread pool with bounded
+    in-flight work, preserving order."""
+    with ThreadPoolExecutor(_pool_size()) as ex:
+        pending = deque()
+        for item in items:
+            pending.append(ex.submit(func, item))
+            if len(pending) >= _pool_size() * 2:
+                yield pending.popleft().result()
+        while pending:
+            yield pending.popleft().result()
+
+
+class XShards:
+    """A sharded dataset.  Construct with `XShards.partition` or the reader
+    functions in `analytics_zoo_tpu.orca.data.pandas`."""
+
+    def __init__(self, shards: Iterable[Any], tier: Optional[str] = None):
+        self._store = _ShardStore(shards, tier)
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def partition(data: Any, num_shards: Optional[int] = None) -> "XShards":
+        """Partition numpy data into shards (reference shard.py:472
+        `XShards.partition`).  `data` may be an ndarray, a (nested) list/tuple
+        of ndarrays, or a dict with ndarray (or nested) values; the split is
+        along axis 0 of every leaf array.
+        """
+        flat, rebuild = _flatten(data)
+        if not flat:
+            raise ValueError("no arrays found in data")
+        n_rows = len(flat[0])
+        for a in flat:
+            if len(a) != n_rows:
+                raise ValueError(
+                    f"all arrays must share dim 0: {len(a)} != {n_rows}")
+        if num_shards is None:
+            if OrcaContext.shard_size:
+                num_shards = max(1, math.ceil(n_rows / OrcaContext.shard_size))
+            else:
+                num_shards = min(_pool_size(), max(1, n_rows))
+        num_shards = min(num_shards, max(1, n_rows))
+        bounds = np.linspace(0, n_rows, num_shards + 1).astype(int)
+        shards = []
+        for i in range(num_shards):
+            lo, hi = bounds[i], bounds[i + 1]
+            shards.append(rebuild([a[lo:hi] for a in flat]))
+        return XShards(shards)
+
+    @staticmethod
+    def load_pickle(path: str) -> "XShards":
+        """Load shards saved by `save_pickle` (reference shard.py:105)."""
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".pkl"))
+        shards = []
+        for fp in files:
+            with open(fp, "rb") as f:
+                shards.append(pickle.load(f))
+        return XShards(shards)
+
+    # ------------------------------------------------------------------
+    # core API (parity with reference SparkXShards, shard.py:129-470)
+    # ------------------------------------------------------------------
+
+    def transform_shard(self, func: Callable, *args) -> "XShards":
+        """Apply `func(shard, *args)` to every shard, in parallel.  Under
+        the DISK tier, shards stream through with bounded in-flight memory
+        (2x pool size) and results spill to the new store as they finish."""
+        mapped = _parallel_map(lambda s: func(s, *args), self._store.iter())
+        return XShards(mapped)
+
+    def collect(self) -> List[Any]:
+        return self._store.all()
+
+    def num_partitions(self) -> int:
+        return len(self._store)
+
+    def repartition(self, num_partitions: int) -> "XShards":
+        """Re-split into `num_partitions` shards.  Array-dict and DataFrame
+        shards are split/merged by rows; other types are re-grouped whole."""
+        shards = self._store.all()
+        first = shards[0] if shards else None
+        if _is_array_like(first):
+            merged = _concat_shards(shards)
+            return XShards.partition(merged, num_partitions)
+        import pandas as pd
+        if isinstance(first, pd.DataFrame):
+            df = pd.concat(shards, ignore_index=True)
+            bounds = np.linspace(0, len(df), num_partitions + 1).astype(int)
+            return XShards([df.iloc[bounds[i]:bounds[i + 1]]
+                            for i in range(num_partitions)])
+        # generic: round-robin group the shard objects
+        groups: List[List[Any]] = [[] for _ in range(num_partitions)]
+        for i, s in enumerate(shards):
+            groups[i % num_partitions].append(s)
+        return XShards([g for g in groups if g])
+
+    def partition_by(self, cols: str, num_partitions: Optional[int] = None
+                     ) -> "XShards":
+        """Hash-partition DataFrame shards by a column (reference
+        shard.py:232): rows with equal keys end up in the same shard."""
+        import pandas as pd
+        shards = self._store.all()
+        if not shards or not isinstance(shards[0], pd.DataFrame):
+            raise ValueError("partition_by requires pandas DataFrame shards")
+        num_partitions = num_partitions or len(shards)
+        df = pd.concat(shards, ignore_index=True)
+        codes = pd.util.hash_array(df[cols].to_numpy()) % num_partitions
+        out = [df[codes == i] for i in range(num_partitions)]
+        return XShards(out)
+
+    def unique(self, col: Optional[str] = None) -> np.ndarray:
+        """Distinct values of a DataFrame column (reference shard.py:260)."""
+        import pandas as pd
+        vals = []
+        for s in self._store.iter():
+            if isinstance(s, pd.DataFrame):
+                vals.append(s[col].unique() if col else s.iloc[:, 0].unique())
+            else:
+                vals.append(np.unique(s[col] if col else s))
+        return np.unique(np.concatenate(vals))
+
+    def split(self) -> List["XShards"]:
+        """If each shard is a tuple/list of N elements, split into N XShards
+        (reference shard.py:300)."""
+        shards = self._store.all()
+        n = len(shards[0])
+        for s in shards:
+            if len(s) != n:
+                raise ValueError("each shard must have the same length")
+        return [XShards([s[i] for s in shards]) for i in range(n)]
+
+    def zip(self, other: "XShards") -> "XShards":
+        """Pairwise-zip two XShards with equal partitioning (reference
+        shard.py:439)."""
+        if self.num_partitions() != other.num_partitions():
+            raise ValueError("XShards.zip requires equal num_partitions")
+        return XShards(list(zip(self._store.all(), other._store.all())))
+
+    def sample(self, frac: float, seed: Optional[int] = None) -> "XShards":
+        # independent per-shard generators (SeedSequence.spawn): the shard
+        # transforms run concurrently, and numpy Generators are not
+        # thread-safe
+        n_parts = self.num_partitions()
+        child_seeds = np.random.SeedSequence(seed).spawn(n_parts)
+
+        def _s(item):
+            i, shard = item
+            rng = np.random.default_rng(child_seeds[i])
+            if _is_array_like(shard):
+                flat, rebuild = _flatten(shard)
+                n = len(flat[0])
+                idx = np.sort(rng.choice(n, size=int(n * frac), replace=False))
+                return rebuild([a[idx] for a in flat])
+            return shard.sample(frac=frac,
+                                random_state=int(rng.integers(0, 2**31)))
+        # stream (index, shard) pairs so DISK-tier datasets never fully
+        # materialize and no intermediate store is written
+        return XShards(_parallel_map(_s, enumerate(self._store.iter())))
+
+    def __len__(self) -> int:
+        total = 0
+        for s in self._store.iter():
+            if _is_array_like(s):
+                flat, _ = _flatten(s)
+                total += len(flat[0])
+            else:
+                total += len(s)
+        return total
+
+    def save_pickle(self, path: str) -> "XShards":
+        os.makedirs(path, exist_ok=True)
+        for i, s in enumerate(self._store.iter()):
+            with open(os.path.join(path, f"part-{i:05d}.pkl"), "wb") as f:
+                pickle.dump(s, f, protocol=pickle.HIGHEST_PROTOCOL)
+        return self
+
+    # ------------------------------------------------------------------
+    # conversions
+    # ------------------------------------------------------------------
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.concat(self._store.all(), ignore_index=True)
+
+    def merged(self) -> Any:
+        """Concatenate all shards into one object (host memory)."""
+        shards = self._store.all()
+        if _is_array_like(shards[0]):
+            return _concat_shards(shards)
+        import pandas as pd
+        if isinstance(shards[0], pd.DataFrame):
+            return pd.concat(shards, ignore_index=True)
+        out = []
+        for s in shards:
+            out.extend(s if isinstance(s, list) else [s])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _is_array_like(x) -> bool:
+    if isinstance(x, np.ndarray):
+        return True
+    if isinstance(x, dict):
+        return all(_is_array_like(v) for v in x.values())
+    if isinstance(x, (list, tuple)):
+        return all(_is_array_like(v) for v in x)
+    return False
+
+
+def _flatten(data):
+    """Flatten nested dict/list/tuple of ndarrays → (leaves, rebuild_fn)."""
+    leaves: List[np.ndarray] = []
+
+    def build_spec(d):
+        if isinstance(d, np.ndarray):
+            leaves.append(d)
+            return ("leaf", len(leaves) - 1)
+        if isinstance(d, dict):
+            return ("dict", {k: build_spec(v) for k, v in d.items()})
+        if isinstance(d, (list, tuple)):
+            return (type(d).__name__, [build_spec(v) for v in d])
+        arr = np.asarray(d)
+        leaves.append(arr)
+        return ("leaf", len(leaves) - 1)
+
+    spec = build_spec(data)
+
+    def rebuild(new_leaves):
+        def go(s):
+            kind, payload = s
+            if kind == "leaf":
+                return new_leaves[payload]
+            if kind == "dict":
+                return {k: go(v) for k, v in payload.items()}
+            seq = [go(v) for v in payload]
+            return tuple(seq) if kind == "tuple" else seq
+        return go(spec)
+
+    return leaves, rebuild
+
+
+def _concat_shards(shards):
+    flats = []
+    rebuild = None
+    for s in shards:
+        f, rb = _flatten(s)
+        flats.append(f)
+        rebuild = rb
+    merged = [np.concatenate([f[i] for f in flats]) for i in range(len(flats[0]))]
+    return rebuild(merged)

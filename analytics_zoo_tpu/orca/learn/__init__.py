@@ -1,0 +1,6 @@
+from analytics_zoo_tpu.orca.learn.estimator import Estimator  # noqa: F401
+from analytics_zoo_tpu.orca.learn import metrics  # noqa: F401
+from analytics_zoo_tpu.orca.learn.trigger import (  # noqa: F401
+    EveryEpoch,
+    SeveralIteration,
+)

@@ -1,0 +1,54 @@
+"""Checkpoint / resume on orbax (reference: BigDL optimizer snapshots +
+`find_latest_checkpoint`, /root/reference/pyzoo/zoo/orca/learn/utils.py:24,
+and the DP-1 retry-restore loop, Topology.scala:1255-1310).
+
+Multi-host note: orbax writes a sharded checkpoint cooperatively from all
+processes, which is the TPU-native analog of the reference's rank-0
+authoritative state save (torch_runner.py:369-410).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def save_checkpoint(path: str, state) -> str:
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+    return path
+
+
+def load_checkpoint(path: str, target_state):
+    """Restore into the sharding/structure of `target_state`."""
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(path, target_state)
+    ckptr.close()
+    return restored
+
+
+def find_latest_checkpoint(model_dir: str,
+                           version: Optional[int] = None) -> str:
+    pat = re.compile(r"^ckpt-(\d+)$")
+    candidates = []
+    for name in os.listdir(model_dir):
+        m = pat.match(name)
+        if m:
+            candidates.append((int(m.group(1)), os.path.join(model_dir, name)))
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoints under {model_dir}")
+    if version is not None:
+        for v, p in candidates:
+            if v == version:
+                return p
+        raise FileNotFoundError(f"no checkpoint version {version}")
+    return max(candidates)[1]

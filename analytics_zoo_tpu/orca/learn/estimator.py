@@ -1,0 +1,291 @@
+"""Orca Estimator — the user-facing sklearn-style fit/evaluate/predict API
+(L5').
+
+Reference: the per-backend estimator family under
+/root/reference/pyzoo/zoo/orca/learn/{tf,tf2,pytorch,bigdl,openvino}/estimator.py,
+all of which funnel into one of eight DP engines (SURVEY.md §2.3).  Here every
+factory produces the same `Estimator` over the single SPMD engine; only the
+model-lowering differs:
+
+  * `Estimator.from_flax(module, ...)` — native path.
+  * `Estimator.from_keras(model, ...)` — the framework's Keras-style API
+    (analytics_zoo_tpu.keras), mirroring `tf2/estimator.py:87` from_keras.
+  * `Estimator.from_torch(model, ...)` — imports a torch.nn.Module by
+    structural conversion (analytics_zoo_tpu.orca.learn.torch_adapter),
+    mirroring `pytorch/estimator.py:39`.
+
+fit/evaluate/predict accept XShards, dict-of-ndarray, (x, y) tuples, or
+pandas DataFrames with feature_cols/label_cols — the same surface as the
+reference's Estimators over XShards/Spark DataFrames.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.common.context import OrcaContext
+from analytics_zoo_tpu.orca.learn import losses as losses_mod
+from analytics_zoo_tpu.orca.learn import metrics as metrics_mod
+from analytics_zoo_tpu.orca.learn import optimizers as optim_mod
+from analytics_zoo_tpu.orca.learn.spmd import SPMDEngine
+from analytics_zoo_tpu.orca.learn.trigger import EveryEpoch, Trigger
+from analytics_zoo_tpu.orca.learn.utils import HostDataset
+
+
+class Estimator:
+    """Unified distributed estimator over the SPMD engine."""
+
+    def __init__(self, *, apply_fn=None, params=None, model_state=None,
+                 module=None, loss=None, optimizer=None, metrics=None,
+                 model_dir: Optional[str] = None,
+                 shard_rules: Optional[Dict[str, str]] = None,
+                 clip_norm: Optional[float] = None,
+                 clip_value: Optional[float] = None,
+                 learning_rate: Optional[float] = None,
+                 seed: int = 0):
+        self._module = module
+        self._apply_fn = apply_fn
+        self._params = params
+        self._model_state = model_state
+        self._loss = losses_mod.resolve(loss)
+        self._tx = optim_mod.resolve(optimizer, learning_rate,
+                                     clip_norm, clip_value)
+        self._metrics = metrics_mod.resolve_all(metrics)
+        self._shard_rules = shard_rules
+        self._seed = seed
+        self.model_dir = model_dir
+        self._engine: Optional[SPMDEngine] = None
+        self._pending_ckpt: Optional[str] = None
+        self.train_summary: List[Dict[str, Any]] = []
+        self.val_summary: List[Dict[str, Any]] = []
+        self._epoch = 0
+
+    # ------------------------------------------------------------------
+    # factories
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def from_flax(module, *, loss=None, optimizer=None, metrics=None,
+                  model_dir=None, shard_rules=None, clip_norm=None,
+                  clip_value=None, learning_rate=None, seed=0) -> "Estimator":
+        return Estimator(module=module, loss=loss, optimizer=optimizer,
+                         metrics=metrics, model_dir=model_dir,
+                         shard_rules=shard_rules, clip_norm=clip_norm,
+                         clip_value=clip_value, learning_rate=learning_rate,
+                         seed=seed)
+
+    @staticmethod
+    def from_keras(model, *, loss=None, optimizer=None, metrics=None,
+                   model_dir=None, **kwargs) -> "Estimator":
+        """Build from an `analytics_zoo_tpu.keras` model.  If the model was
+        `compile()`d, its loss/optimizer/metrics are used unless overridden
+        (reference: tf2/estimator.py from_keras)."""
+        loss = loss if loss is not None else getattr(model, "_loss", None)
+        optimizer = (optimizer if optimizer is not None
+                     else getattr(model, "_optimizer", None))
+        metrics = (metrics if metrics is not None
+                   else getattr(model, "_metrics", None))
+        return Estimator.from_flax(model.to_flax(), loss=loss,
+                                   optimizer=optimizer, metrics=metrics,
+                                   model_dir=model_dir, **kwargs)
+
+    @staticmethod
+    def from_torch(model, *, loss=None, optimizer=None, metrics=None,
+                   model_dir=None, **kwargs) -> "Estimator":
+        """Import a torch.nn.Module (reference: pytorch/estimator.py:39).
+        The module is structurally converted to flax and its weights copied;
+        training then runs on the TPU mesh, not in torch."""
+        from analytics_zoo_tpu.orca.learn.torch_adapter import torch_to_flax
+        module, params, model_state = torch_to_flax(model)
+        est = Estimator.from_flax(module, loss=loss, optimizer=optimizer,
+                                  metrics=metrics, model_dir=model_dir,
+                                  **kwargs)
+        est._params = params
+        est._model_state = model_state
+        return est
+
+    # ------------------------------------------------------------------
+    # engine bring-up
+    # ------------------------------------------------------------------
+
+    def _ensure_engine(self, sample_batch: Dict[str, Any]):
+        if self._engine is not None:
+            return
+        if self._module is not None:
+            from analytics_zoo_tpu.orca.learn.flax_adapter import (
+                flax_apply_fn, init_flax)
+            apply_fn = flax_apply_fn(self._module)
+            if self._params is None:
+                feats = tuple(a[:1] for a in sample_batch["features"])
+                self._params, self._model_state = init_flax(
+                    self._module, feats, self._seed)
+        else:
+            apply_fn = self._apply_fn
+        self._engine = SPMDEngine(
+            apply_fn=apply_fn,
+            params=self._params,
+            optimizer=self._tx,
+            loss_fn=self._loss,
+            metric_fns=self._metrics,
+            model_state=self._model_state,
+            shard_rules=self._shard_rules,
+            seed=self._seed)
+        if self._pending_ckpt is not None:
+            path, self._pending_ckpt = self._pending_ckpt, None
+            self.load(path)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 32,
+            feature_cols: Optional[Sequence[str]] = None,
+            label_cols: Optional[Sequence[str]] = None,
+            validation_data=None,
+            checkpoint_trigger: Optional[Trigger] = None,
+            shuffle: bool = True) -> "Estimator":
+        ds = HostDataset.from_data(data, feature_cols, label_cols)
+        val_ds = (HostDataset.from_data(validation_data, feature_cols,
+                                        label_cols)
+                  if validation_data is not None else None)
+        first = next(ds.batches(min(batch_size, max(1, ds.n)),
+                                pad_to_multiple_of=1))
+        self._ensure_engine(first)
+        eng = self._engine
+        trigger = checkpoint_trigger
+        if trigger is None and self.model_dir:
+            trigger = EveryEpoch()
+
+        mult = eng.pad_multiple()
+
+        def on_step(step):
+            # step-granular triggers (SeveralIteration) fire mid-epoch
+            if trigger and self.model_dir and trigger(
+                    epoch=self._epoch, step=step, epoch_end=False):
+                self.save_checkpoint()
+
+        for _ in range(epochs):
+            t0 = time.time()
+            stats = eng.run_epoch(
+                ds.batches(batch_size, shuffle=shuffle, seed=self._seed,
+                           pad_to_multiple_of=mult, epoch=self._epoch),
+                train=True, on_step=on_step)
+            self._epoch += 1
+            if trigger is not None and hasattr(trigger, "last_loss"):
+                trigger.last_loss = stats.get("loss")
+            step = int(np.asarray(eng.state.step))
+            stats.update(epoch=self._epoch, step=step,
+                         wall_s=time.time() - t0,
+                         samples_per_s=ds.n / max(time.time() - t0, 1e-9))
+            self.train_summary.append(stats)
+            if val_ds is not None:
+                vstats = eng.run_epoch(
+                    val_ds.batches(batch_size, pad_to_multiple_of=mult),
+                    train=False)
+                vstats.update(epoch=self._epoch, step=step)
+                self.val_summary.append(vstats)
+            if trigger and self.model_dir and trigger(
+                    epoch=self._epoch, step=step, epoch_end=True):
+                self.save_checkpoint()
+        return self
+
+    def evaluate(self, data, batch_size: int = 32,
+                 feature_cols=None, label_cols=None) -> Dict[str, float]:
+        ds = HostDataset.from_data(data, feature_cols, label_cols)
+        if not ds.labels:
+            raise ValueError(
+                "evaluate requires labels: pass {'x': ..., 'y': ...}, an "
+                "(x, y) tuple, or label_cols for DataFrame input")
+        first = next(ds.batches(min(batch_size, max(1, ds.n)),
+                                pad_to_multiple_of=1))
+        self._ensure_engine(first)
+        return self._engine.run_epoch(
+            ds.batches(batch_size,
+                       pad_to_multiple_of=self._engine.pad_multiple()),
+            train=False)
+
+    def predict(self, data, batch_size: int = 32, feature_cols=None):
+        """Returns stacked predictions (numpy).  For XShards/DataFrame input
+        the row order of the input is preserved."""
+        ds = HostDataset.from_data(data, feature_cols, None)
+        first = next(ds.batches(min(batch_size, max(1, ds.n)),
+                                pad_to_multiple_of=1))
+        self._ensure_engine(first)
+        outs = self._engine.predict_all(
+            ds.batches(batch_size,
+                       pad_to_multiple_of=self._engine.pad_multiple()))
+        if not outs:
+            return None
+        if isinstance(outs[0], (tuple, list)):
+            return type(outs[0])(
+                np.concatenate([o[i] for o in outs])
+                for i in range(len(outs[0])))
+        return np.concatenate(outs)
+
+    # ------------------------------------------------------------------
+    # parameters & checkpointing
+    # ------------------------------------------------------------------
+
+    def get_model(self):
+        """Return current parameters as host numpy (reference estimators
+        return the trained model object)."""
+        self._require_engine()
+        return self._engine.get_params()
+
+    def _require_engine(self):
+        if self._engine is None:
+            raise RuntimeError(
+                "estimator not yet built; call fit/evaluate/predict first")
+
+    def save(self, path: str):
+        self._require_engine()
+        from analytics_zoo_tpu.orca.learn.checkpoint import save_checkpoint
+        save_checkpoint(path, self._engine.state)
+        return path
+
+    def load(self, path: str):
+        """Restore a checkpoint.  On a fresh estimator (engine not yet
+        built) the restore is deferred until the first
+        fit/evaluate/predict builds the engine — so resume-after-crash is
+        just `from_flax(...).load_orca_checkpoint(dir)` (reference:
+        tf/estimator.py:271)."""
+        if self._engine is None:
+            self._pending_ckpt = path
+            return self
+        from analytics_zoo_tpu.orca.learn.checkpoint import load_checkpoint
+        self._engine.state = load_checkpoint(path, self._engine.state)
+        return self
+
+    def save_checkpoint(self) -> str:
+        """Write a step-versioned checkpoint under model_dir (reference
+        checkpoint_trigger semantics, orca/learn/trigger.py + tf/estimator.py
+        save path)."""
+        self._require_engine()
+        step = int(np.asarray(self._engine.state.step))
+        path = os.path.join(self.model_dir, f"ckpt-{step}")
+        return self.save(path)
+
+    def load_orca_checkpoint(self, path: str, version: Optional[int] = None):
+        """Resume from the latest (or a specific `version`) checkpoint in a
+        directory (reference: tf/estimator.py:271 + find_latest_checkpoint,
+        orca/learn/utils.py:24)."""
+        from analytics_zoo_tpu.orca.learn.checkpoint import (
+            find_latest_checkpoint)
+        ckpt = find_latest_checkpoint(path, version)
+        return self.load(ckpt)
+
+    # ------------------------------------------------------------------
+    # summaries
+    # ------------------------------------------------------------------
+
+    def get_train_summary(self, tag: str):
+        """(step, value) rows for a stat, like the reference's TensorBoard
+        summary readback (tf/estimator.py:168-222)."""
+        return [(s["step"], s[tag]) for s in self.train_summary if tag in s]
+
+    def get_validation_summary(self, tag: str):
+        return [(s["step"], s[tag]) for s in self.val_summary if tag in s]

@@ -1,0 +1,62 @@
+"""Adapter lowering flax.linen modules onto the SPMD engine's pure
+`apply_fn(params, model_state, features, rng, training)` convention."""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+
+
+def _mode_kwarg(module) -> Tuple[str, bool]:
+    """Find the module's train-mode kwarg: 'training'/'train' (True when
+    training) or 'deterministic' (inverted).  Returns (name, invert)."""
+    try:
+        sig = inspect.signature(type(module).__call__)
+    except (TypeError, ValueError):
+        return ("", False)
+    names = set(sig.parameters)
+    if "training" in names:
+        return ("training", False)
+    if "train" in names:
+        return ("train", False)
+    if "deterministic" in names:
+        return ("deterministic", True)
+    return ("", False)
+
+
+def init_flax(module, sample_features: Tuple[np.ndarray, ...], seed: int = 0):
+    """Initialize; returns (params, model_state) with model_state holding
+    mutable collections like batch_stats."""
+    kw, invert = _mode_kwarg(module)
+    kwargs: Dict[str, Any] = {}
+    if kw:
+        kwargs[kw] = True if invert else False
+    rng = jax.random.PRNGKey(seed)
+    variables = module.init({"params": rng, "dropout": rng},
+                            *sample_features, **kwargs)
+    params = variables.get("params", {})
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+    return params, model_state
+
+
+def flax_apply_fn(module):
+    kw, invert = _mode_kwarg(module)
+
+    def apply_fn(params, model_state, features, rng, training):
+        variables = {"params": params, **model_state}
+        kwargs: Dict[str, Any] = {}
+        if kw:
+            kwargs[kw] = (not training) if invert else training
+        mutable = list(model_state.keys()) if (training and model_state) else False
+        rngs = {"dropout": rng} if training else None
+        if mutable:
+            preds, updated = module.apply(variables, *features, rngs=rngs,
+                                          mutable=mutable, **kwargs)
+            return preds, dict(updated)
+        preds = module.apply(variables, *features, rngs=rngs, **kwargs)
+        return preds, model_state
+
+    return apply_fn

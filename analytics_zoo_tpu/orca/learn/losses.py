@@ -1,0 +1,121 @@
+"""Per-example loss functions.
+
+Every loss maps (preds, labels) -> per-example values with leading batch dim;
+the engine masked-means them (padding-aware).  Mirrors the loss vocabulary of
+the reference's Keras objectives
+(/root/reference/pyzoo/zoo/pipeline/api/keras/objectives.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _first(t):
+    return t[0] if isinstance(t, (tuple, list)) else t
+
+
+def sparse_categorical_crossentropy(preds, labels, from_logits=True):
+    p, y = _first(preds), _first(labels).astype(jnp.int32)
+    y = y.reshape(y.shape[0], *p.shape[1:-1])
+    if from_logits:
+        per = optax.softmax_cross_entropy_with_integer_labels(p, y)
+    else:
+        p = jnp.clip(p, 1e-7, 1.0)
+        per = -jnp.take_along_axis(jnp.log(p), y[..., None], axis=-1)[..., 0]
+    return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+def categorical_crossentropy(preds, labels, from_logits=True):
+    p, y = _first(preds), _first(labels)
+    if from_logits:
+        per = optax.softmax_cross_entropy(p, y)
+    else:
+        p = jnp.clip(p, 1e-7, 1.0)
+        per = -(y * jnp.log(p)).sum(axis=-1)
+    return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+def binary_crossentropy(preds, labels, from_logits=True):
+    p, y = _first(preds), _first(labels)
+    p = p.reshape(p.shape[0], -1)
+    y = y.reshape(y.shape[0], -1).astype(p.dtype)
+    if from_logits:
+        per = optax.sigmoid_binary_cross_entropy(p, y)
+    else:
+        p = jnp.clip(p, 1e-7, 1 - 1e-7)
+        per = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+    return per.mean(axis=-1)
+
+
+def mean_squared_error(preds, labels):
+    p, y = _first(preds), _first(labels)
+    d = p.reshape(p.shape[0], -1) - y.reshape(y.shape[0], -1)
+    return (d * d).mean(axis=-1)
+
+
+def mean_absolute_error(preds, labels):
+    p, y = _first(preds), _first(labels)
+    return jnp.abs(p.reshape(p.shape[0], -1)
+                   - y.reshape(y.shape[0], -1)).mean(axis=-1)
+
+
+def huber(preds, labels, delta: float = 1.0):
+    p, y = _first(preds), _first(labels)
+    per = optax.huber_loss(p.reshape(p.shape[0], -1),
+                           y.reshape(y.shape[0], -1), delta=delta)
+    return per.mean(axis=-1)
+
+
+def hinge(preds, labels):
+    p, y = _first(preds), _first(labels)
+    p = p.reshape(p.shape[0], -1)
+    y = y.reshape(y.shape[0], -1).astype(p.dtype)
+    y = 2.0 * y - 1.0  # {0,1} -> {-1,1}
+    return jnp.maximum(0.0, 1.0 - y * p).mean(axis=-1)
+
+
+def kld(preds, labels):
+    p, y = _first(preds), _first(labels)
+    y = jnp.clip(y, 1e-7, 1.0)
+    p = jnp.clip(p, 1e-7, 1.0)
+    per = (y * (jnp.log(y) - jnp.log(p))).sum(axis=-1)
+    return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+def poisson(preds, labels):
+    p, y = _first(preds), _first(labels)
+    p = p.reshape(p.shape[0], -1)
+    y = y.reshape(y.shape[0], -1)
+    return (p - y * jnp.log(p + 1e-7)).mean(axis=-1)
+
+
+_REGISTRY = {
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "categorical_crossentropy": categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "huber": huber,
+    "hinge": hinge,
+    "kld": kld,
+    "kullback_leibler_divergence": kld,
+    "poisson": poisson,
+}
+
+
+def resolve(loss):
+    if loss is None:
+        return None
+    if isinstance(loss, str):
+        key = loss.lower()
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown loss '{loss}'; known: {sorted(_REGISTRY)}")
+        return _REGISTRY[key]
+    if callable(loss):
+        return loss
+    raise TypeError(f"cannot resolve loss from {loss!r}")

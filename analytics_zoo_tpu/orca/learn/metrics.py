@@ -1,0 +1,160 @@
+"""Framework-neutral metrics (reference:
+/root/reference/pyzoo/zoo/orca/learn/metrics.py:19-340, which lowers to BigDL
+ValidationMethods over Py4J).
+
+Here each metric is a pure per-example function `fn(preds, labels) ->
+values[batch, ...]`; the engine masked-means them on device, so metric math
+runs inside the same jitted step as the model (no host round-trip per batch).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _first(t):
+    return t[0] if isinstance(t, (tuple, list)) else t
+
+
+class Metric:
+    name = "metric"
+
+    def __call__(self, preds, labels):
+        raise NotImplementedError
+
+    def get_name(self):
+        return self.name
+
+
+class Accuracy(Metric):
+    """Classification accuracy; auto-detects binary (scalar output) vs
+    sparse-categorical, like the reference's Accuracy (metrics.py:120).
+
+    `from_logits` (default True, matching the losses module) puts the binary
+    decision boundary at logit 0 == probability 0.5."""
+    name = "accuracy"
+
+    def __init__(self, from_logits: bool = True):
+        self.from_logits = from_logits
+
+    def __call__(self, preds, labels):
+        p, y = _first(preds), _first(labels)
+        if p.ndim == 1 or p.shape[-1] == 1:
+            threshold = 0.0 if self.from_logits else 0.5
+            yhat = (p.reshape(p.shape[0], -1)[:, 0] > threshold
+                    ).astype(jnp.int32)
+            return (yhat == y.reshape(y.shape[0], -1)[:, 0].astype(jnp.int32)
+                    ).astype(jnp.float32)
+        yhat = jnp.argmax(p, axis=-1)
+        if y.ndim == p.ndim:  # one-hot labels
+            y = jnp.argmax(y, axis=-1)
+        return (yhat == y.astype(yhat.dtype)).astype(jnp.float32)
+
+
+class SparseCategoricalAccuracy(Accuracy):
+    name = "sparse_categorical_accuracy"
+
+
+class CategoricalAccuracy(Accuracy):
+    name = "categorical_accuracy"
+
+
+class BinaryAccuracy(Metric):
+    """`threshold` applies in probability space; with `from_logits` (the
+    framework default) predictions are sigmoid-ed first."""
+    name = "binary_accuracy"
+
+    def __init__(self, threshold: float = 0.5, from_logits: bool = True):
+        self.threshold = threshold
+        self.from_logits = from_logits
+
+    def __call__(self, preds, labels):
+        p, y = _first(preds), _first(labels)
+        p = p.reshape(p.shape[0], -1)
+        if self.from_logits:
+            p = jax.nn.sigmoid(p)
+        yhat = p > self.threshold
+        y = y.reshape(y.shape[0], -1) > 0.5
+        return jnp.all(yhat == y, axis=-1).astype(jnp.float32)
+
+
+class Top5Accuracy(Metric):
+    name = "top5_accuracy"
+
+    def __call__(self, preds, labels):
+        p, y = _first(preds), _first(labels)
+        if y.ndim == p.ndim:
+            y = jnp.argmax(y, axis=-1)
+        top5 = jnp.argsort(p, axis=-1)[..., -5:]
+        return jnp.any(top5 == y[..., None].astype(top5.dtype),
+                       axis=-1).astype(jnp.float32)
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def __call__(self, preds, labels):
+        p, y = _first(preds), _first(labels)
+        return jnp.abs(p.reshape(p.shape[0], -1)
+                       - y.reshape(y.shape[0], -1)).mean(axis=-1)
+
+
+class MSE(Metric):
+    name = "mse"
+
+    def __call__(self, preds, labels):
+        p, y = _first(preds), _first(labels)
+        d = p.reshape(p.shape[0], -1) - y.reshape(y.shape[0], -1)
+        return (d * d).mean(axis=-1)
+
+
+_REGISTRY = {
+    "accuracy": Accuracy,
+    "acc": Accuracy,
+    "sparse_categorical_accuracy": SparseCategoricalAccuracy,
+    "categorical_accuracy": CategoricalAccuracy,
+    "binary_accuracy": BinaryAccuracy,
+    "top5accuracy": Top5Accuracy,
+    "top5_accuracy": Top5Accuracy,
+    "mae": MAE,
+    "mse": MSE,
+}
+
+
+def resolve(metric) -> Metric:
+    """Accept Metric instances, classes, callables, or registry names."""
+    if isinstance(metric, Metric):
+        return metric
+    if isinstance(metric, type) and issubclass(metric, Metric):
+        return metric()
+    if isinstance(metric, str):
+        key = metric.lower()
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown metric '{metric}'; "
+                             f"known: {sorted(_REGISTRY)}")
+        return _REGISTRY[key]()
+    if callable(metric):
+        return _FnMetric(metric, getattr(metric, "__name__", "metric"))
+    raise TypeError(f"cannot resolve metric from {metric!r}")
+
+
+class _FnMetric(Metric):
+    def __init__(self, fn, name):
+        self.fn = fn
+        self.name = name
+
+    def __call__(self, preds, labels):
+        return self.fn(preds, labels)
+
+
+def resolve_all(metrics_arg) -> dict:
+    if metrics_arg is None:
+        return {}
+    if not isinstance(metrics_arg, (list, tuple)):
+        metrics_arg = [metrics_arg]
+    out = {}
+    for m in metrics_arg:
+        r = resolve(m)
+        out[r.get_name()] = r
+    return out

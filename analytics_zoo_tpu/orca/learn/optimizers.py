@@ -1,0 +1,156 @@
+"""Optimizer resolution (reference:
+/root/reference/pyzoo/zoo/orca/learn/optimizers/ — wrappers lowering to BigDL
+OptimMethods; here they lower to optax transformations).
+
+Also provides learning-rate schedules mirroring
+`orca/learn/optimizers/schedule.py` (Poly, Exponential, Step, Warmup...)
+as optax schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import optax
+
+
+class Schedule:
+    """Marker base for schedule builders; `build(base_lr)` returns an optax
+    schedule fn."""
+
+    def build(self, base_lr: float):
+        raise NotImplementedError
+
+
+class Poly(Schedule):
+    def __init__(self, power: float, max_iteration: int):
+        self.power, self.max_iteration = power, max_iteration
+
+    def build(self, base_lr):
+        return optax.polynomial_schedule(
+            init_value=base_lr, end_value=0.0, power=self.power,
+            transition_steps=self.max_iteration)
+
+
+class Exponential(Schedule):
+    def __init__(self, decay_step: int, decay_rate: float, stair_case=False):
+        self.decay_step, self.decay_rate = decay_step, decay_rate
+        self.stair_case = stair_case
+
+    def build(self, base_lr):
+        return optax.exponential_decay(
+            base_lr, self.decay_step, self.decay_rate,
+            staircase=self.stair_case)
+
+
+class Step(Schedule):
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def build(self, base_lr):
+        return optax.exponential_decay(
+            base_lr, self.step_size, self.gamma, staircase=True)
+
+
+class Warmup(Schedule):
+    def __init__(self, warmup_steps: int, total_steps: int,
+                 end_value: float = 0.0):
+        self.warmup_steps, self.total_steps = warmup_steps, total_steps
+        self.end_value = end_value
+
+    def build(self, base_lr):
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=base_lr,
+            warmup_steps=self.warmup_steps,
+            decay_steps=self.total_steps, end_value=self.end_value)
+
+
+def _lr(learning_rate, schedule: Optional[Schedule]):
+    if schedule is not None:
+        return schedule.build(learning_rate)
+    return learning_rate
+
+
+def SGD(learning_rate=1e-2, momentum=0.0, nesterov=False, weight_decay=0.0,
+        learningrate_schedule: Optional[Schedule] = None, **_):
+    lr = _lr(learning_rate, learningrate_schedule)
+    tx = optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+def Adam(learning_rate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8,
+         learningrate_schedule: Optional[Schedule] = None, **_):
+    return optax.adam(_lr(learning_rate, learningrate_schedule),
+                      b1=beta1, b2=beta2, eps=epsilon)
+
+
+def AdamWeightDecay(learning_rate=1e-3, weight_decay=0.01, beta1=0.9,
+                    beta2=0.999, epsilon=1e-6,
+                    learningrate_schedule: Optional[Schedule] = None, **_):
+    """The BERT optimizer (reference scala keras AdamWeightDecay,
+    SURVEY.md §2.4)."""
+    return optax.adamw(_lr(learning_rate, learningrate_schedule),
+                       b1=beta1, b2=beta2, eps=epsilon,
+                       weight_decay=weight_decay)
+
+
+def RMSprop(learning_rate=1e-3, decay_rate=0.9, epsilon=1e-8, **_):
+    return optax.rmsprop(learning_rate, decay=decay_rate, eps=epsilon)
+
+
+def Adagrad(learning_rate=1e-2, **_):
+    return optax.adagrad(learning_rate)
+
+
+def Adadelta(learning_rate=1.0, rho=0.95, epsilon=1e-6, **_):
+    return optax.adadelta(learning_rate, rho=rho, eps=epsilon)
+
+
+def LBFGS(learning_rate=1.0, **_):
+    return optax.lbfgs(learning_rate)
+
+
+_REGISTRY = {
+    "sgd": SGD,
+    "adam": Adam,
+    "adamw": AdamWeightDecay,
+    "adamweightdecay": AdamWeightDecay,
+    "rmsprop": RMSprop,
+    "adagrad": Adagrad,
+    "adadelta": Adadelta,
+}
+
+
+def resolve(optimizer, learning_rate: Optional[float] = None,
+            clip_norm: Optional[float] = None,
+            clip_value: Optional[float] = None):
+    """Accept an optax GradientTransformation, a name, or None (adam).
+    Gradient clipping mirrors the reference Estimator's
+    set_gradient_clipping (zoo/pipeline/estimator/Estimator.scala:75-96)."""
+    # only pass learning_rate when the user gave one, so each optimizer's
+    # documented default holds (and an explicit 0.0 is honored)
+    lr_kwargs = {} if learning_rate is None else {
+        "learning_rate": learning_rate}
+    if optimizer is None:
+        tx = Adam(**lr_kwargs)
+    elif isinstance(optimizer, str):
+        key = optimizer.lower()
+        if key not in _REGISTRY:
+            raise ValueError(
+                f"unknown optimizer '{optimizer}'; known: {sorted(_REGISTRY)}")
+        tx = _REGISTRY[key](**lr_kwargs)
+    elif isinstance(optimizer, optax.GradientTransformation):
+        tx = optimizer
+    else:
+        raise TypeError(f"cannot resolve optimizer from {optimizer!r}")
+
+    clips = []
+    if clip_norm:
+        clips.append(optax.clip_by_global_norm(clip_norm))
+    if clip_value:
+        clips.append(optax.clip(clip_value))
+    if clips:
+        tx = optax.chain(*clips, tx)
+    return tx

@@ -1,0 +1,214 @@
+"""The ONE SPMD training engine (L4').
+
+This replaces all eight distributed-training backends of the reference
+(SURVEY.md §2.3 DP-1..DP-8): BigDL's Spark-BlockManager parameter-server
+allreduce (zoo/src/main/scala/.../keras/models/Topology.scala:1145-1310),
+gloo DDP on Ray actors (pyzoo/zoo/orca/learn/pytorch/torch_runner.py:136-152),
+TF2 MultiWorkerMirroredStrategy, Horovod, MXNet KVStore, the MPI launcher,
+and the two graph-in-JVM embeddings.
+
+Design: parameters live as sharded `jax.Array`s laid out by
+`infer_param_shardings` (replicated for pure DP; "fsdp"/"tp" rules shard
+them); each step consumes a *global* batch assembled from process-local
+numpy via `shard_batch`; the whole step is one `jax.jit` — XLA turns the
+global-mean loss gradient into reduce-scatter/all-gather collectives over
+ICI.  bfloat16 compute with float32 params/optimizer state keeps the MXU fed
+without hand-written mixed-precision plumbing.
+
+The engine is framework-agnostic: it takes a pure `apply_fn(params,
+features, rng, training)` plus a per-example loss, which is what the
+Keras-style API, the flax path, and the torch importer all lower to.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from analytics_zoo_tpu.common.context import OrcaContext
+from analytics_zoo_tpu.parallel.sharding import (
+    batch_sharding,
+    data_parallelism,
+    infer_param_shardings,
+    replicated,
+    shard_batch,
+)
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    rng: jnp.ndarray
+    # mutable model collections (e.g. BatchNorm stats); empty dict if unused
+    model_state: Any = struct.field(default_factory=dict)
+
+
+def masked_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Mean over real (unpadded) examples.  `values` is per-example with
+    leading batch dim; trailing dims are averaged per example first."""
+    values = values.reshape(values.shape[0], -1).mean(axis=1)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return (values * mask).sum() / denom
+
+
+class SPMDEngine:
+    """Sharded training/eval/predict executor for one model.
+
+    apply_fn(params, model_state, features, rng, training)
+        -> (preds, new_model_state)
+    loss_fn(preds, labels) -> per-example loss (leading dim = batch)
+    metric_fns: {name: fn(preds, labels) -> per-example values}
+    """
+
+    def __init__(self,
+                 apply_fn: Callable,
+                 params: Any,
+                 optimizer: optax.GradientTransformation,
+                 loss_fn: Optional[Callable] = None,
+                 metric_fns: Optional[Dict[str, Callable]] = None,
+                 model_state: Any = None,
+                 mesh=None,
+                 shard_rules: Optional[Dict[str, str]] = None,
+                 seed: int = 0):
+        self.mesh = mesh or OrcaContext.mesh
+        self.apply_fn = apply_fn
+        self.tx = optimizer
+        self.loss_fn = loss_fn
+        self.metric_fns = dict(metric_fns or {})
+        self.shard_rules = shard_rules or {}
+        self._data_sharding = batch_sharding(self.mesh)
+        self._repl = replicated(self.mesh)
+
+        params = jax.tree_util.tree_map(np.asarray, params)
+        self.param_shardings = infer_param_shardings(
+            params, self.mesh, self.shard_rules)
+        params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, s), params, self.param_shardings)
+        opt_state = self.tx.init(params)
+        model_state = model_state if model_state is not None else {}
+        model_state = jax.device_put(model_state, self._repl)
+        self.state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            rng=jax.random.PRNGKey(seed),
+            model_state=model_state)
+
+        self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
+        self._eval_step = jax.jit(self._eval_step_impl)
+        self._predict_step = jax.jit(self._predict_step_impl)
+
+    # ------------------------------------------------------------------
+    # jitted step functions
+    # ------------------------------------------------------------------
+
+    def _forward(self, params, model_state, features, rng, training):
+        return self.apply_fn(params, model_state, features, rng, training)
+
+    def _train_step_impl(self, state: TrainState, batch):
+        rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_of(params):
+            preds, new_ms = self._forward(
+                params, state.model_state, batch["features"], rng, True)
+            per_ex = self.loss_fn(preds, batch["labels"])
+            loss = masked_mean(per_ex, batch["mask"])
+            return loss, (preds, new_ms)
+
+        (loss, (preds, new_ms)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(state.params)
+        updates, opt_state = self.tx.update(grads, state.opt_state,
+                                            state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(step=state.step + 1, params=params,
+                                  opt_state=opt_state, model_state=new_ms)
+        stats = {"loss": loss}
+        for name, fn in self.metric_fns.items():
+            stats[name] = masked_mean(fn(preds, batch["labels"]),
+                                      batch["mask"])
+        stats["_count"] = batch["mask"].sum()
+        return new_state, stats
+
+    def _eval_step_impl(self, state: TrainState, batch):
+        preds, _ = self._forward(state.params, state.model_state,
+                                 batch["features"], state.rng, False)
+        stats = {}
+        if batch["labels"]:  # metrics/loss need labels; label-less eval
+            if self.loss_fn is not None:
+                per_ex = self.loss_fn(preds, batch["labels"])
+                stats["loss"] = masked_mean(per_ex, batch["mask"])
+            for name, fn in self.metric_fns.items():
+                stats[name] = masked_mean(fn(preds, batch["labels"]),
+                                          batch["mask"])
+        stats["_count"] = batch["mask"].sum()
+        return stats
+
+    def _predict_step_impl(self, state: TrainState, batch):
+        preds, _ = self._forward(state.params, state.model_state,
+                                 batch["features"], state.rng, False)
+        return preds
+
+    # ------------------------------------------------------------------
+    # host-side loops
+    # ------------------------------------------------------------------
+
+    def put_batch(self, batch: Dict[str, Any]):
+        return shard_batch(batch, self.mesh)
+
+    def run_epoch(self, batch_iter, train: bool = True,
+                  on_step: Optional[Callable[[int], None]] = None
+                  ) -> Dict[str, float]:
+        """Drive one pass; returns weighted-average stats over real rows.
+        `on_step(global_step)` is called after each training step (for
+        step-granular triggers)."""
+        totals: Dict[str, float] = {}
+        count = 0.0
+        # host-side step mirror: avoids a device sync per step just to
+        # know the step number
+        step = int(np.asarray(self.state.step)) if train else 0
+        for host_batch in batch_iter:
+            batch = self.put_batch(host_batch)
+            if train:
+                self.state, stats = self._train_step(self.state, batch)
+                step += 1
+            else:
+                stats = self._eval_step(self.state, batch)
+            stats = jax.device_get(stats)
+            c = float(stats.pop("_count"))
+            for k, v in stats.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * c
+            count += c
+            if train and on_step is not None:
+                on_step(step)
+        return {k: v / max(count, 1.0) for k, v in totals.items()}
+
+    def predict_all(self, batch_iter) -> List[np.ndarray]:
+        """Run inference over batches; strips padding rows per batch."""
+        outs = []
+        for host_batch in batch_iter:
+            n_real = int(host_batch["mask"].sum())
+            batch = self.put_batch(host_batch)
+            preds = jax.device_get(self._predict_step(self.state, batch))
+            outs.append(jax.tree_util.tree_map(lambda a: a[:n_real], preds))
+        return outs
+
+    # ------------------------------------------------------------------
+    def pad_multiple(self) -> int:
+        return data_parallelism(self.mesh)
+
+    def get_params(self):
+        return jax.device_get(self.state.params)
+
+    def set_params(self, params):
+        params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(np.asarray(p), s),
+            params, self.param_shardings)
+        self.state = self.state.replace(params=params)

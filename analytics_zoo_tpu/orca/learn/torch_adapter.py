@@ -1,0 +1,18 @@
+"""torch.nn.Module → flax import path backing `Estimator.from_torch`
+(reference: /root/reference/pyzoo/zoo/orca/learn/pytorch/estimator.py:39).
+
+Planned design: trace the module with torch.fx and interpret the traced
+graph with jax ops, copying weights — so training runs on the TPU mesh with
+no torch runtime in the hot loop (unlike the reference, which embeds real
+CPython-torch inside Spark executors via jep, TorchModel.scala:34).
+"""
+
+from __future__ import annotations
+
+
+def torch_to_flax(model):
+    """Convert a torch.nn.Module to (flax_module, params, model_state)."""
+    raise NotImplementedError(
+        "Estimator.from_torch is not implemented yet in this build; use "
+        "Estimator.from_flax or Estimator.from_keras. The torch.fx-based "
+        "importer lands in analytics_zoo_tpu.orca.learn.torch_adapter.")

@@ -1,0 +1,52 @@
+"""Checkpoint/validation triggers (reference:
+/root/reference/pyzoo/zoo/orca/learn/trigger.py:19-100, which proxies BigDL
+Trigger objects)."""
+
+from __future__ import annotations
+
+
+class Trigger:
+    def __call__(self, *, epoch: int, step: int, epoch_end: bool) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def resolve(t):
+        if t is None or isinstance(t, Trigger):
+            return t
+        raise TypeError(f"not a Trigger: {t!r}")
+
+
+class EveryEpoch(Trigger):
+    """Fires at each epoch boundary (reference trigger.py:40)."""
+
+    def __call__(self, *, epoch, step, epoch_end):
+        return epoch_end
+
+
+class SeveralIteration(Trigger):
+    """Fires every `interval` training steps (reference trigger.py:59)."""
+
+    def __init__(self, interval: int):
+        if interval <= 0:
+            raise ValueError("interval must be positive")
+        self.interval = interval
+
+    def __call__(self, *, epoch, step, epoch_end):
+        return (not epoch_end) and step > 0 and step % self.interval == 0
+
+
+class MaxIteration(Trigger):
+    def __init__(self, max_steps: int):
+        self.max = max_steps
+
+    def __call__(self, *, epoch, step, epoch_end):
+        return step >= self.max
+
+
+class MinLoss(Trigger):
+    def __init__(self, min_loss: float):
+        self.min = min_loss
+        self.last_loss = None
+
+    def __call__(self, *, epoch, step, epoch_end):
+        return self.last_loss is not None and self.last_loss < self.min

@@ -1,0 +1,140 @@
+"""Data lowering for the training engine.
+
+Reference equivalents: `pyzoo/zoo/orca/learn/utils.py` (`dataframe_to_xshards`
+:282, `convert_predict_*`) and `pyzoo/zoo/orca/data/utils.py:168-236`
+(`ray_partition_get_data_label`, `xshard_to_sample`).
+
+The reference forces `batch_size % total_core_num == 0`
+(pyzoo/zoo/tfpark/tf_dataset.py:148-153) and re-partitions data so shards
+divide evenly.  Here the global batch must be divisible by the mesh's data
+parallelism *for XLA sharding*, so instead of constraining the user we
+pad the final partial batch and carry an explicit `mask` column that the
+loss/metrics consume — static shapes for XLA, exact results for the user
+(SURVEY.md §7 "hard parts": global-batch ↔ per-host shard math).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.orca.data.shard import XShards, _concat_shards
+
+
+def _as_tuple(x) -> Tuple:
+    if x is None:
+        return ()
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+def _stack_cols(df, cols: Sequence[str]) -> Tuple[np.ndarray, ...]:
+    out = []
+    for c in cols:
+        v = df[c].to_numpy()
+        if v.dtype == object:  # column of arrays
+            v = np.stack(v)
+        out.append(v)
+    return tuple(out)
+
+
+class HostDataset:
+    """The host-resident, already-merged (features, labels) arrays this
+    process will feed to its devices.  One instance per fit/evaluate/predict
+    call; the TPU-native stand-in for FeatureSet's cached RDD partitions."""
+
+    def __init__(self, features: Tuple[np.ndarray, ...],
+                 labels: Tuple[np.ndarray, ...]):
+        self.features = features
+        self.labels = labels
+        self.n = len(features[0]) if features else 0
+
+    @staticmethod
+    def from_data(data: Any,
+                  feature_cols: Optional[Sequence[str]] = None,
+                  label_cols: Optional[Sequence[str]] = None) -> "HostDataset":
+        """Accepts: dict {"x": ndarray(s), "y": ndarray(s)} (the reference
+        XShards convention), (x, y) tuples, bare ndarrays/tuples (no labels),
+        pandas DataFrames (+feature_cols/label_cols), or XShards of any of
+        those."""
+        import pandas as pd
+
+        if isinstance(data, XShards):
+            shards = data.collect()
+            if not shards:
+                raise ValueError("empty XShards")
+            if isinstance(shards[0], pd.DataFrame):
+                data = pd.concat(shards, ignore_index=True)
+            else:
+                data = _concat_shards(shards)
+
+        if isinstance(data, pd.DataFrame):
+            if not feature_cols:
+                raise ValueError("feature_cols required for DataFrame input")
+            feats = _stack_cols(data, feature_cols)
+            labels = _stack_cols(data, _as_tuple(label_cols)) if label_cols else ()
+            return HostDataset(feats, labels)
+
+        if isinstance(data, dict):
+            x = data.get("x")
+            y = data.get("y")
+            if x is None:
+                raise ValueError('dict data must have an "x" key')
+            return HostDataset(_np_tuple(x), _np_tuple(y))
+
+        if isinstance(data, tuple) and len(data) == 2:
+            # a 2-tuple is always (x, y), matching the reference convention
+            return HostDataset(_np_tuple(data[0]), _np_tuple(data[1]))
+
+        return HostDataset(_np_tuple(data), ())
+
+    def batches(self, batch_size: int, *, shuffle: bool = False,
+                seed: int = 0, pad_to_multiple_of: int = 1,
+                epoch: int = 0) -> Iterator[Dict[str, Any]]:
+        """Yield host-local batches of `batch_size` rows, each padded up to a
+        multiple of `pad_to_multiple_of` with a float `mask` marking real
+        rows."""
+        idx = np.arange(self.n)
+        if shuffle:
+            rng = np.random.default_rng(seed + epoch)
+            rng.shuffle(idx)
+        for start in range(0, self.n, batch_size):
+            take = idx[start:start + batch_size]
+            feats = tuple(a[take] for a in self.features)
+            labels = tuple(a[take] for a in self.labels)
+            yield pad_batch(feats, labels, batch_size, pad_to_multiple_of)
+
+    def steps_per_epoch(self, batch_size: int) -> int:
+        return max(1, int(np.ceil(self.n / batch_size)))
+
+
+def _np_tuple(x) -> Tuple[np.ndarray, ...]:
+    return tuple(np.asarray(a) for a in _as_tuple(x))
+
+
+def pad_batch(feats: Tuple[np.ndarray, ...], labels: Tuple[np.ndarray, ...],
+              batch_size: int, multiple: int) -> Dict[str, Any]:
+    n = len(feats[0]) if feats else 0
+    # every batch is padded to the same static shape: one XLA compilation,
+    # and dim 0 always divides the mesh's data parallelism
+    target = _round_up(batch_size, multiple)
+    mask = np.zeros(target, np.float32)
+    mask[:n] = 1.0
+
+    def _pad(a):
+        if len(a) == target:
+            return a
+        pad_width = [(0, target - len(a))] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, pad_width)
+
+    return {
+        "features": tuple(_pad(a) for a in feats),
+        "labels": tuple(_pad(a) for a in labels),
+        "mask": mask,
+    }
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m

@@ -1,0 +1,8 @@
+from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    replicated,
+    shard_batch,
+    named_sharding,
+    logical_to_sharding,
+    infer_param_shardings,
+)

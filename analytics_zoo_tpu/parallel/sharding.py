@@ -1,0 +1,130 @@
+"""Sharding helpers: the single SPMD substrate that replaces the reference's
+eight data-parallel backends (SURVEY.md §2.3, DP-1..DP-8).
+
+The reference synchronizes gradients through a parameter-server allreduce
+built on Spark BlockManager (BigDL `AllReduceParameter`,
+zoo/src/main/scala/.../keras/models/Topology.scala:1204) or per-framework
+collectives (gloo DDP, TF collective ops, Horovod, MXNet KVStore).  Here the
+equivalent is *implicit*: batches are global `jax.Array`s sharded over the
+mesh's data axes, parameters are sharded (or replicated) per a rule table,
+and XLA inserts the reduce-scatter/all-gather collectives over ICI when the
+jitted train step computes a global-mean loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.common.context import DATA_AXES, OrcaContext
+
+
+def _present_axes(mesh: Mesh, axes: Sequence[str]) -> Tuple[str, ...]:
+    return tuple(a for a in axes if a in mesh.axis_names)
+
+
+def data_axes(mesh: Optional[Mesh] = None) -> Tuple[str, ...]:
+    """The mesh axes a batch dimension is sharded over."""
+    mesh = mesh or OrcaContext.mesh
+    return _present_axes(mesh, DATA_AXES)
+
+
+def data_parallelism(mesh: Optional[Mesh] = None) -> int:
+    """Number of data-parallel shards (product of data-axis sizes)."""
+    mesh = mesh or OrcaContext.mesh
+    n = 1
+    for a in data_axes(mesh):
+        n *= mesh.shape[a]
+    return n
+
+
+def named_sharding(spec: P, mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or OrcaContext.mesh
+    return NamedSharding(mesh, spec)
+
+
+def batch_sharding(mesh: Optional[Mesh] = None, ndim: int = None) -> NamedSharding:
+    """Sharding for a batch tensor: dim 0 split over the data axes, the rest
+    replicated.  (The global-batch semantics of the reference's TFDataset
+    per-core batch math, pyzoo/zoo/tfpark/tf_dataset.py:148-153.)"""
+    mesh = mesh or OrcaContext.mesh
+    axes = data_axes(mesh)
+    spec = P(axes if axes else None)
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or OrcaContext.mesh
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
+    """Turn a pytree of *process-local* numpy arrays into global sharded
+    `jax.Array`s, batch dim split over the data axes.
+
+    Uses `jax.make_array_from_process_local_data`, which on a multi-host pod
+    assembles a global array from each host's local shard (the TPU-native
+    analog of RayXShards' locality-aware partition→actor assignment,
+    pyzoo/zoo/orca/data/ray_xshards.py:252) and degenerates to a plain
+    device_put on one host.
+    """
+    mesh = mesh or OrcaContext.mesh
+    sharding = batch_sharding(mesh)
+
+    def _one(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(_one, batch)
+
+
+# ---------------------------------------------------------------------------
+# Parameter sharding rules
+# ---------------------------------------------------------------------------
+
+def logical_to_sharding(rules: Dict[str, Optional[str]],
+                        path: Tuple[str, ...],
+                        shape: Tuple[int, ...],
+                        mesh: Mesh) -> NamedSharding:
+    """Map a parameter (by its pytree path) to a NamedSharding using
+    substring rules: ``{"kernel": "tp", ...}`` shards the *last* dimension of
+    any param whose joined path contains the key over the named axis."""
+    joined = "/".join(str(p) for p in path)
+    for key, axis in rules.items():
+        if key in joined and axis in mesh.axis_names and mesh.shape[axis] > 1:
+            ndim = len(shape)
+            if ndim == 0:
+                continue
+            # shard the largest dim that divides the axis size
+            order = sorted(range(ndim), key=lambda i: -shape[i])
+            for dim in order:
+                if shape[dim] % mesh.shape[axis] == 0:
+                    spec = [None] * ndim
+                    spec[dim] = axis
+                    return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())
+
+
+def infer_param_shardings(params: Any,
+                          mesh: Optional[Mesh] = None,
+                          rules: Optional[Dict[str, str]] = None) -> Any:
+    """Produce a sharding pytree for `params`.
+
+    Default policy: replicate everything (pure DP — capability parity with
+    the reference).  With `rules` (and a mesh that has "fsdp"/"tp" axes),
+    large parameters get sharded, giving FSDP/TP "for free" — the
+    capability the reference lacks entirely (SURVEY.md §2.3).
+    """
+    mesh = mesh or OrcaContext.mesh
+    rules = rules or {}
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    shardings = []
+    for path, leaf in flat:
+        names = tuple(getattr(p, "key", getattr(p, "idx", p)) for p in path)
+        shape = np.shape(leaf)
+        shardings.append(logical_to_sharding(rules, names, shape, mesh))
+    return jax.tree_util.tree_unflatten(treedef, shardings)

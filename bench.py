@@ -1,0 +1,108 @@
+"""Headline benchmark: NCF training throughput (samples/sec) on the real
+TPU chip — BASELINE.md north-star metric #1 ("NCF samples/sec/chip").
+
+The reference publishes no absolute numbers (BASELINE.json published: {});
+its stated target is ">10x per-node CPU BigDL throughput".  We therefore
+report `vs_baseline` as TPU throughput divided by (10 x the same train step
+measured on this host's CPU), i.e. vs_baseline >= 1.0 means the >10x-CPU
+target is met against a CPU baseline that is itself generous to the
+reference (same XLA-compiled model, not Py4J+JVM BigDL).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _throughput(platform: str, batch: int, steps: int, warmup: int) -> float:
+    import jax
+    devices = jax.devices(platform)
+    dev = devices[0]
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+    users, items = 200_000, 50_000
+    model = NeuralCF(user_count=users, item_count=items, class_num=2,
+                     user_embed=64, item_embed=64,
+                     hidden_layers=(256, 256, 128), mf_embed=64)
+
+    rng = np.random.default_rng(0)
+    u = rng.integers(1, users + 1, batch).astype(np.int32)
+    i = rng.integers(1, items + 1, batch).astype(np.int32)
+    y = ((u + i) % 2).astype(np.int32)
+
+    with jax.default_device(dev):
+        key = jax.random.PRNGKey(0)
+        params = model.init(key, u[:1], i[:1])["params"]
+        tx = optax.adam(1e-3)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state, u, i, y):
+            def loss_fn(p):
+                logits = model.apply({"params": p}, u, i, training=True)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean()
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        u_d, i_d, y_d = (jax.device_put(a, dev) for a in (u, i, y))
+        for _ in range(warmup):
+            params, opt_state, loss = step(params, opt_state, u_d, i_d, y_d)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, u_d, i_d, y_d)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main():
+    import jax
+
+    batch = int(os.environ.get("BENCH_BATCH", 16384))
+    tpu_platform = None
+    for p in ("axon", "tpu"):
+        try:
+            jax.devices(p)
+            tpu_platform = p
+            break
+        except RuntimeError:
+            continue
+
+    if tpu_platform is None:
+        tpu_platform = "cpu"  # degraded mode: no accelerator visible
+
+    value = _throughput(tpu_platform, batch, steps=30, warmup=5)
+    cpu = None
+    for cpu_batch in (batch, 4096, 512):
+        try:
+            cpu = _throughput("cpu", cpu_batch, steps=3, warmup=1)
+            break
+        except Exception:
+            continue
+    # 0.0 = CPU baseline unavailable (never fabricate a met target)
+    vs = value / (10.0 * cpu) if cpu else 0.0
+
+    print(json.dumps({
+        "metric": "ncf_train_samples_per_sec",
+        "value": round(value, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

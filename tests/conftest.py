@@ -1,0 +1,36 @@
+"""Test bootstrap: distributed-without-a-cluster (SURVEY.md §4.3-4.4).
+
+The reference runs its whole distributed stack on Spark local[8] + Ray local
+(pyzoo/test/zoo/orca/learn/ray/pytorch/conftest.py:22-40).  The TPU-native
+analog: 8 virtual CPU devices via --xla_force_host_platform_device_count, so
+every test exercises real mesh sharding and XLA collectives with no TPU.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# force CPU: the session env pins JAX_PLATFORMS to the real TPU platform, and
+# a sitecustomize pre-imports jax, so the env var alone is captured too early —
+# update the live config as well (the XLA backend itself initializes lazily,
+# so this still lands in time).
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def orca_context_local():
+    """Fresh local context per test that needs explicit init."""
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    stop_orca_context()
+    mesh = init_orca_context(cluster_mode="local")
+    yield mesh
+    stop_orca_context()

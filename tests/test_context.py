@@ -1,0 +1,46 @@
+import pytest
+
+
+def test_init_local_default_mesh(orca_context_local):
+    from analytics_zoo_tpu import OrcaContext
+    mesh = orca_context_local
+    assert OrcaContext.initialized
+    assert mesh.axis_names == ("dp",)
+    assert OrcaContext.num_devices == 8
+
+
+def test_mesh_shape_dp_tp():
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    stop_orca_context()
+    mesh = init_orca_context(cluster_mode="local",
+                             mesh_shape={"dp": 2, "tp": 4})
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
+    stop_orca_context()
+
+
+def test_mesh_folds_remainder_into_dp():
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    stop_orca_context()
+    mesh = init_orca_context(cluster_mode="local", mesh_shape={"tp": 2})
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+    stop_orca_context()
+
+
+def test_bad_cluster_mode():
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    stop_orca_context()
+    with pytest.raises(ValueError):
+        init_orca_context(cluster_mode="yarn")
+
+
+def test_orca_context_knobs():
+    from analytics_zoo_tpu import OrcaContext
+    OrcaContext.shard_size = 100
+    assert OrcaContext.shard_size == 100
+    OrcaContext.shard_size = None
+    with pytest.raises(ValueError):
+        OrcaContext.train_data_store = "GPU"
+    OrcaContext.train_data_store = "DISK_4"
+    assert OrcaContext.train_data_store == "DISK_4"
+    OrcaContext.train_data_store = "DRAM"

@@ -1,0 +1,96 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.orca.data import XShards
+from analytics_zoo_tpu.orca.data import pandas as orca_pandas
+
+
+def test_partition_dict_and_collect():
+    x = np.arange(100).reshape(100, 1)
+    y = np.arange(100)
+    shards = XShards.partition({"x": x, "y": y}, num_shards=4)
+    assert shards.num_partitions() == 4
+    back = shards.collect()
+    assert sum(len(s["x"]) for s in back) == 100
+    assert len(shards) == 100
+
+
+def test_partition_nested():
+    data = {"x": [np.ones((10, 2)), np.zeros((10, 3))], "y": np.arange(10)}
+    shards = XShards.partition(data, num_shards=3)
+    merged = shards.merged()
+    assert merged["x"][0].shape == (10, 2)
+    assert merged["x"][1].shape == (10, 3)
+    np.testing.assert_array_equal(merged["y"], np.arange(10))
+
+
+def test_transform_shard_parallel():
+    shards = XShards.partition(np.arange(64), num_shards=8)
+    doubled = shards.transform_shard(lambda s: s * 2)
+    np.testing.assert_array_equal(doubled.merged(), np.arange(64) * 2)
+
+
+def test_repartition_arrays():
+    shards = XShards.partition(np.arange(30), num_shards=3)
+    r = shards.repartition(5)
+    assert r.num_partitions() == 5
+    np.testing.assert_array_equal(np.sort(r.merged()), np.arange(30))
+
+
+def test_partition_by_and_unique():
+    df = pd.DataFrame({"k": [1, 2, 1, 3, 2, 1], "v": range(6)})
+    shards = XShards([df.iloc[:3], df.iloc[3:]])
+    parts = shards.partition_by("k", num_partitions=3)
+    # all rows of one key land in exactly one shard
+    for key in (1, 2, 3):
+        holders = [i for i, p in enumerate(parts.collect())
+                   if (p["k"] == key).any()]
+        assert len(holders) == 1, (key, holders)
+    all_keys = np.concatenate([p["k"].unique() for p in parts.collect()
+                               if len(p)])
+    assert sorted(set(all_keys)) == [1, 2, 3]
+    assert sorted(shards.unique("k")) == [1, 2, 3]
+
+
+def test_zip_and_split():
+    a = XShards.partition(np.arange(10), num_shards=2)
+    b = XShards.partition(np.arange(10) * 10, num_shards=2)
+    z = a.zip(b)
+    parts = z.split()
+    assert len(parts) == 2
+    np.testing.assert_array_equal(parts[1].merged(), np.arange(10) * 10)
+
+
+def test_save_load_pickle(tmp_path):
+    shards = XShards.partition(np.arange(20), num_shards=4)
+    shards.save_pickle(str(tmp_path / "s"))
+    loaded = XShards.load_pickle(str(tmp_path / "s"))
+    np.testing.assert_array_equal(loaded.merged(), np.arange(20))
+
+
+def test_disk_tier(tmp_path):
+    from analytics_zoo_tpu import OrcaContext
+    OrcaContext.train_data_store = "DISK_2"
+    try:
+        shards = XShards.partition(np.arange(16), num_shards=4)
+        np.testing.assert_array_equal(shards.merged(), np.arange(16))
+    finally:
+        OrcaContext.train_data_store = "DRAM"
+
+
+def test_read_csv_dir(tmp_path):
+    for i in range(3):
+        pd.DataFrame({"a": range(5), "b": range(5)}).to_csv(
+            tmp_path / f"f{i}.csv", index=False)
+    shards = orca_pandas.read_csv(str(tmp_path))
+    df = shards.to_pandas()
+    assert len(df) == 15
+    assert list(df.columns) == ["a", "b"]
+
+
+def test_read_single_csv_splits(tmp_path):
+    pd.DataFrame({"a": range(100)}).to_csv(tmp_path / "one.csv", index=False)
+    shards = orca_pandas.read_csv(str(tmp_path / "one.csv"))
+    assert shards.num_partitions() > 1
+    assert len(shards.to_pandas()) == 100
