@@ -1,0 +1,12 @@
+"""Keras-style model-building API (reference:
+/root/reference/pyzoo/zoo/pipeline/api/keras/ — python front-end over 120
+Scala layer classes; here: a symbolic graph that lowers to one flax module
+and trains on the SPMD engine).
+"""
+
+from analytics_zoo_tpu.keras.engine import Input, Layer  # noqa: F401
+from analytics_zoo_tpu.keras.models import Model, Sequential  # noqa: F401
+from analytics_zoo_tpu.keras import layers  # noqa: F401
+from analytics_zoo_tpu.orca.learn import losses as objectives  # noqa: F401
+from analytics_zoo_tpu.orca.learn import metrics  # noqa: F401
+from analytics_zoo_tpu.orca.learn import optimizers  # noqa: F401

@@ -1,0 +1,164 @@
+"""Symbolic graph engine for the Keras-style API.
+
+Reference: `pyzoo/zoo/pipeline/api/keras/engine/topology.py` — there, layer
+calls build a JVM-side graph over Py4J.  Here a layer call records a `Node`
+in a lightweight Python DAG; `Model(inputs, outputs)` topologically sorts it
+and lowers the whole graph to ONE flax module (`GraphModule`), so XLA sees a
+single traced function it can fuse end-to-end — there is no per-layer
+dispatch at run time.
+
+Design notes:
+  * Layers are config holders.  Parameterized layers implement
+    `build_flax()` returning a flax module; stateless ops implement
+    `call(*xs, training)` with pure jax.  Either way the layer's `name`
+    fixes the flax parameter scope, so param trees are stable across
+    rebuilds.
+  * No shape inference pass: flax infers input dims lazily at init, which
+    removes the entire Keras shape-propagation machinery.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+_name_counters: Dict[str, "itertools.count"] = defaultdict(
+    lambda: itertools.count(1))
+
+
+def _auto_name(prefix: str) -> str:
+    return f"{prefix}_{next(_name_counters[prefix])}"
+
+
+def reset_name_scope():
+    _name_counters.clear()
+
+
+class SymTensor:
+    """A symbolic tensor: the output of a Node (layer invocation)."""
+
+    def __init__(self, node: "Node", index: int = 0):
+        self.node = node
+        self.index = index
+
+    # ---- operator sugar (autograd-style Variable math, reference
+    # pyzoo/zoo/pipeline/api/autograd.py:256) ----
+    def __add__(self, other):
+        from analytics_zoo_tpu.keras.layers.merge import _BinaryOp
+        return _BinaryOp(jnp.add, "add")([self, _lift(other)])
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        from analytics_zoo_tpu.keras.layers.merge import _BinaryOp
+        return _BinaryOp(jnp.subtract, "sub")([self, _lift(other)])
+
+    def __rsub__(self, other):
+        from analytics_zoo_tpu.keras.layers.merge import _BinaryOp
+        return _BinaryOp(jnp.subtract, "rsub")([_lift(other), self])
+
+    def __mul__(self, other):
+        from analytics_zoo_tpu.keras.layers.merge import _BinaryOp
+        return _BinaryOp(jnp.multiply, "mul")([self, _lift(other)])
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        from analytics_zoo_tpu.keras.layers.merge import _BinaryOp
+        return _BinaryOp(jnp.divide, "div")([self, _lift(other)])
+
+    def __rtruediv__(self, other):
+        from analytics_zoo_tpu.keras.layers.merge import _BinaryOp
+        return _BinaryOp(jnp.divide, "rdiv")([_lift(other), self])
+
+    def __pow__(self, other):
+        from analytics_zoo_tpu.keras.layers.merge import _BinaryOp
+        return _BinaryOp(jnp.power, "pow")([self, _lift(other)])
+
+    def __neg__(self):
+        from analytics_zoo_tpu.keras.layers.merge import _UnaryOp
+        return _UnaryOp(jnp.negative, "neg")(self)
+
+
+def _lift(x):
+    if isinstance(x, SymTensor):
+        return x
+    from analytics_zoo_tpu.keras.layers.merge import _Const
+    return _Const(x)()
+
+
+class Node:
+    def __init__(self, layer: "Layer", inputs: List[SymTensor]):
+        self.layer = layer
+        self.inputs = inputs
+
+
+class InputNode(Node):
+    def __init__(self, name: str, shape: Optional[Tuple[int, ...]]):
+        super().__init__(layer=None, inputs=[])
+        self.name = name
+        self.shape = shape
+
+
+def Input(shape: Optional[Sequence[int]] = None, name: Optional[str] = None
+          ) -> SymTensor:
+    """Declare a graph input (reference topology.py `Input`).  `shape`
+    excludes the batch dim and is only documentation here — real shapes
+    come from the data."""
+    name = name or _auto_name("input")
+    return SymTensor(InputNode(name, tuple(shape) if shape else None))
+
+
+class Layer:
+    """Base class.  Subclasses set `self.name` via __init__(name=...) and
+    implement either `build_flax()` (parameterized) or `call()`
+    (stateless)."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or _auto_name(type(self).__name__.lower())
+
+    # -- one of these two --
+    def build_flax(self):
+        return None
+
+    def call(self, *xs, training: bool = False):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement call() or build_flax()")
+
+    #: number of outputs the layer produces; >1 makes the symbolic call
+    #: return a tuple of SymTensors (e.g. BERT -> (sequence, pooled))
+    n_outputs = 1
+
+    def __call__(self, x):
+        """Symbolic application.  `x` is a SymTensor or list of them."""
+        inputs = list(x) if isinstance(x, (list, tuple)) else [x]
+        for t in inputs:
+            if not isinstance(t, SymTensor):
+                raise TypeError(
+                    f"layer {self.name} called on non-symbolic input "
+                    f"{type(t).__name__}; use Input(...) to start a graph")
+        node = Node(self, inputs)
+        if self.n_outputs == 1:
+            return SymTensor(node)
+        return tuple(SymTensor(node, i) for i in range(self.n_outputs))
+
+
+def topo_sort(outputs: List[SymTensor]) -> List[Node]:
+    """Deterministic post-order DFS over the DAG."""
+    seen: Dict[int, Node] = {}
+    order: List[Node] = []
+
+    def visit(node: Node):
+        if id(node) in seen:
+            return
+        seen[id(node)] = node
+        for t in node.inputs:
+            visit(t.node)
+        order.append(node)
+
+    for t in outputs:
+        visit(t.node)
+    return order

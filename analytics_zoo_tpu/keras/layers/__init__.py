@@ -1,0 +1,65 @@
+from analytics_zoo_tpu.keras.layers.core import (  # noqa: F401
+    Activation,
+    Dense,
+    Dropout,
+    Flatten,
+    GaussianNoise,
+    Highway,
+    Lambda,
+    Permute,
+    RepeatVector,
+    Reshape,
+)
+from analytics_zoo_tpu.keras.layers.embeddings import Embedding  # noqa: F401
+from analytics_zoo_tpu.keras.layers.normalization import (  # noqa: F401
+    BatchNormalization,
+    LayerNormalization,
+)
+from analytics_zoo_tpu.keras.layers.conv import (  # noqa: F401
+    Conv1D,
+    Conv2D,
+    Conv3D,
+    Convolution1D,
+    Convolution2D,
+    Convolution3D,
+    Cropping2D,
+    Deconvolution2D,
+    SeparableConv2D,
+    UpSampling1D,
+    UpSampling2D,
+    ZeroPadding1D,
+    ZeroPadding2D,
+)
+from analytics_zoo_tpu.keras.layers.pooling import (  # noqa: F401
+    AveragePooling1D,
+    AveragePooling2D,
+    AveragePooling3D,
+    GlobalAveragePooling1D,
+    GlobalAveragePooling2D,
+    GlobalMaxPooling1D,
+    GlobalMaxPooling2D,
+    MaxPooling1D,
+    MaxPooling2D,
+    MaxPooling3D,
+)
+from analytics_zoo_tpu.keras.layers.recurrent import (  # noqa: F401
+    GRU,
+    LSTM,
+    Bidirectional,
+    SimpleRNN,
+    TimeDistributed,
+)
+from analytics_zoo_tpu.keras.layers.merge import (  # noqa: F401
+    Add,
+    Average,
+    Concat,
+    Dot,
+    Maximum,
+    Merge,
+    Multiply,
+    merge,
+)
+from analytics_zoo_tpu.keras.layers.self_attention import (  # noqa: F401
+    BERT,
+    TransformerLayer,
+)

@@ -1,0 +1,194 @@
+"""Convolution layers (reference: keras layers Convolution1D/2D/3D,
+Deconvolution2D, SeparableConvolution2D, ZeroPadding, UpSampling, Cropping).
+
+Layout is channels-last (NWC / NHWC / NDHWC) — the idiomatic layout for
+XLA:TPU convolutions (feeds the MXU without transposes)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.engine import Layer
+from analytics_zoo_tpu.keras.layers.core import get_activation
+
+IntOrPair = Union[int, Sequence[int]]
+
+
+def _tup(v: IntOrPair, n: int) -> Tuple[int, ...]:
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    assert len(t) == n, f"expected {n} values, got {t}"
+    return t
+
+
+def _pad(border_mode: str):
+    return {"same": "SAME", "valid": "VALID"}[border_mode.lower()]
+
+
+class _ConvND(Layer):
+    ndim = 2
+
+    def __init__(self, nb_filter: int, kernel_size, activation=None,
+                 subsample=1, border_mode: str = "valid",
+                 use_bias: bool = True, name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.nb_filter = nb_filter
+        self.kernel_size = _tup(kernel_size, self.ndim)
+        self.strides = _tup(subsample, self.ndim)
+        self.padding = _pad(border_mode)
+        self.activation = get_activation(activation)
+        self.use_bias = use_bias
+
+    def build_flax(self):
+        return nn.Conv(self.nb_filter, self.kernel_size,
+                       strides=self.strides, padding=self.padding,
+                       use_bias=self.use_bias, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return self.activation(m(x))
+
+
+class Conv1D(_ConvND):
+    ndim = 1
+
+    def __init__(self, nb_filter, filter_length=3, **kwargs):
+        super().__init__(nb_filter, filter_length, **kwargs)
+
+
+class Conv2D(_ConvND):
+    ndim = 2
+
+    def __init__(self, nb_filter, nb_row=3, nb_col=None, **kwargs):
+        ks = (nb_row, nb_col if nb_col is not None else nb_row) \
+            if isinstance(nb_row, int) else nb_row
+        super().__init__(nb_filter, ks, **kwargs)
+
+
+class Conv3D(_ConvND):
+    ndim = 3
+
+    def __init__(self, nb_filter, kernel_size=3, **kwargs):
+        super().__init__(nb_filter, kernel_size, **kwargs)
+
+
+# reference naming aliases
+Convolution1D = Conv1D
+Convolution2D = Conv2D
+Convolution3D = Conv3D
+
+
+class Deconvolution2D(Layer):
+    """Transposed conv (reference Deconvolution2D)."""
+
+    def __init__(self, nb_filter: int, nb_row: int = 3,
+                 nb_col: Optional[int] = None, activation=None,
+                 subsample=1, border_mode: str = "valid",
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.nb_filter = nb_filter
+        self.kernel_size = (nb_row, nb_col if nb_col is not None else nb_row)
+        self.strides = _tup(subsample, 2)
+        self.padding = _pad(border_mode)
+        self.activation = get_activation(activation)
+
+    def build_flax(self):
+        return nn.ConvTranspose(self.nb_filter, self.kernel_size,
+                                strides=self.strides, padding=self.padding,
+                                name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return self.activation(m(x))
+
+
+class SeparableConv2D(Layer):
+    """Depthwise conv followed by 1x1 pointwise conv."""
+
+    def __init__(self, nb_filter: int, nb_row: int = 3,
+                 nb_col: Optional[int] = None, activation=None,
+                 depth_multiplier: int = 1, subsample=1,
+                 border_mode: str = "valid", name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.nb_filter = nb_filter
+        self.kernel_size = (nb_row, nb_col if nb_col is not None else nb_row)
+        self.depth_multiplier = depth_multiplier
+        self.strides = _tup(subsample, 2)
+        self.padding = _pad(border_mode)
+        self.activation = get_activation(activation)
+
+    def build_flax(self):
+        return _SeparableConv(self.nb_filter, self.kernel_size,
+                              self.depth_multiplier, self.strides,
+                              self.padding, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return self.activation(m(x))
+
+
+class _SeparableConv(nn.Module):
+    filters: int
+    kernel_size: Tuple[int, int]
+    depth_multiplier: int
+    strides: Tuple[int, int]
+    padding: str
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        depth = nn.Conv(in_ch * self.depth_multiplier, self.kernel_size,
+                        strides=self.strides, padding=self.padding,
+                        feature_group_count=in_ch, name="depthwise")(x)
+        return nn.Conv(self.filters, (1, 1), name="pointwise")(depth)
+
+
+class ZeroPadding1D(Layer):
+    def __init__(self, padding: IntOrPair = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.padding = _tup(padding, 2) if not isinstance(padding, int) \
+            else (padding, padding)
+
+    def call(self, x, training=False):
+        return jnp.pad(x, ((0, 0), self.padding, (0, 0)))
+
+
+class ZeroPadding2D(Layer):
+    def __init__(self, padding: IntOrPair = 1, name: Optional[str] = None):
+        super().__init__(name)
+        p = _tup(padding, 2) if not isinstance(padding, int) \
+            else (padding, padding)
+        self.padding = ((p[0], p[0]), (p[1], p[1]))
+
+    def call(self, x, training=False):
+        return jnp.pad(x, ((0, 0),) + self.padding + ((0, 0),))
+
+
+class UpSampling1D(Layer):
+    def __init__(self, length: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.length = length
+
+    def call(self, x, training=False):
+        return jnp.repeat(x, self.length, axis=1)
+
+
+class UpSampling2D(Layer):
+    def __init__(self, size=(2, 2), name: Optional[str] = None):
+        super().__init__(name)
+        self.size = _tup(size, 2)
+
+    def call(self, x, training=False):
+        x = jnp.repeat(x, self.size[0], axis=1)
+        return jnp.repeat(x, self.size[1], axis=2)
+
+
+class Cropping2D(Layer):
+    def __init__(self, cropping=((0, 0), (0, 0)), name: Optional[str] = None):
+        super().__init__(name)
+        self.cropping = cropping
+
+    def call(self, x, training=False):
+        (t, b), (l, r) = self.cropping
+        return x[:, t:x.shape[1] - b or None, l:x.shape[2] - r or None, :]

@@ -1,0 +1,178 @@
+"""Core layers (reference: `pyzoo/zoo/pipeline/api/keras/layers/core.py` over
+scala `pipeline/api/keras/layers/` — Dense, Dropout, Flatten, ...)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.engine import Layer
+
+_ACTIVATIONS = {
+    "relu": nn.relu,
+    "relu6": nn.relu6,
+    "tanh": jnp.tanh,
+    "sigmoid": nn.sigmoid,
+    "hard_sigmoid": nn.hard_sigmoid,
+    "softmax": nn.softmax,
+    "log_softmax": nn.log_softmax,
+    "softplus": nn.softplus,
+    "softsign": nn.soft_sign,
+    "elu": nn.elu,
+    "selu": nn.selu,
+    "gelu": nn.gelu,
+    "swish": nn.swish,
+    "silu": nn.silu,
+    "leakyrelu": nn.leaky_relu,
+    "leaky_relu": nn.leaky_relu,
+    "linear": lambda x: x,
+    None: lambda x: x,
+}
+
+
+def get_activation(act) -> Callable:
+    if callable(act):
+        return act
+    try:
+        return _ACTIVATIONS[act.lower() if isinstance(act, str) else act]
+    except KeyError:
+        raise ValueError(f"unknown activation '{act}'; "
+                         f"known: {sorted(k for k in _ACTIVATIONS if k)}")
+
+
+class Dense(Layer):
+    """Fully-connected layer (reference core.py Dense; applied to the last
+    dim, matching the reference's behavior on >2D input)."""
+
+    def __init__(self, output_dim: int, activation=None, use_bias: bool = True,
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.output_dim = output_dim
+        self.activation = get_activation(activation)
+        self.use_bias = use_bias
+
+    def build_flax(self):
+        return nn.Dense(self.output_dim, use_bias=self.use_bias,
+                        name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return self.activation(m(x))
+
+
+class Dropout(Layer):
+    def __init__(self, p: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.p = p
+
+    def build_flax(self):
+        return nn.Dropout(rate=self.p, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x, deterministic=not training)
+
+
+class GaussianNoise(Layer):
+    def __init__(self, sigma: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.sigma = sigma
+
+    def build_flax(self):
+        return _GaussianNoise(sigma=self.sigma, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x, training=training)
+
+
+class _GaussianNoise(nn.Module):
+    sigma: float
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        if not training:
+            return x
+        noise = jax.random.normal(self.make_rng("dropout"), x.shape, x.dtype)
+        return x + self.sigma * noise
+
+
+class Activation(Layer):
+    def __init__(self, activation, name: Optional[str] = None):
+        super().__init__(name)
+        self.activation = get_activation(activation)
+
+    def call(self, x, training=False):
+        return self.activation(x)
+
+
+class Flatten(Layer):
+    def call(self, x, training=False):
+        return x.reshape(x.shape[0], -1)
+
+
+class Reshape(Layer):
+    def __init__(self, target_shape: Sequence[int],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.target_shape = tuple(target_shape)
+
+    def call(self, x, training=False):
+        return x.reshape((x.shape[0],) + self.target_shape)
+
+
+class Permute(Layer):
+    """Permute non-batch dims; `dims` is 1-indexed like keras."""
+
+    def __init__(self, dims: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.dims = tuple(dims)
+
+    def call(self, x, training=False):
+        return jnp.transpose(x, (0,) + tuple(d for d in self.dims))
+
+
+class RepeatVector(Layer):
+    def __init__(self, n: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.n = n
+
+    def call(self, x, training=False):
+        return jnp.repeat(x[:, None, :], self.n, axis=1)
+
+
+class Lambda(Layer):
+    """Wrap an arbitrary jax function (reference autograd Lambda,
+    pyzoo/zoo/pipeline/api/autograd.py:369)."""
+
+    def __init__(self, function: Callable, name: Optional[str] = None):
+        super().__init__(name)
+        self.function = function
+
+    def call(self, *xs, training=False):
+        return self.function(*xs)
+
+
+class Highway(Layer):
+    """y = t * h(Wx+b) + (1-t) * x (reference keras layers Highway)."""
+
+    def __init__(self, activation="tanh", name: Optional[str] = None):
+        super().__init__(name)
+        self.activation = get_activation(activation)
+
+    def build_flax(self):
+        return _Highway(activation=self.activation, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x)
+
+
+class _Highway(nn.Module):
+    activation: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        h = self.activation(nn.Dense(d, name="transform")(x))
+        t = nn.sigmoid(nn.Dense(d, name="gate")(x))
+        return t * h + (1 - t) * x

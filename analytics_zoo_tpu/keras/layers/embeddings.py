@@ -1,0 +1,25 @@
+"""Embedding (reference: keras layers `Embedding`, scala
+`pipeline/api/keras/layers/Embedding.scala`)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.engine import Layer
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim: int, output_dim: int,
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def build_flax(self):
+        return nn.Embed(self.input_dim, self.output_dim, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x.astype(jnp.int32))

@@ -1,0 +1,112 @@
+"""Merge layers + operator-sugar ops (reference: keras layers `merge`/Merge
+and the autograd Variable arithmetic,
+pyzoo/zoo/pipeline/api/autograd.py:256)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.engine import Layer
+
+
+class Merge(Layer):
+    """N-ary merge (reference `merge(inputs, mode=...)`)."""
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.mode = mode.lower()
+        self.concat_axis = concat_axis
+
+    def call(self, *xs, training=False):
+        if self.mode in ("sum", "add"):
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out
+        if self.mode in ("mul", "multiply"):
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if self.mode in ("ave", "average"):
+            return sum(xs) / len(xs)
+        if self.mode == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if self.mode in ("concat", "concatenate"):
+            return jnp.concatenate(xs, axis=self.concat_axis)
+        if self.mode == "dot":
+            a, b = xs
+            return jnp.sum(a * b, axis=-1, keepdims=True)
+        if self.mode == "cos":
+            a, b = xs
+            na = jnp.linalg.norm(a, axis=-1, keepdims=True)
+            nb = jnp.linalg.norm(b, axis=-1, keepdims=True)
+            return jnp.sum(a * b, axis=-1, keepdims=True) / (na * nb + 1e-8)
+        raise ValueError(f"unknown merge mode '{self.mode}'")
+
+
+def merge(inputs, mode: str = "sum", concat_axis: int = -1,
+          name: Optional[str] = None):
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(inputs)
+
+
+def _named(mode):
+    class _M(Merge):
+        def __init__(self, name: Optional[str] = None, **kw):
+            super().__init__(mode=mode, name=name, **kw)
+    _M.__name__ = mode.capitalize()
+    return _M
+
+
+Add = _named("sum")
+Multiply = _named("mul")
+Average = _named("ave")
+Maximum = _named("max")
+Dot = _named("dot")
+
+
+class Concat(Merge):
+    def __init__(self, axis: int = -1, name: Optional[str] = None):
+        super().__init__(mode="concat", concat_axis=axis, name=name)
+
+
+class _BinaryOp(Layer):
+    def __init__(self, fn: Callable, opname: str):
+        from analytics_zoo_tpu.keras.engine import _auto_name
+        super().__init__(_auto_name(f"{opname}_op"))
+        self.fn = fn
+
+    def call(self, a, b, training=False):
+        return self.fn(a, b)
+
+
+class _UnaryOp(Layer):
+    def __init__(self, fn: Callable, opname: str):
+        from analytics_zoo_tpu.keras.engine import _auto_name
+        super().__init__(_auto_name(f"{opname}_op"))
+        self.fn = fn
+
+    def call(self, a, training=False):
+        return self.fn(a)
+
+
+class _Const(Layer):
+    """Lift a python/numpy constant into the graph."""
+
+    def __init__(self, value):
+        from analytics_zoo_tpu.keras.engine import _auto_name
+        super().__init__(_auto_name("const"))
+        self.value = value
+
+    def __call__(self):
+        from analytics_zoo_tpu.keras.engine import Node, SymTensor
+        return SymTensor(Node(self, []))
+
+    def call(self, training=False):
+        return jnp.asarray(self.value)

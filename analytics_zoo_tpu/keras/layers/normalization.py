@@ -1,0 +1,39 @@
+"""Normalization layers (reference: keras layers BatchNormalization)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+
+from analytics_zoo_tpu.keras.engine import Layer
+
+
+class BatchNormalization(Layer):
+    """Running stats live in the engine's model_state ("batch_stats"
+    collection), updated during training steps."""
+
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.epsilon = epsilon
+        self.momentum = momentum
+
+    def build_flax(self):
+        return nn.BatchNorm(use_running_average=None, momentum=self.momentum,
+                            epsilon=self.epsilon, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x, use_running_average=not training)
+
+
+class LayerNormalization(Layer):
+    def __init__(self, epsilon: float = 1e-6, name: Optional[str] = None):
+        super().__init__(name)
+        self.epsilon = epsilon
+
+    def build_flax(self):
+        return nn.LayerNorm(epsilon=self.epsilon, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x)

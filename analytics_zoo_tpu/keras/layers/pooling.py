@@ -1,0 +1,82 @@
+"""Pooling layers (reference: keras layers MaxPooling1D/2D/3D,
+AveragePooling*, Global*Pooling*); channels-last."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.engine import Layer
+from analytics_zoo_tpu.keras.layers.conv import IntOrPair, _pad, _tup
+
+
+class _PoolND(Layer):
+    ndim = 2
+    mode = "max"
+
+    def __init__(self, pool_size: IntOrPair = 2, strides=None,
+                 border_mode: str = "valid", name: Optional[str] = None):
+        super().__init__(name)
+        self.pool_size = _tup(pool_size, self.ndim)
+        self.strides = _tup(strides, self.ndim) if strides is not None \
+            else self.pool_size
+        self.padding = _pad(border_mode)
+
+    def call(self, x, training=False):
+        if self.mode == "max":
+            return nn.max_pool(x, self.pool_size, strides=self.strides,
+                               padding=self.padding)
+        return nn.avg_pool(x, self.pool_size, strides=self.strides,
+                           padding=self.padding)
+
+
+class MaxPooling1D(_PoolND):
+    ndim, mode = 1, "max"
+
+
+class MaxPooling2D(_PoolND):
+    ndim, mode = 2, "max"
+
+
+class MaxPooling3D(_PoolND):
+    ndim, mode = 3, "max"
+
+
+class AveragePooling1D(_PoolND):
+    ndim, mode = 1, "avg"
+
+
+class AveragePooling2D(_PoolND):
+    ndim, mode = 2, "avg"
+
+
+class AveragePooling3D(_PoolND):
+    ndim, mode = 3, "avg"
+
+
+class _GlobalPool(Layer):
+    axes: Tuple[int, ...] = (1,)
+    mode = "max"
+
+    def call(self, x, training=False):
+        if self.mode == "max":
+            return x.max(axis=self.axes)
+        return x.mean(axis=self.axes)
+
+
+class GlobalMaxPooling1D(_GlobalPool):
+    axes, mode = (1,), "max"
+
+
+class GlobalAveragePooling1D(_GlobalPool):
+    axes, mode = (1,), "avg"
+
+
+class GlobalMaxPooling2D(_GlobalPool):
+    axes, mode = (1, 2), "max"
+
+
+class GlobalAveragePooling2D(_GlobalPool):
+    axes, mode = (1, 2), "avg"

@@ -1,0 +1,127 @@
+"""Recurrent layers (reference: keras layers LSTM/GRU/SimpleRNN/
+Bidirectional/TimeDistributed, scala `pipeline/api/keras/layers/`).
+
+TPU note: flax `nn.RNN` lowers to `lax.scan`, giving XLA a compiled loop
+with static shapes (no per-step Python dispatch like the reference's JVM
+recurrent containers)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.engine import Layer
+from analytics_zoo_tpu.keras.layers.core import get_activation
+
+
+class _RNNBase(Layer):
+    def __init__(self, output_dim: int, activation=None,
+                 return_sequences: bool = False,
+                 go_backwards: bool = False, name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.output_dim = output_dim
+        # `activation` configures the cell's internal activation (reference
+        # semantics), not a post-hoc transform of the outputs
+        self.cell_activation = (get_activation(activation)
+                                if activation is not None else None)
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def _cell_kwargs(self):
+        kw = {}
+        if self.cell_activation is not None:
+            kw["activation_fn"] = self.cell_activation
+        return kw
+
+    def _cell(self, name=None):
+        raise NotImplementedError
+
+    def build_flax(self):
+        return nn.RNN(self._cell(name=f"{self.name}_cell"), name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        if self.go_backwards:
+            x = jnp.flip(x, axis=1)
+        y = m(x)
+        return y if self.return_sequences else y[:, -1]
+
+
+class LSTM(_RNNBase):
+    def _cell(self, name=None):
+        return nn.OptimizedLSTMCell(self.output_dim, name=name,
+                                    **self._cell_kwargs())
+
+
+class GRU(_RNNBase):
+    def _cell(self, name=None):
+        return nn.GRUCell(self.output_dim, name=name,
+                          **self._cell_kwargs())
+
+
+class SimpleRNN(_RNNBase):
+    def _cell(self, name=None):
+        return nn.SimpleCell(self.output_dim, name=name,
+                             **self._cell_kwargs())
+
+
+class Bidirectional(Layer):
+    """Runs the wrapped recurrent layer forward and backward and merges
+    (reference Bidirectional)."""
+
+    def __init__(self, layer: _RNNBase, merge_mode: str = "concat",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.layer = layer
+        self.merge_mode = merge_mode.lower()
+
+    def build_flax(self):
+        return nn.RNN(self.layer._cell(name=f"{self.name}_fwd_cell"),
+                      name=f"{self.name}_fwd")
+
+    def apply_flax(self, m, x, training=False):
+        bwd = nn.RNN(self.layer._cell(name=f"{self.name}_bwd_cell"),
+                     name=f"{self.name}_bwd")
+        y_f = m(x)
+        y_b_rev = bwd(jnp.flip(x, axis=1))  # index -1 = full-sequence state
+        if self.layer.return_sequences:
+            y_f_out, y_b_out = y_f, jnp.flip(y_b_rev, axis=1)
+        else:
+            # forward final state + backward final state (after consuming
+            # the whole sequence), NOT the backward step-0 output
+            y_f_out, y_b_out = y_f[:, -1], y_b_rev[:, -1]
+        if self.merge_mode == "concat":
+            return jnp.concatenate([y_f_out, y_b_out], axis=-1)
+        if self.merge_mode == "sum":
+            return y_f_out + y_b_out
+        if self.merge_mode in ("ave", "average"):
+            return (y_f_out + y_b_out) / 2
+        if self.merge_mode == "mul":
+            return y_f_out * y_b_out
+        raise ValueError(f"unknown merge_mode '{self.merge_mode}'")
+
+
+class TimeDistributed(Layer):
+    """Apply a layer independently at every timestep (reference
+    TimeDistributed): fold time into batch, apply, unfold."""
+
+    def __init__(self, layer: Layer, name: Optional[str] = None):
+        super().__init__(name)
+        self.layer = layer
+
+    def build_flax(self):
+        return self.layer.build_flax()
+
+    def apply_flax(self, m, x, training=False):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        if m is not None:
+            y = self.layer.apply_flax(m, flat, training=training)
+        else:
+            y = self.layer.call(flat, training=training)
+        return y.reshape((b, t) + y.shape[1:])
+
+    def call(self, x, training=False):
+        # stateless inner layer path
+        return self.apply_flax(None, x, training=training)

@@ -1,0 +1,182 @@
+"""Transformer / BERT keras layers (reference:
+`pyzoo/zoo/pipeline/api/keras/layers/self_attention.py:46` TransformerLayer,
+`:235` BERT, scala `pipeline/api/keras/layers/BERT.scala`).
+
+TPU-first design: attention is computed in bfloat16 einsums shaped
+[batch, heads, q, k] that XLA tiles onto the MXU; the sequence dim of the
+activations can shard over the "sp" mesh axis and heads over "tp" via the
+estimator's shard_rules.  (A pallas flash-attention kernel can be dropped in
+at `analytics_zoo_tpu.ops.attention` for long sequences.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.keras.engine import Layer
+
+
+class MultiHeadAttention(nn.Module):
+    hidden_size: int
+    n_head: int
+    attn_dropout: float = 0.0
+    causal: bool = False
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask=None, training: bool = False):
+        from analytics_zoo_tpu.ops.attention import dot_product_attention
+
+        b, t, d = x.shape
+        h = self.n_head
+        qkv = nn.Dense(3 * self.hidden_size, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(a):
+            return a.reshape(b, t, h, self.hidden_size // h)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        drop_rng = (self.make_rng("dropout")
+                    if training and self.attn_dropout > 0 else None)
+        out = dot_product_attention(
+            q, k, v, mask=mask, causal=self.causal,
+            dropout_rate=self.attn_dropout if training else 0.0,
+            dropout_rng=drop_rng, compute_dtype=self.compute_dtype)
+        out = out.reshape(b, t, self.hidden_size)
+        return nn.Dense(self.hidden_size, name="proj")(out)
+
+
+class TransformerBlock(nn.Module):
+    hidden_size: int
+    n_head: int
+    intermediate_size: int
+    attn_dropout: float = 0.0
+    residual_dropout: float = 0.0
+    causal: bool = False
+    activation: str = "gelu"
+
+    @nn.compact
+    def __call__(self, x, mask=None, training: bool = False):
+        from analytics_zoo_tpu.keras.layers.core import get_activation
+
+        a = MultiHeadAttention(self.hidden_size, self.n_head,
+                               self.attn_dropout, self.causal,
+                               name="attn")(x, mask, training)
+        a = nn.Dropout(self.residual_dropout)(a, deterministic=not training)
+        x = nn.LayerNorm(name="ln1")(x + a)
+        f = nn.Dense(self.intermediate_size, name="fc1")(x)
+        f = get_activation(self.activation)(f)
+        f = nn.Dense(self.hidden_size, name="fc2")(f)
+        f = nn.Dropout(self.residual_dropout)(f, deterministic=not training)
+        return nn.LayerNorm(name="ln2")(x + f)
+
+
+class TransformerEncoder(nn.Module):
+    """Embeddings + N blocks (+ optional pooler).  Post-LN like BERT."""
+    vocab: int
+    hidden_size: int
+    n_head: int
+    n_block: int
+    intermediate_size: int
+    max_position_len: int = 512
+    n_segments: int = 0          # 0 = no segment embeddings
+    embedding_dropout: float = 0.1
+    attn_dropout: float = 0.1
+    residual_dropout: float = 0.1
+    causal: bool = False
+    with_pooler: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids, segment_ids=None, position_ids=None,
+                 attention_mask=None, training: bool = False):
+        input_ids = input_ids.astype(jnp.int32)
+        b, t = input_ids.shape
+        x = nn.Embed(self.vocab, self.hidden_size, name="token_embed"
+                     )(input_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(t)[None, :]
+        x = x + nn.Embed(self.max_position_len, self.hidden_size,
+                         name="position_embed"
+                         )(position_ids.astype(jnp.int32))
+        if self.n_segments:
+            if segment_ids is None:
+                segment_ids = jnp.zeros((b, t), jnp.int32)
+            x = x + nn.Embed(self.n_segments, self.hidden_size,
+                             name="segment_embed"
+                             )(segment_ids.astype(jnp.int32))
+        x = nn.LayerNorm(name="embed_ln")(x)
+        x = nn.Dropout(self.embedding_dropout)(x, deterministic=not training)
+
+        mask = None
+        if attention_mask is not None:
+            # [b, t] of 1/0 -> additive [b, 1, 1, t]
+            mask = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)
+                    ) * -1e9
+        for i in range(self.n_block):
+            x = TransformerBlock(
+                self.hidden_size, self.n_head, self.intermediate_size,
+                self.attn_dropout, self.residual_dropout, self.causal,
+                name=f"block_{i}")(x, mask, training)
+
+        if self.with_pooler:
+            pooled = jnp.tanh(nn.Dense(self.hidden_size, name="pooler"
+                                       )(x[:, 0]))
+            return x, pooled
+        return x
+
+
+class TransformerLayer(Layer):
+    """GPT-style causal transformer over token ids (reference
+    self_attention.py:46)."""
+
+    def __init__(self, vocab: int, hidden_size: int = 768, n_head: int = 12,
+                 seq_len: int = 512, n_block: int = 12,
+                 intermediate_size: Optional[int] = None,
+                 embedding_drop: float = 0.1, attn_drop: float = 0.1,
+                 residual_drop: float = 0.1, name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.cfg = dict(
+            vocab=vocab, hidden_size=hidden_size, n_head=n_head,
+            n_block=n_block,
+            intermediate_size=intermediate_size or 4 * hidden_size,
+            max_position_len=seq_len, n_segments=0,
+            embedding_dropout=embedding_drop, attn_dropout=attn_drop,
+            residual_dropout=residual_drop, causal=True, with_pooler=False)
+
+    def build_flax(self):
+        return TransformerEncoder(name=self.name, **self.cfg)
+
+    def apply_flax(self, m, *xs, training=False):
+        return m(*xs, training=training)
+
+
+class BERT(Layer):
+    """BERT encoder layer: inputs (token_ids, segment_ids, position_ids,
+    attention_mask) -> (sequence_output, pooled_output) (reference
+    self_attention.py:235, BERT.scala)."""
+
+    n_outputs = 2
+
+    def __init__(self, vocab: int = 40990, hidden_size: int = 768,
+                 n_block: int = 12, n_head: int = 12,
+                 intermediate_size: int = 3072,
+                 max_position_len: int = 512, seq_len: int = 512,
+                 hidden_drop: float = 0.1, attn_drop: float = 0.1,
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.cfg = dict(
+            vocab=vocab, hidden_size=hidden_size, n_head=n_head,
+            n_block=n_block, intermediate_size=intermediate_size,
+            max_position_len=max(max_position_len, seq_len), n_segments=2,
+            embedding_dropout=hidden_drop, attn_dropout=attn_drop,
+            residual_dropout=hidden_drop, causal=False, with_pooler=True)
+
+    def build_flax(self):
+        return TransformerEncoder(name=self.name, **self.cfg)
+
+    def apply_flax(self, m, *xs, training=False):
+        return m(*xs, training=training)

@@ -1,0 +1,176 @@
+"""Model / Sequential (reference:
+`pyzoo/zoo/pipeline/api/keras/engine/topology.py` KerasNet/Model and
+`models.py` Sequential — compile/fit/evaluate/predict over the BigDL engine;
+here they lower to one flax module trained by the SPMD engine)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import flax.linen as nn
+
+from analytics_zoo_tpu.keras.engine import (
+    InputNode, Layer, Node, SymTensor, topo_sort)
+
+
+class _GraphModule(nn.Module):
+    """The whole keras graph as ONE flax module."""
+    model: Any
+
+    @nn.compact
+    def __call__(self, *inputs, training: bool = False):
+        return self.model._execute(inputs, training)
+
+
+class KerasNet:
+    """compile/fit/evaluate/predict surface shared by Model & Sequential
+    (reference topology.py:153-340)."""
+
+    def __init__(self):
+        self._loss = None
+        self._optimizer = None
+        self._metrics = None
+        self._estimator = None
+        self.model_dir = None
+
+    # -- lowering --
+    def to_flax(self) -> nn.Module:
+        return _GraphModule(model=self)
+
+    def _execute(self, inputs, training):
+        raise NotImplementedError
+
+    # -- training surface --
+    def compile(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics
+        self._estimator = None
+        return self
+
+    def set_checkpoint(self, path: str):
+        """Reference topology.py:153 set_checkpoint."""
+        self.model_dir = path
+
+    def _ensure_estimator(self):
+        # no loss required: an uncompiled model can still predict
+        if self._estimator is None:
+            from analytics_zoo_tpu.orca.learn.estimator import Estimator
+            self._estimator = Estimator.from_keras(
+                self, model_dir=self.model_dir)
+        return self._estimator
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 1,
+            epochs: Optional[int] = None, validation_data=None, **kwargs):
+        if self._loss is None:
+            raise RuntimeError(
+                "call compile(optimizer, loss) before fit")
+        data = x if y is None else (x, y)
+        est = self._ensure_estimator()
+        est.fit(data, epochs=epochs or nb_epoch, batch_size=batch_size,
+                validation_data=validation_data, **kwargs)
+        return self
+
+    def evaluate(self, x, y=None, batch_size: int = 32, **kwargs):
+        data = x if y is None else (x, y)
+        return self._ensure_estimator().evaluate(
+            data, batch_size=batch_size, **kwargs)
+
+    def predict(self, x, batch_size: int = 32, **kwargs):
+        return self._ensure_estimator().predict(
+            x, batch_size=batch_size, **kwargs)
+
+    def get_weights(self):
+        return self._ensure_estimator().get_model()
+
+    # -- introspection --
+    def layers(self) -> List[Layer]:
+        raise NotImplementedError
+
+    def summary(self) -> str:
+        lines = [f"{type(self).__name__}:"]
+        for l in self.layers():
+            lines.append(f"  {l.name} ({type(l).__name__})")
+        s = "\n".join(lines)
+        print(s)
+        return s
+
+
+class Model(KerasNet):
+    """Functional graph model (reference topology.py Model)."""
+
+    def __init__(self, input, output, name: Optional[str] = None):
+        super().__init__()
+        self.inputs: List[SymTensor] = (
+            list(input) if isinstance(input, (list, tuple)) else [input])
+        self.outputs: List[SymTensor] = (
+            list(output) if isinstance(output, (list, tuple)) else [output])
+        self._single_output = not isinstance(output, (list, tuple))
+        self.name = name or "model"
+        self._order = topo_sort(self.outputs)
+        input_ids = {id(t.node) for t in self.inputs}
+        for node in self._order:
+            if isinstance(node, InputNode) and id(node) not in input_ids:
+                raise ValueError(
+                    f"graph references Input '{node.name}' that is not in "
+                    "the model's input list")
+
+    def _execute(self, inputs, training):
+        if len(inputs) != len(self.inputs):
+            raise ValueError(
+                f"model expects {len(self.inputs)} inputs, got {len(inputs)}")
+        env = {}
+        built = {}  # one flax module per layer: shared layers share params
+        for sym, arr in zip(self.inputs, inputs):
+            env[id(sym.node)] = (arr,)
+        for node in self._order:
+            if isinstance(node, InputNode):
+                continue
+            xs = [env[id(t.node)][t.index] for t in node.inputs]
+            layer = node.layer
+            if id(layer) not in built:
+                built[id(layer)] = layer.build_flax()
+            m = built[id(layer)]
+            if m is not None:
+                y = layer.apply_flax(m, *xs, training=training)
+            else:
+                y = layer.call(*xs, training=training)
+            env[id(node)] = y if isinstance(y, tuple) else (y,)
+        outs = tuple(env[id(t.node)][t.index] for t in self.outputs)
+        return outs[0] if self._single_output else outs
+
+    def layers(self):
+        return [n.layer for n in self._order if n.layer is not None]
+
+
+class Sequential(KerasNet):
+    """Linear stack (reference models.py Sequential)."""
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None,
+                 name: Optional[str] = None):
+        super().__init__()
+        self._layers: List[Layer] = list(layers or [])
+        self.name = name or "sequential"
+
+    def add(self, layer: Layer) -> "Sequential":
+        self._layers.append(layer)
+        self._estimator = None
+        return self
+
+    def _execute(self, inputs, training):
+        if len(inputs) != 1:
+            raise ValueError("Sequential models take exactly one input")
+        x = inputs[0]
+        built = {}
+        for layer in self._layers:
+            if id(layer) not in built:
+                built[id(layer)] = layer.build_flax()
+            m = built[id(layer)]
+            if m is not None:
+                x = layer.apply_flax(m, x, training=training)
+            else:
+                x = layer.call(x, training=training)
+        return x
+
+    def layers(self):
+        return list(self._layers)

@@ -1,0 +1,43 @@
+"""Attention ops.
+
+`dot_product_attention` is the reference implementation every attention
+consumer in the framework calls; it computes the [b, h, q, k] score matrix
+with bfloat16 einsums (MXU-friendly) and float32 softmax accumulation.
+A pallas flash-attention kernel (tiled online-softmax, no materialized
+score matrix) can replace it for long sequences — same signature — via
+`use_flash=True` once `analytics_zoo_tpu.ops.pallas.flash_attention` lands.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(q, k, v, mask=None, causal: bool = False,
+                          dropout_rate: float = 0.0, dropout_rng=None,
+                          compute_dtype=jnp.bfloat16):
+    """q, k, v: [batch, time, heads, head_dim] (BTHD).  `mask` is an
+    additive float mask broadcastable to [batch, heads, q_time, k_time].
+    Returns [batch, time, heads, head_dim]."""
+    b, t, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    q = q.astype(compute_dtype)
+    k = k.astype(compute_dtype)
+    v = v.astype(compute_dtype)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(causal_mask[None, None], scores, -1e9)
+    if mask is not None:
+        scores = scores + mask
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(compute_dtype), v)
+    return out.astype(jnp.float32)

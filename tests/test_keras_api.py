@@ -1,0 +1,192 @@
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.keras import Input, Model, Sequential, optimizers
+from analytics_zoo_tpu.keras import layers as L
+
+
+def test_sequential_mlp_fit():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 10)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+
+    model = Sequential([
+        L.Dense(32, activation="relu"),
+        L.Dropout(0.1),
+        L.Dense(2),
+    ])
+    model.compile(optimizer=optimizers.Adam(learning_rate=1e-2),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=32, nb_epoch=5)
+    stats = model.evaluate(x, y, batch_size=32)
+    assert stats["accuracy"] > 0.85, stats
+
+
+def test_functional_two_tower_ncf_style():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    user = rng.integers(0, 50, 300)
+    item = rng.integers(0, 30, 300)
+    y = ((user + item) % 2).astype(np.int32)
+
+    u_in, i_in = Input(shape=(), name="user"), Input(shape=(), name="item")
+    u_emb = L.Flatten()(L.Embedding(50, 16)(u_in))
+    i_emb = L.Flatten()(L.Embedding(30, 16)(i_in))
+    h = L.Concat()([u_emb, i_emb])
+    h = L.Dense(32, activation="relu")(h)
+    out = L.Dense(2)(h)
+    model = Model(input=[u_in, i_in], output=out)
+    model.compile(optimizer=optimizers.Adam(learning_rate=1e-2),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit({"x": [user, item], "y": y}, batch_size=32, nb_epoch=6)
+    stats = model.evaluate({"x": [user, item], "y": y})
+    assert stats["accuracy"] > 0.8, stats
+    preds = model.predict({"x": [user, item]})
+    assert preds.shape == (300, 2)
+
+
+def test_operator_sugar_autograd_style():
+    init_orca_context(cluster_mode="local")
+    a, b = Input(shape=(4,)), Input(shape=(4,))
+    out = L.Dense(3)((a + b) * 2.0)
+    model = Model(input=[a, b], output=out)
+    model.compile(optimizer="sgd", loss="mse")
+    x1 = np.ones((16, 4), np.float32)
+    x2 = np.zeros((16, 4), np.float32)
+    preds = model.predict({"x": [x1, x2]}, batch_size=8)
+    assert preds.shape == (16, 3)
+
+
+def test_conv_pool_batchnorm_stack():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8, 8, 3)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    model = Sequential([
+        L.Conv2D(8, 3, border_mode="same", activation="relu"),
+        L.BatchNormalization(),
+        L.MaxPooling2D(2),
+        L.GlobalAveragePooling2D(),
+        L.Dense(2),
+    ])
+    model.compile(optimizer=optimizers.Adam(learning_rate=1e-2),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=16, nb_epoch=3)
+    preds = model.predict(x, batch_size=16)
+    assert preds.shape == (64, 2)
+
+
+def test_lstm_sequence_classification():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 12, 6)).astype(np.float32)
+    y = (x[:, :, 0].mean(axis=1) > 0).astype(np.int32)
+    model = Sequential([
+        L.LSTM(16),
+        L.Dense(2),
+    ])
+    model.compile(optimizer=optimizers.Adam(learning_rate=1e-2),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=32, nb_epoch=4)
+    stats = model.evaluate(x, y)
+    assert stats["accuracy"] > 0.7, stats
+
+
+def test_bidirectional_and_timedistributed():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 5, 4)).astype(np.float32)
+    model = Sequential([
+        L.Bidirectional(L.GRU(8, return_sequences=True)),
+        L.TimeDistributed(L.Dense(3)),
+    ])
+    model.compile(optimizer="adam", loss="mse")
+    preds = model.predict(x, batch_size=16)
+    assert preds.shape == (32, 5, 3)
+
+
+def test_transformer_layer_forward():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 100, size=(8, 16)).astype(np.int32)
+    t_in = Input(shape=(16,))
+    h = L.TransformerLayer(vocab=100, hidden_size=32, n_head=4, seq_len=16,
+                           n_block=2)(t_in)
+    out = L.Dense(2)(L.Lambda(lambda a: a[:, 0])(h))
+    model = Model(input=t_in, output=out)
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    preds = model.predict(ids, batch_size=8)
+    assert preds.shape == (8, 2)
+
+
+def test_bert_layer_outputs():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    b, t = 4, 12
+    ids = rng.integers(0, 50, size=(b, t)).astype(np.int32)
+    seg = np.zeros((b, t), np.int32)
+    pos = np.tile(np.arange(t), (b, 1)).astype(np.int32)
+    mask = np.ones((b, t), np.int32)
+
+    inputs = [Input(shape=(t,)) for _ in range(4)]
+    bert = L.BERT(vocab=50, hidden_size=24, n_block=2, n_head=3,
+                  intermediate_size=48, seq_len=t)
+    seq, pooled = bert(inputs)
+    model = Model(input=inputs, output=[seq, pooled])
+    model.compile(optimizer="adam", loss="mse")
+    out_seq, out_pooled = model.predict(
+        {"x": [ids, seg, pos, mask]}, batch_size=4)
+    assert out_seq.shape == (b, t, 24)
+    assert out_pooled.shape == (b, 24)
+
+
+def test_shared_layer_weight_sharing():
+    """Regression: the same layer instance used twice shares parameters."""
+    init_orca_context(cluster_mode="local")
+    a, b = Input(shape=(6,)), Input(shape=(6,))
+    shared = L.Dense(4)
+    out = shared(a) + shared(b)
+    model = Model(input=[a, b], output=out)
+    model.compile(optimizer="sgd", loss="mse")
+    x = np.ones((8, 6), np.float32)
+    z = np.zeros((8, 6), np.float32)
+    p1 = model.predict({"x": [x, z]}, batch_size=8)
+    p2 = model.predict({"x": [z, x]}, batch_size=8)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)  # symmetric by sharing
+    params = model.get_weights()
+    assert sum(1 for k in params if "dense" in k) == 1, list(params)
+
+
+def test_rsub_rdiv_sugar():
+    init_orca_context(cluster_mode="local")
+    x_in = Input(shape=(3,))
+    out = 1.0 - x_in
+    model = Model(input=x_in, output=out)
+    preds = model.predict(np.full((8, 3), 0.25, np.float32), batch_size=8)
+    np.testing.assert_allclose(preds, 0.75)
+
+
+def test_predict_without_compile():
+    init_orca_context(cluster_mode="local")
+    model = Sequential([L.Dense(2)])
+    preds = model.predict(np.ones((8, 3), np.float32), batch_size=8)
+    assert preds.shape == (8, 2)
+
+
+def test_bidirectional_last_step_uses_final_backward_state():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 6, 3)).astype(np.float32)
+    bi = Sequential([L.Bidirectional(L.GRU(5))])
+    bi.compile(optimizer="sgd", loss="mse")
+    seq = Sequential([L.Bidirectional(L.GRU(5), merge_mode="concat")])
+    # compare: last-step output must equal return_sequences variant's
+    # forward[-1] ++ backward[0-in-input-time] == flipped-back seq at the ends
+    p = bi.predict(x, batch_size=4)
+    assert p.shape == (4, 10)
