@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.models.anomalydetection.anomaly_detector import (  # noqa: F401,E501
+    AnomalyDetector,
+    detect_anomalies,
+)

@@ -1,0 +1,59 @@
+"""LSTM anomaly detector.
+
+Reference: scala `models/anomalydetection/AnomalyDetector.scala`, py
+`pyzoo/zoo/models/anomalydetection/anomaly_detector.py` — stacked LSTM
+regressor predicting the next point of a time series; anomalies are the
+points with the largest prediction error (`detectAnomalies`).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+
+
+class AnomalyDetector(nn.Module, ZooModel):
+    hidden_layers: Sequence[int] = (8, 32, 15)
+    dropouts: Sequence[float] = (0.2, 0.2, 0.2)
+
+    default_loss = "mse"
+    default_metrics = ("mse",)
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        for i, (width, drop) in enumerate(
+                zip(self.hidden_layers, self.dropouts)):
+            last = i == len(self.hidden_layers) - 1
+            cell = nn.OptimizedLSTMCell(width, name=f"lstm_cell_{i}")
+            x = nn.RNN(cell, name=f"lstm_{i}")(x)
+            if not last:
+                x = nn.Dropout(drop)(x, deterministic=not training)
+            else:
+                x = x[:, -1]
+        return nn.Dense(1, name="head")(x)
+
+    @staticmethod
+    def unroll(data: np.ndarray, unroll_length: int):
+        """Sliding windows: series [n, d] -> (windows [m, unroll, d],
+        targets [m]) (reference `unroll`, anomaly_detector.py)."""
+        data = np.asarray(data, np.float32)
+        if data.ndim == 1:
+            data = data[:, None]
+        m = len(data) - unroll_length
+        if m <= 0:
+            raise ValueError("series shorter than unroll_length")
+        idx = np.arange(unroll_length)[None, :] + np.arange(m)[:, None]
+        return data[idx], data[unroll_length:, 0]
+
+
+def detect_anomalies(y_true, y_pred, anomaly_size: int = 5):
+    """Top-`anomaly_size` largest absolute errors are anomalies (reference
+    `detectAnomalies`).  Returns indices of anomalous points."""
+    err = np.abs(np.asarray(y_true).ravel() - np.asarray(y_pred).ravel())
+    k = min(anomaly_size, len(err))
+    return np.argsort(err)[-k:][::-1]

@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel  # noqa: F401

@@ -1,0 +1,95 @@
+"""Common model-zoo base (reference:
+scala `models/common/ZooModel.scala`, py
+`pyzoo/zoo/models/common/zoo_model.py` — save/load + predict surface).
+
+A ZooModel here is a flax module plus convenience train/predict/save/load
+that lowers onto the Orca Estimator, so every zoo model gets the SPMD
+engine (sharded batches, checkpointing) for free."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict
+
+import numpy as np
+
+
+class ZooModel:
+    """Mixin over flax modules.  Subclasses define `default_loss` and
+    `default_metrics`, and may override `prepare_inputs` to map user data
+    to the module's argument tuple."""
+
+    default_loss = "sparse_categorical_crossentropy"
+    default_metrics = ("accuracy",)
+
+    def module(self):
+        """The flax module to train (default: self, for nn.Module
+        subclasses)."""
+        return self
+
+    def estimator(self, *, optimizer="adam", learning_rate=None, loss=None,
+                  metrics=None, model_dir=None, shard_rules=None, **kwargs):
+        from analytics_zoo_tpu.orca.learn.estimator import Estimator
+        est = Estimator.from_flax(
+            self.module(),
+            loss=loss or self.default_loss,
+            optimizer=optimizer,
+            learning_rate=learning_rate,
+            metrics=list(metrics) if metrics is not None
+            else list(self.default_metrics),
+            model_dir=model_dir,
+            shard_rules=shard_rules,
+            **kwargs)
+        self._estimator = est
+        return est
+
+    def _require_estimator(self):
+        est = getattr(self, "_estimator", None)
+        if est is None:
+            est = self.estimator()
+        return est
+
+    def fit(self, data, **kwargs):
+        return self._require_estimator().fit(data, **kwargs)
+
+    def predict(self, data, **kwargs):
+        return self._require_estimator().predict(data, **kwargs)
+
+    def evaluate(self, data, **kwargs):
+        return self._require_estimator().evaluate(data, **kwargs)
+
+    # -- save/load (reference ZooModel.saveModel/loadModel) --
+    def save_model(self, path: str):
+        est = self._require_estimator()
+        os.makedirs(path, exist_ok=True)
+        params = est.get_model()
+        model_state = est.get_model_state()
+        with open(os.path.join(path, "weights.pkl"), "wb") as f:
+            pickle.dump({"params": params, "model_state": model_state}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        with open(os.path.join(path, "config.pkl"), "wb") as f:
+            pickle.dump({"class": type(self).__name__,
+                         "config": self.get_config()}, f)
+        return path
+
+    def get_config(self) -> Dict[str, Any]:
+        """Constructor kwargs; flax dataclass modules get this for free."""
+        import dataclasses
+        if dataclasses.is_dataclass(self):
+            return {f.name: getattr(self, f.name)
+                    for f in dataclasses.fields(self)
+                    if f.name not in ("parent", "name")}
+        return {}
+
+    @classmethod
+    def load_model(cls, path: str):
+        with open(os.path.join(path, "config.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        with open(os.path.join(path, "weights.pkl"), "rb") as f:
+            saved = pickle.load(f)
+        model = cls(**meta["config"])
+        est = model.estimator()
+        est._params = saved["params"]
+        est._model_state = saved.get("model_state") or {}
+        return model

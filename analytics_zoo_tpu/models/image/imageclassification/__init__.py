@@ -1,0 +1,6 @@
+from analytics_zoo_tpu.models.image.imageclassification.resnet import (  # noqa: F401,E501
+    ResNet,
+    ResNet18,
+    ResNet50,
+    ImageClassifier,
+)

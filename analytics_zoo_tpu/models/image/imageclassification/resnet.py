@@ -1,0 +1,129 @@
+"""Image classification models (reference:
+`models/image/imageclassification/` — ImageNet nets loaded through BigDL;
+the Orca torch path fine-tunes ResNet-50 in `apps/dogs-vs-cats/`, BASELINE
+config #3).
+
+TPU-first ResNet: NHWC layout, bf16 compute / f32 BatchNorm statistics,
+strided 3x3 convs that XLA tiles onto the MXU."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not training,
+                       dtype=jnp.float32)
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
+        y = norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype,
+                               name="proj")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not training,
+                       dtype=jnp.float32)
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = nn.Conv(4 * self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv3")(y)
+        y = norm(name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(4 * self.filters, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype,
+                               name="proj")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module, ZooModel):
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    block: str = "basic"            # "basic" | "bottleneck"
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        block_cls = BasicBlock if self.block == "basic" else BottleneckBlock
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not training,
+                         dtype=jnp.float32, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = block_cls(64 * 2 ** i, strides, self.dtype,
+                              name=f"stage{i}_block{j}")(x, training)
+        x = x.mean(axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32))
+
+
+def ResNet18(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block="basic",
+                  num_classes=num_classes, **kw)
+
+
+def ResNet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block="bottleneck",
+                  num_classes=num_classes, **kw)
+
+
+class ImageClassifier(ZooModel):
+    """Reference `ImageClassifier.load_model(path)` facade: wraps a backbone
+    by name."""
+
+    BACKBONES = {"resnet-18": ResNet18, "resnet-50": ResNet50}
+
+    def __init__(self, model_name: str = "resnet-18", num_classes: int = 2):
+        key = model_name.lower()
+        if key not in self.BACKBONES:
+            raise ValueError(f"unknown backbone '{model_name}'; "
+                             f"known: {sorted(self.BACKBONES)}")
+        self._module = self.BACKBONES[key](num_classes=num_classes)
+        self.model_name = model_name
+        self.num_classes = num_classes
+
+    def module(self):
+        return self._module
+
+    def get_config(self):
+        return {"model_name": self.model_name,
+                "num_classes": self.num_classes}

@@ -1,1 +1,8 @@
 from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF  # noqa: F401
+from analytics_zoo_tpu.models.recommendation.wide_and_deep import (  # noqa: F401,E501
+    ColumnFeatureInfo,
+    WideAndDeep,
+)
+from analytics_zoo_tpu.models.recommendation.session_recommender import (  # noqa: F401,E501
+    SessionRecommender,
+)

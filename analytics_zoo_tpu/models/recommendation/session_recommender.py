@@ -1,0 +1,51 @@
+"""Session-based recommender.
+
+Reference: scala `models/recommendation/SessionRecommender.scala`, py
+`pyzoo/zoo/models/recommendation/session_recommender.py` — GRU over the
+session's recent item clicks, optionally fused with an MLP over longer
+purchase history, softmax over the item vocabulary.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+
+
+class SessionRecommender(nn.Module, ZooModel):
+    item_count: int
+    item_embed: int = 100
+    rnn_hidden_layers: Sequence[int] = (40, 20)
+    session_length: int = 10
+    include_history: bool = False
+    mlp_hidden_layers: Sequence[int] = (40, 20)
+    history_length: int = 5
+
+    @nn.compact
+    def __call__(self, session_items, history_items=None,
+                 training: bool = False):
+        # items indexed from 1; 0 = padding
+        ids = jnp.clip(session_items.astype(jnp.int32), 0, self.item_count)
+        x = nn.Embed(self.item_count + 1, self.item_embed,
+                     name="session_embed")(ids)
+        for i, width in enumerate(self.rnn_hidden_layers):
+            x = nn.RNN(nn.GRUCell(width, name=f"gru_cell_{i}"),
+                       name=f"gru_{i}")(x)
+        h = x[:, -1]
+
+        if self.include_history and history_items is not None:
+            hids = jnp.clip(history_items.astype(jnp.int32), 0,
+                            self.item_count)
+            hist = nn.Embed(self.item_count + 1, self.item_embed,
+                            name="history_embed")(hids)
+            hist = hist.reshape(hist.shape[0], -1)
+            for i, width in enumerate(self.mlp_hidden_layers):
+                hist = nn.relu(nn.Dense(width, name=f"mlp_{i}")(hist))
+            h = jnp.concatenate([h, hist], axis=-1)
+
+        # logits over items (index 0 unused, matching 1-based reference)
+        return nn.Dense(self.item_count + 1, name="head")(h)

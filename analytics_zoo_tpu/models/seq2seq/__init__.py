@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.models.seq2seq.seq2seq import Seq2Seq  # noqa: F401

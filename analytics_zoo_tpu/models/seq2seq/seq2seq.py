@@ -1,0 +1,90 @@
+"""Seq2Seq encoder-decoder.
+
+Reference: scala `models/seq2seq/{Seq2seq,RNNEncoder,RNNDecoder,Bridge}.scala`
+— stacked RNN encoder, a Bridge mapping final encoder states into decoder
+initial states, stacked RNN decoder, optional dense generator head.
+
+Teacher-forced training: `__call__(encoder_seq, decoder_seq)` returns decoder
+outputs.  Greedy closed-loop decoding: `infer` (via
+`module.apply(vars, enc, start, steps, method=Seq2Seq.infer)`), with the
+step loop unrolled at trace time so XLA compiles one fused program."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+
+
+class Seq2Seq(nn.Module, ZooModel):
+    hidden_size: int = 64
+    num_layers: int = 1
+    output_dim: Optional[int] = None    # generator head width (None: hidden)
+    bridge: str = "dense"               # "dense" | "passthrough"
+    cell_type: str = "lstm"             # "lstm" | "gru"
+
+    default_loss = "mse"
+    default_metrics = ("mse",)
+
+    def setup(self):
+        mk = (nn.OptimizedLSTMCell if self.cell_type == "lstm"
+              else nn.GRUCell)
+        self.enc_cells = [mk(self.hidden_size) for _ in range(self.num_layers)]
+        self.dec_cells = [mk(self.hidden_size) for _ in range(self.num_layers)]
+        self.enc_rnns = [nn.RNN(c, return_carry=True) for c in self.enc_cells]
+        self.dec_rnns = [nn.RNN(c) for c in self.dec_cells]
+        if self.bridge == "dense":
+            n_leaves = 2 if self.cell_type == "lstm" else 1
+            self.bridge_dense = [
+                [nn.Dense(self.hidden_size) for _ in range(n_leaves)]
+                for _ in range(self.num_layers)]
+        elif self.bridge != "passthrough":
+            raise ValueError(f"unknown bridge '{self.bridge}'")
+        self.generator = (nn.Dense(self.output_dim)
+                          if self.output_dim is not None else None)
+
+    def _encode(self, enc_seq):
+        x = enc_seq
+        carries = []
+        for rnn in self.enc_rnns:
+            carry, x = rnn(x)
+            carries.append(carry)
+        if self.bridge == "dense":
+            mapped = []
+            for i, c in enumerate(carries):
+                leaves, treedef = jax.tree_util.tree_flatten(c)
+                leaves = [self.bridge_dense[i][j](a)
+                          for j, a in enumerate(leaves)]
+                mapped.append(jax.tree_util.tree_unflatten(treedef, leaves))
+            carries = mapped
+        return carries
+
+    def __call__(self, enc_seq, dec_seq, training: bool = False):
+        carries = self._encode(enc_seq)
+        y = dec_seq
+        for i, rnn in enumerate(self.dec_rnns):
+            y = rnn(y, initial_carry=carries[i])
+        if self.generator is not None:
+            y = self.generator(y)
+        return y
+
+    def infer(self, enc_seq, dec_start, n_steps: int,
+              training: bool = False):
+        """Greedy closed-loop decoding: each predicted step feeds back as
+        the next decoder input (requires output_dim == input feature dim).
+        `dec_start`: first decoder input [batch, features]."""
+        carries = self._encode(enc_seq)
+        step_in = dec_start
+        outs = []
+        for _ in range(n_steps):
+            h = step_in
+            for i, cell in enumerate(self.dec_cells):
+                carries[i], h = cell(carries[i], h)
+            y = self.generator(h) if self.generator is not None else h
+            outs.append(y)
+            step_in = y
+        return jnp.stack(outs, axis=1)

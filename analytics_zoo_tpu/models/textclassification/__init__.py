@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.models.textclassification.text_classifier import (  # noqa: F401,E501
+    TextClassifier,
+)

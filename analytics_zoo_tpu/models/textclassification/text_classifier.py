@@ -1,0 +1,42 @@
+"""Text classifier.
+
+Reference: scala `models/textclassification/TextClassifier.scala`, py
+`pyzoo/zoo/models/textclassification/text_classifier.py` — token embedding
+(optionally pre-trained GloVe) + CNN / LSTM / GRU encoder + softmax head.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+
+
+class TextClassifier(nn.Module, ZooModel):
+    class_num: int
+    vocab_size: int = 20000
+    embed_dim: int = 200
+    sequence_length: int = 500
+    encoder: str = "cnn"            # "cnn" | "lstm" | "gru"
+    encoder_output_dim: int = 256
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, token_ids, training: bool = False):
+        ids = jnp.clip(token_ids.astype(jnp.int32), 0, self.vocab_size - 1)
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="embed")(ids)
+        enc = self.encoder.lower()
+        if enc == "cnn":
+            h = nn.Conv(self.encoder_output_dim, (5,), name="conv")(x)
+            h = nn.relu(h)
+            h = h.max(axis=1)  # global max pool over time
+        elif enc in ("lstm", "gru"):
+            cell = (nn.OptimizedLSTMCell if enc == "lstm" else nn.GRUCell)(
+                self.encoder_output_dim, name="cell")
+            h = nn.RNN(cell, name="rnn")(x)[:, -1]
+        else:
+            raise ValueError(f"unknown encoder '{self.encoder}'")
+        h = nn.Dropout(self.dropout)(h, deterministic=not training)
+        h = nn.relu(nn.Dense(128, name="fc")(h))
+        return nn.Dense(self.class_num, name="head")(h)

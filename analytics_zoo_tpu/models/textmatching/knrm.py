@@ -1,0 +1,66 @@
+"""KNRM — Kernel-pooling Neural Ranking Model.
+
+Reference: scala `models/textmatching/KNRM.scala`, py
+`pyzoo/zoo/models/textmatching/knrm.py` — query/doc token embeddings →
+cosine translation matrix → RBF kernel pooling → linear ranking score.
+The whole model is three einsums + exp, which XLA fuses into a couple of
+MXU/VPU kernels."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+
+
+class KNRM(nn.Module, ZooModel):
+    text1_length: int = 10          # query length
+    text2_length: int = 40          # doc length
+    vocab_size: int = 20000
+    embed_dim: int = 300
+    kernel_num: int = 21
+    sigma: float = 0.1
+    exact_sigma: float = 0.001
+    target_mode: str = "ranking"    # "ranking" | "classification"
+
+    @property
+    def default_metrics(self):
+        from analytics_zoo_tpu.orca.learn.metrics import BinaryAccuracy
+        return (BinaryAccuracy(
+            from_logits=self.target_mode != "classification"),)
+
+    @property
+    def default_loss(self):
+        # classification outputs sigmoid probabilities, so the loss must
+        # not re-apply the sigmoid; ranking outputs raw scores (logits)
+        if self.target_mode == "classification":
+            from analytics_zoo_tpu.orca.learn.losses import (
+                binary_crossentropy)
+            return lambda p, l: binary_crossentropy(p, l, from_logits=False)
+        return "binary_crossentropy"
+
+    @nn.compact
+    def __call__(self, query_ids, doc_ids, training: bool = False):
+        q = jnp.clip(query_ids.astype(jnp.int32), 0, self.vocab_size - 1)
+        d = jnp.clip(doc_ids.astype(jnp.int32), 0, self.vocab_size - 1)
+        embed = nn.Embed(self.vocab_size, self.embed_dim, name="embed")
+        qe, de = embed(q), embed(d)
+        qe = qe / (jnp.linalg.norm(qe, axis=-1, keepdims=True) + 1e-8)
+        de = de / (jnp.linalg.norm(de, axis=-1, keepdims=True) + 1e-8)
+        # translation matrix [b, q_len, d_len]
+        sim = jnp.einsum("bqe,bde->bqd", qe, de)
+
+        # kernel centers mu in [-1, 1], last kernel is the exact-match one
+        mus = np.linspace(-1.0, 1.0, self.kernel_num - 1).tolist() + [1.0]
+        sigmas = [self.sigma] * (self.kernel_num - 1) + [self.exact_sigma]
+        mus = jnp.asarray(mus)[None, None, None, :]
+        sigmas = jnp.asarray(sigmas)[None, None, None, :]
+        k = jnp.exp(-((sim[..., None] - mus) ** 2) / (2 * sigmas ** 2))
+        # soft-TF: sum over doc, log, sum over query  [b, kernel_num]
+        phi = jnp.log1p(k.sum(axis=2)).sum(axis=1)
+        score = nn.Dense(1, name="head")(phi)
+        if self.target_mode == "classification":
+            return nn.sigmoid(score)  # probabilities (reference parity)
+        return score  # raw ranking score / logits

@@ -236,6 +236,13 @@ class Estimator:
         self._require_engine()
         return self._engine.get_params()
 
+    def get_model_state(self):
+        """Mutable model collections (e.g. BatchNorm batch_stats) as host
+        numpy."""
+        self._require_engine()
+        import jax
+        return jax.device_get(self._engine.state.model_state)
+
     def _require_engine(self):
         if self._engine is None:
             raise RuntimeError(

@@ -1,0 +1,191 @@
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context(cluster_mode="local")
+    yield
+
+
+def test_wide_and_deep_trains():
+    from analytics_zoo_tpu.models.recommendation import (
+        ColumnFeatureInfo, WideAndDeep)
+    ci = ColumnFeatureInfo(
+        wide_base_cols=["gender"], wide_base_dims=[3],
+        wide_cross_cols=["age_gender"], wide_cross_dims=[50],
+        indicator_cols=["occupation"], indicator_dims=[5],
+        embed_cols=["user", "item"], embed_in_dims=[100, 80],
+        embed_out_dims=[16, 16],
+        continuous_cols=["age"])
+    model = WideAndDeep(column_info=ci, class_num=2,
+                        compute_dtype=np.float32)
+    rng = np.random.default_rng(0)
+    n = 200
+    feats = np.column_stack([
+        rng.integers(0, 3, n), rng.integers(0, 50, n),
+        rng.integers(0, 5, n), rng.integers(0, 100, n),
+        rng.integers(0, 80, n), rng.normal(size=n)]).astype(np.float32)
+    y = (feats[:, 0].astype(int) % 2).astype(np.int32)
+    est = model.estimator(learning_rate=2e-2)
+    est.fit({"x": feats, "y": y}, epochs=8, batch_size=32)
+    stats = est.evaluate({"x": feats, "y": y})
+    assert stats["accuracy"] > 0.8, stats
+
+
+def test_wide_only_and_deep_only_forward():
+    import jax
+    from analytics_zoo_tpu.models.recommendation import (
+        ColumnFeatureInfo, WideAndDeep)
+    ci = ColumnFeatureInfo(wide_base_cols=["a"], wide_base_dims=[4],
+                           embed_cols=["b"], embed_in_dims=[10],
+                           embed_out_dims=[4], continuous_cols=["c"])
+    x = np.array([[1, 2, 0.5], [3, 4, -1.0]], np.float32)
+    for mt in ("wide", "deep"):
+        m = WideAndDeep(column_info=ci, model_type=mt,
+                        compute_dtype=np.float32)
+        params = m.init(jax.random.PRNGKey(0), x)
+        out = m.apply(params, x)
+        assert out.shape == (2, 2)
+
+
+def test_session_recommender():
+    from analytics_zoo_tpu.models.recommendation import SessionRecommender
+    model = SessionRecommender(item_count=50, item_embed=16,
+                               rnn_hidden_layers=(16,), session_length=6)
+    rng = np.random.default_rng(0)
+    sess = rng.integers(1, 51, size=(120, 6))
+    y = sess[:, -1].astype(np.int32)  # predict last shown item
+    est = model.estimator(learning_rate=1e-2)
+    est.fit({"x": sess, "y": y}, epochs=3, batch_size=32)
+    preds = est.predict({"x": sess}, batch_size=32)
+    assert preds.shape == (120, 51)
+
+
+def test_text_classifier_cnn_and_gru():
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 100, size=(96, 20))
+    y = (toks[:, 0] % 2).astype(np.int32)
+    for enc in ("cnn", "gru"):
+        model = TextClassifier(class_num=2, vocab_size=100, embed_dim=16,
+                               sequence_length=20, encoder=enc,
+                               encoder_output_dim=32)
+        est = model.estimator(learning_rate=1e-2)
+        est.fit({"x": toks, "y": y}, epochs=8, batch_size=32)
+        stats = est.evaluate({"x": toks, "y": y})
+        assert stats["accuracy"] > 0.7, (enc, stats)
+
+
+def test_knrm_forward_and_rank():
+    from analytics_zoo_tpu.models.textmatching import KNRM
+    rng = np.random.default_rng(0)
+    q = rng.integers(0, 50, size=(32, 5))
+    d = rng.integers(0, 50, size=(32, 12))
+    y = rng.integers(0, 2, 32).astype(np.float32)
+    model = KNRM(text1_length=5, text2_length=12, vocab_size=50,
+                 embed_dim=16)
+    est = model.estimator(learning_rate=1e-2)
+    est.fit({"x": [q, d], "y": y}, epochs=2, batch_size=16)
+    scores = est.predict({"x": [q, d]})
+    assert scores.shape == (32, 1)
+
+
+def test_seq2seq_teacher_forcing():
+    from analytics_zoo_tpu.models.seq2seq import Seq2Seq
+    rng = np.random.default_rng(0)
+    enc = rng.normal(size=(64, 8, 4)).astype(np.float32)
+    dec_in = rng.normal(size=(64, 6, 4)).astype(np.float32)
+    target = np.cumsum(dec_in, axis=1).astype(np.float32)
+    model = Seq2Seq(hidden_size=16, num_layers=2, output_dim=4)
+    est = model.estimator(learning_rate=1e-2)
+    est.fit({"x": [enc, dec_in], "y": target}, epochs=2, batch_size=16)
+    out = est.predict({"x": [enc, dec_in]})
+    assert out.shape == (64, 6, 4)
+
+
+def test_anomaly_detector_end_to_end():
+    from analytics_zoo_tpu.models.anomalydetection import (
+        AnomalyDetector, detect_anomalies)
+    t = np.arange(300, dtype=np.float32)
+    series = np.sin(t / 10)
+    series[250] = 5.0  # planted anomaly
+    x, y = AnomalyDetector.unroll(series, 20)
+    model = AnomalyDetector(hidden_layers=(8, 8), dropouts=(0.0, 0.0))
+    est = model.estimator(learning_rate=1e-2)
+    est.fit({"x": x, "y": y}, epochs=5, batch_size=32)
+    preds = est.predict({"x": x})
+    idx = detect_anomalies(y, preds, anomaly_size=3)
+    assert (250 - 20) in idx, idx
+
+
+def test_resnet18_forward_and_train_step():
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        ImageClassifier)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 2, 16).astype(np.int32)
+    clf = ImageClassifier("resnet-18", num_classes=2)
+    est = clf.estimator(learning_rate=1e-3)
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=8)
+    preds = est.predict({"x": x}, batch_size=8)
+    assert preds.shape == (16, 2)
+
+
+def test_zoo_model_save_load(tmp_path):
+    import jax
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 50, size=(32, 10))
+    y = (toks[:, 0] % 2).astype(np.int32)
+    model = TextClassifier(class_num=2, vocab_size=50, embed_dim=8,
+                           sequence_length=10, encoder="cnn",
+                           encoder_output_dim=16)
+    est = model.estimator(learning_rate=1e-2)
+    est.fit({"x": toks, "y": y}, epochs=1, batch_size=16)
+    p1 = est.predict({"x": toks})
+    model.save_model(str(tmp_path / "m"))
+    loaded = TextClassifier.load_model(str(tmp_path / "m"))
+    p2 = loaded.predict({"x": toks})
+    np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+
+def test_wide_and_deep_bad_model_type():
+    import jax
+    from analytics_zoo_tpu.models.recommendation import (
+        ColumnFeatureInfo, WideAndDeep)
+    ci = ColumnFeatureInfo(wide_base_cols=["a"], wide_base_dims=[4])
+    m = WideAndDeep(column_info=ci, model_type="wide_deep")
+    with pytest.raises(ValueError, match="unsupported model_type"):
+        m.init(jax.random.PRNGKey(0), np.zeros((2, 1), np.float32))
+
+
+def test_resnet_save_load_with_batchstats(tmp_path):
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        ImageClassifier)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16, 16, 3)).astype(np.float32)
+    y = rng.integers(0, 2, 8).astype(np.int32)
+    clf = ImageClassifier("resnet-18", num_classes=2)
+    est = clf.estimator(learning_rate=1e-3)
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=8)
+    p1 = est.predict({"x": x}, batch_size=8)
+    clf.save_model(str(tmp_path / "rn"))
+    loaded = ImageClassifier.load_model(str(tmp_path / "rn"))
+    p2 = loaded.predict({"x": x}, batch_size=8)
+    np.testing.assert_allclose(p1, p2, atol=1e-4)
+
+
+def test_seq2seq_infer_closed_loop():
+    import jax
+    from analytics_zoo_tpu.models.seq2seq import Seq2Seq
+    rng = np.random.default_rng(0)
+    enc = rng.normal(size=(4, 8, 3)).astype(np.float32)
+    dec_in = rng.normal(size=(4, 5, 3)).astype(np.float32)
+    model = Seq2Seq(hidden_size=8, num_layers=1, output_dim=3)
+    variables = model.init(jax.random.PRNGKey(0), enc, dec_in)
+    out = model.apply(variables, enc, dec_in[:, 0], 5,
+                      method=Seq2Seq.infer)
+    assert out.shape == (4, 5, 3)
