@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.chronos.autots.autotsestimator import (  # noqa: F401
+    AutoTSEstimator,
+)
+from analytics_zoo_tpu.chronos.autots.tspipeline import TSPipeline  # noqa: F401,E501

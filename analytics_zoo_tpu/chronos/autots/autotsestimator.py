@@ -1,0 +1,126 @@
+"""AutoTSEstimator (reference:
+/root/reference/pyzoo/zoo/chronos/autots/autotsestimator.py:26,166 — builds
+per-model search spaces (autots/model/auto_{tcn,lstm,seq2seq}.py), runs the
+AutoML search engine over them, returns a TSPipeline)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Union
+
+from analytics_zoo_tpu.chronos.autots.tspipeline import TSPipeline
+from analytics_zoo_tpu.chronos.data.tsdataset import TSDataset
+from analytics_zoo_tpu.orca.automl import hp
+from analytics_zoo_tpu.orca.automl.search_engine import SearchEngine
+
+
+def _default_space(model: str) -> Dict:
+    if model == "lstm":
+        return {"hidden_dim": hp.choice([16, 32, 64]),
+                "layer_num": hp.choice([1, 2]),
+                "lr": hp.loguniform(1e-3, 1e-2),
+                "dropout": hp.uniform(0.0, 0.2)}
+    if model == "tcn":
+        return {"hidden_units": hp.choice([16, 30, 48]),
+                "levels": hp.choice([2, 3]),
+                "kernel_size": hp.choice([2, 3]),
+                "lr": hp.loguniform(1e-3, 1e-2),
+                "dropout": hp.uniform(0.0, 0.2)}
+    if model == "seq2seq":
+        return {"lstm_hidden_dim": hp.choice([16, 32, 64]),
+                "lstm_layer_num": hp.choice([1, 2]),
+                "lr": hp.loguniform(1e-3, 1e-2)}
+    raise ValueError(f"unknown model '{model}'; known: lstm, tcn, seq2seq")
+
+
+class AutoTSEstimator:
+    def __init__(self, model: str = "lstm",
+                 search_space: Optional[Dict] = None,
+                 past_seq_len: Union[int, None] = 24,
+                 future_seq_len: int = 1,
+                 input_feature_num: Optional[int] = None,
+                 output_target_num: Optional[int] = None,
+                 metric: str = "mse", metric_mode: str = "min"):
+        self.model = model.lower()
+        self.search_space = search_space or _default_space(self.model)
+        self.past_seq_len = past_seq_len
+        self.future_seq_len = future_seq_len
+        self.input_feature_num = input_feature_num
+        self.output_target_num = output_target_num
+        self.metric = metric
+        self.metric_mode = metric_mode
+        self._best = None
+
+    def _make_forecaster(self, config: Dict):
+        lr = float(config.get("lr", 1e-3))
+        common = dict(past_seq_len=self.past_seq_len,
+                      future_seq_len=self.future_seq_len,
+                      input_feature_num=self.input_feature_num,
+                      output_feature_num=self.output_target_num,
+                      lr=lr)
+        if self.model == "lstm":
+            from analytics_zoo_tpu.chronos.forecaster import LSTMForecaster
+            return LSTMForecaster(
+                hidden_dim=int(config.get("hidden_dim", 32)),
+                layer_num=int(config.get("layer_num", 1)),
+                dropout=float(config.get("dropout", 0.1)), **common)
+        if self.model == "tcn":
+            from analytics_zoo_tpu.chronos.forecaster import TCNForecaster
+            levels = int(config.get("levels", 2))
+            width = int(config.get("hidden_units", 30))
+            return TCNForecaster(
+                num_channels=[width] * levels,
+                kernel_size=int(config.get("kernel_size", 3)),
+                dropout=float(config.get("dropout", 0.1)), **common)
+        if self.model == "seq2seq":
+            from analytics_zoo_tpu.chronos.forecaster import (
+                Seq2SeqForecaster)
+            return Seq2SeqForecaster(
+                lstm_hidden_dim=int(config.get("lstm_hidden_dim", 32)),
+                lstm_layer_num=int(config.get("lstm_layer_num", 1)),
+                **common)
+        raise ValueError(f"unknown model '{self.model}'")
+
+    def fit(self, data, validation_data=None, epochs: int = 5,
+            batch_size: int = 32, n_sampling: int = 4,
+            grace_epochs: int = 1) -> TSPipeline:
+        scaler = None
+        if isinstance(data, TSDataset):
+            scaler = data.scaler
+            if self.input_feature_num is None:
+                self.input_feature_num = data.input_feature_num
+            if self.output_target_num is None:
+                self.output_target_num = data.output_target_num
+            data.roll(self.past_seq_len, self.future_seq_len)
+            x, y = data.to_numpy()
+        else:
+            x, y = data
+        if validation_data is not None:
+            if isinstance(validation_data, TSDataset):
+                validation_data.roll(self.past_seq_len, self.future_seq_len)
+                vx, vy = validation_data.to_numpy()
+            else:
+                vx, vy = validation_data
+        else:
+            vx, vy = x, y
+
+        def trainable(config, state, add_epochs):
+            fc = state or self._make_forecaster(config)
+            bs = int(config.get("batch_size", batch_size))
+            fc.fit((x, y), epochs=add_epochs, batch_size=bs)
+            stats = fc.evaluate((vx, vy), batch_size=bs)
+            return fc, stats[self.metric]
+
+        engine = SearchEngine(trainable, self.search_space,
+                              metric_mode=self.metric_mode,
+                              n_sampling=n_sampling, epochs=epochs,
+                              grace_epochs=grace_epochs)
+        self._best = engine.run()
+        self._trials = engine.trial_table()
+        return TSPipeline(forecaster=self._best.state,
+                          best_config=dict(self._best.config),
+                          scaler=scaler)
+
+    def get_best_config(self) -> Dict:
+        if self._best is None:
+            raise RuntimeError("call fit first")
+        return dict(self._best.config)

@@ -1,0 +1,90 @@
+"""TSPipeline (reference:
+/root/reference/pyzoo/zoo/chronos/autots/tspipeline.py — the fitted
+best-model pipeline: predict/evaluate/fit-more/save/load)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.chronos.data.tsdataset import TSDataset
+
+
+class TSPipeline:
+    def __init__(self, forecaster, best_config: Dict, scaler=None):
+        self.forecaster = forecaster
+        self.best_config = best_config
+        self.scaler = scaler
+
+    def _xy(self, data):
+        if isinstance(data, TSDataset):
+            if data.numpy_x is None:
+                data.roll(self.forecaster.past_seq_len,
+                          self.forecaster.future_seq_len)
+            return data.to_numpy()
+        return data
+
+    def _unscale(self, arr: np.ndarray) -> np.ndarray:
+        """Map model-space values back to original units (reference
+        TSPipeline._tsdataset_unscale)."""
+        if self.scaler is None:
+            return arr
+        n_t = self.forecaster.output_feature_num
+        mean = getattr(self.scaler, "mean_", None)
+        scale = getattr(self.scaler, "scale_", None)
+        if mean is not None:          # StandardScaler
+            return arr * scale[:n_t] + mean[:n_t]
+        mins = getattr(self.scaler, "min_", None)
+        if mins is not None:          # MinMaxScaler
+            return (arr - mins[:n_t]) / scale[:n_t]
+        return arr
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 32):
+        x, y = self._xy(data)
+        self.forecaster.fit((x, y), epochs=epochs, batch_size=batch_size)
+        return self
+
+    def predict(self, data, batch_size: int = 32):
+        """Predictions in ORIGINAL units when the training TSDataset was
+        scaled."""
+        x, _ = self._xy(data)
+        preds = self.forecaster.predict((x, None), batch_size=batch_size)
+        return self._unscale(preds)
+
+    def evaluate(self, data, batch_size: int = 32):
+        """Metrics in original units (predictions and targets unscaled
+        before comparison)."""
+        x, y = self._xy(data)
+        if self.scaler is None:
+            return self.forecaster.evaluate((x, y), batch_size=batch_size)
+        from analytics_zoo_tpu.chronos.forecaster.base import _shape_y
+        preds = self._unscale(
+            self.forecaster.predict((x, None), batch_size=batch_size))
+        y = self._unscale(_shape_y(
+            y, self.forecaster.future_seq_len,
+            self.forecaster.output_feature_num))
+        diff = preds - y
+        return {"mse": float((diff ** 2).mean()),
+                "mae": float(np.abs(diff).mean())}
+
+    def save(self, path: str):
+        os.makedirs(path, exist_ok=True)
+        self.forecaster.save(os.path.join(path, "forecaster.pkl"))
+        with open(os.path.join(path, "pipeline.pkl"), "wb") as f:
+            pickle.dump({"best_config": self.best_config,
+                         "scaler": self.scaler,
+                         "forecaster_class":
+                             type(self.forecaster).__name__}, f)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "TSPipeline":
+        with open(os.path.join(path, "pipeline.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        from analytics_zoo_tpu.chronos import forecaster as fmod
+        cls = getattr(fmod, meta["forecaster_class"])
+        fc = cls.load(os.path.join(path, "forecaster.pkl"))
+        return TSPipeline(fc, meta["best_config"], meta["scaler"])

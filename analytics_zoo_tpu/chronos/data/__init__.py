@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.chronos.data.tsdataset import TSDataset  # noqa: F401

@@ -1,0 +1,252 @@
+"""TSDataset — time-series data container.
+
+Reference: /root/reference/pyzoo/zoo/chronos/data/tsdataset.py:45
+(`from_pandas :80`, `impute`, `deduplicate`, `resample`, `gen_dt_feature`,
+`scale/unscale :467`, `roll :707`, `to_numpy`) plus `data/utils/*`
+(roll/impute/resample/split).  Pure pandas/numpy — identical semantics on
+TPU hosts; the output of `.roll().to_numpy()` feeds the SPMD engine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+_DT_FEATURES = {
+    "MINUTE": lambda s: s.dt.minute,
+    "HOUR": lambda s: s.dt.hour,
+    "DAY": lambda s: s.dt.day,
+    "DAYOFYEAR": lambda s: s.dt.dayofyear,
+    "WEEKDAY": lambda s: s.dt.weekday,
+    "WEEKOFYEAR": lambda s: s.dt.isocalendar().week.astype(np.int64),
+    "MONTH": lambda s: s.dt.month,
+    "YEAR": lambda s: s.dt.year,
+    "IS_AWAKE": lambda s: ((s.dt.hour >= 6) & (s.dt.hour <= 23)
+                           ).astype(np.int64),
+    "IS_BUSY_HOURS": lambda s: s.dt.hour.isin([7, 8, 9, 17, 18, 19]
+                                              ).astype(np.int64),
+    "IS_WEEKEND": lambda s: (s.dt.weekday >= 5).astype(np.int64),
+}
+
+
+def _as_list(x) -> List[str]:
+    if x is None:
+        return []
+    return [x] if isinstance(x, str) else list(x)
+
+
+class TSDataset:
+    def __init__(self, df: pd.DataFrame, dt_col: str,
+                 target_col: List[str], id_col: Optional[str],
+                 feature_col: List[str]):
+        self.df = df
+        self.dt_col = dt_col
+        self.target_col = list(target_col)
+        self.id_col = id_col
+        self.feature_col = list(feature_col)
+        self.scaler = None
+        self.numpy_x = None
+        self.numpy_y = None
+        self.lookback = None
+        self.horizon = None
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def from_pandas(df: pd.DataFrame, dt_col: str,
+                    target_col: Union[str, Sequence[str]],
+                    id_col: Optional[str] = None,
+                    extra_feature_col: Union[str, Sequence[str], None] = None,
+                    with_split: bool = False, val_ratio: float = 0,
+                    test_ratio: float = 0.1):
+        """Build a TSDataset (or (train, val, test) split chronologically,
+        reference tsdataset.py:80)."""
+        target_col = _as_list(target_col)
+        feature_col = _as_list(extra_feature_col)
+        df = df.copy()
+        df[dt_col] = pd.to_datetime(df[dt_col])
+        df = df.sort_values(
+            [id_col, dt_col] if id_col else [dt_col]).reset_index(drop=True)
+
+        if not with_split:
+            return TSDataset(df, dt_col, target_col, id_col, feature_col)
+
+        def split_one(g):
+            n = len(g)
+            n_test = int(n * test_ratio)
+            n_val = int(n * val_ratio)
+            n_train = n - n_val - n_test
+            return (g.iloc[:n_train], g.iloc[n_train:n_train + n_val],
+                    g.iloc[n_train + n_val:])
+
+        if id_col:
+            parts = ([], [], [])
+            for _, g in df.groupby(id_col, sort=False):
+                for i, piece in enumerate(split_one(g)):
+                    parts[i].append(piece)
+            frames = [pd.concat(p).reset_index(drop=True) for p in parts]
+        else:
+            frames = [p.reset_index(drop=True) for p in split_one(df)]
+        return tuple(TSDataset(f, dt_col, target_col, id_col, feature_col)
+                     for f in frames)
+
+    def _groups(self):
+        if self.id_col:
+            return [g for _, g in self.df.groupby(self.id_col, sort=False)]
+        return [self.df]
+
+    def _apply_per_group(self, fn):
+        groups = [fn(g.copy()) for g in self._groups()]
+        self.df = pd.concat(groups).reset_index(drop=True)
+        return self
+
+    # ------------------------------------------------------------------
+    # cleaning / preprocessing (reference data/utils/{impute,resample}.py)
+    # ------------------------------------------------------------------
+
+    def impute(self, mode: str = "last", const_num: float = 0.0):
+        cols = self.target_col + self.feature_col
+
+        def _one(g):
+            if mode == "last":
+                g[cols] = g[cols].ffill().bfill()
+            elif mode == "const":
+                g[cols] = g[cols].fillna(const_num)
+            elif mode == "linear":
+                g[cols] = g[cols].interpolate(
+                    method="linear", limit_direction="both")
+            else:
+                raise ValueError(f"unknown impute mode '{mode}'")
+            return g
+        return self._apply_per_group(_one)
+
+    def deduplicate(self):
+        keys = [self.id_col, self.dt_col] if self.id_col else [self.dt_col]
+        self.df = self.df.drop_duplicates(
+            subset=keys, keep="last").reset_index(drop=True)
+        return self
+
+    def resample(self, interval: str, merge_mode: str = "mean"):
+        cols = self.target_col + self.feature_col
+
+        def _one(g):
+            ident = g[self.id_col].iloc[0] if self.id_col else None
+            g = g.set_index(self.dt_col)
+            agg = getattr(g[cols].resample(interval), merge_mode)()
+            agg = agg.reset_index()
+            if self.id_col:
+                agg[self.id_col] = ident
+            return agg
+        return self._apply_per_group(_one)
+
+    def gen_dt_feature(self, features: Optional[Sequence[str]] = None):
+        """Append datetime-derived feature columns (reference tsfresh-based
+        gen_dt_feature)."""
+        features = list(features) if features else [
+            "HOUR", "DAY", "WEEKDAY", "MONTH", "IS_WEEKEND"]
+        for f in features:
+            if f not in _DT_FEATURES:
+                raise ValueError(f"unknown dt feature '{f}'; "
+                                 f"known: {sorted(_DT_FEATURES)}")
+            self.df[f] = _DT_FEATURES[f](self.df[self.dt_col])
+            if f not in self.feature_col:
+                self.feature_col.append(f)
+        return self
+
+    # ------------------------------------------------------------------
+    # scaling (reference tsdataset.py:467)
+    # ------------------------------------------------------------------
+
+    def scale(self, scaler=None, fit: bool = True):
+        if scaler is None:
+            from sklearn.preprocessing import StandardScaler
+            scaler = StandardScaler()
+        cols = self.target_col + self.feature_col
+        if fit:
+            scaler.fit(self.df[cols])
+        self.df[cols] = scaler.transform(self.df[cols])
+        self.scaler = scaler
+        return self
+
+    def unscale(self):
+        if self.scaler is None:
+            raise RuntimeError("scale() was never called")
+        cols = self.target_col + self.feature_col
+        self.df[cols] = self.scaler.inverse_transform(self.df[cols])
+        return self
+
+    def unscale_numpy(self, data: np.ndarray) -> np.ndarray:
+        """Unscale model output [batch, horizon, n_targets] (reference
+        tsdataset.unscale_numpy)."""
+        if self.scaler is None:
+            raise RuntimeError("scale() was never called")
+        n_t = len(self.target_col)
+        scale = getattr(self.scaler, "scale_", None)
+        if scale is None:
+            raise ValueError("scaler has no scale_ attribute")
+        mean = getattr(self.scaler, "mean_", None)
+        if mean is None:  # MinMaxScaler
+            mins = self.scaler.min_[:n_t]
+            return (data - mins) / self.scaler.scale_[:n_t]
+        return data * scale[:n_t] + mean[:n_t]
+
+    # ------------------------------------------------------------------
+    # windowing (reference tsdataset.py:707 roll + utils/roll.py)
+    # ------------------------------------------------------------------
+
+    def roll(self, lookback: int, horizon: Union[int, Sequence[int]],
+             feature_col: Optional[Sequence[str]] = None,
+             target_col: Optional[Sequence[str]] = None):
+        feature_col = (list(feature_col) if feature_col is not None
+                       else self.feature_col)
+        target_col = (list(target_col) if target_col is not None
+                      else self.target_col)
+        horizons = ([horizon] if isinstance(horizon, int)
+                    else list(horizon))
+        max_h = max(horizons) if horizons != [0] else 0
+        xs, ys = [], []
+        in_cols = target_col + feature_col
+        for g in self._groups():
+            arr_x = g[in_cols].to_numpy(np.float32)
+            arr_y = g[target_col].to_numpy(np.float32)
+            n = len(g) - lookback - max_h + 1
+            if n <= 0:
+                continue
+            idx = np.arange(lookback)[None, :] + np.arange(n)[:, None]
+            xs.append(arr_x[idx])
+            if max_h:
+                if isinstance(horizon, int):
+                    h_idx = (np.arange(horizon)[None, :] + lookback
+                             + np.arange(n)[:, None])
+                else:
+                    h_idx = (np.asarray(horizons)[None, :] - 1 + lookback
+                             + np.arange(n)[:, None])
+                ys.append(arr_y[h_idx])
+        if not xs:
+            raise ValueError("series shorter than lookback + horizon")
+        self.numpy_x = np.concatenate(xs)
+        self.numpy_y = np.concatenate(ys) if ys else None
+        self.lookback = lookback
+        self.horizon = horizon
+        return self
+
+    def to_numpy(self) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        if self.numpy_x is None:
+            raise RuntimeError("call roll(lookback, horizon) first")
+        return self.numpy_x, self.numpy_y
+
+    def to_pandas(self) -> pd.DataFrame:
+        return self.df.copy()
+
+    # convenience accessors used by forecasters
+    @property
+    def input_feature_num(self):
+        return len(self.target_col) + len(self.feature_col)
+
+    @property
+    def output_target_num(self):
+        return len(self.target_col)

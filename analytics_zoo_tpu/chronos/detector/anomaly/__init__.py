@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.chronos.detector.anomaly.detectors import (  # noqa: F401,E501
+    AEDetector,
+    DBScanDetector,
+    ThresholdDetector,
+)

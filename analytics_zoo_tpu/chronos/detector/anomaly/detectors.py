@@ -1,0 +1,144 @@
+"""Anomaly detectors (reference:
+/root/reference/pyzoo/zoo/chronos/detector/anomaly/{ae_detector,
+dbscan_detector,th_detector}.py).
+
+API parity: `fit(y)` then `score()` / `anomaly_indexes()`."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class ThresholdDetector:
+    """Threshold on |y - y_hat| or on absolute bounds (reference
+    th_detector.py ThresholdDetector)."""
+
+    def __init__(self):
+        self.th = (-np.inf, np.inf)
+        self.ratio = 0.01
+        self._scores = None
+
+    def set_params(self, mode: str = "default", ratio: float = 0.01,
+                   threshold=(-np.inf, np.inf)):
+        self.ratio = ratio
+        self.th = threshold
+        return self
+
+    def fit(self, y: np.ndarray, y_pred: Optional[np.ndarray] = None):
+        y = np.asarray(y, np.float32).ravel()
+        if y_pred is not None:
+            err = np.abs(y - np.asarray(y_pred, np.float32).ravel())
+            if np.isscalar(self.th) or isinstance(self.th, float):
+                cut = float(self.th)
+            else:
+                cut = np.quantile(err, 1 - self.ratio)
+            self._scores = (err > cut).astype(np.float32) * err
+        else:
+            if np.isscalar(self.th):
+                lo, hi = -np.inf, float(self.th)
+            else:
+                lo, hi = self.th
+            out = (y < lo) | (y > hi)
+            self._scores = out.astype(np.float32) * np.abs(y)
+        return self
+
+    def score(self) -> np.ndarray:
+        if self._scores is None:
+            raise RuntimeError("call fit first")
+        return self._scores
+
+    def anomaly_indexes(self) -> np.ndarray:
+        return np.nonzero(self.score() > 0)[0]
+
+
+class AEDetector:
+    """Autoencoder reconstruction-error detector (reference
+    ae_detector.py): dense AE over rolled windows, anomalies = largest
+    reconstruction errors.  The AE trains on the SPMD engine."""
+
+    def __init__(self, roll_len: int = 24, ratio: float = 0.1,
+                 compress_rate: float = 0.8, batch_size: int = 100,
+                 epochs: int = 20, lr: float = 1e-3):
+        self.roll_len = roll_len
+        self.ratio = ratio
+        self.compress_rate = compress_rate
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.lr = lr
+        self._scores = None
+
+    def fit(self, y: np.ndarray):
+        import flax.linen as nn
+
+        from analytics_zoo_tpu.orca.learn.estimator import Estimator
+
+        y = np.asarray(y, np.float32)
+        flat = y.ravel()
+        n = len(flat) - self.roll_len + 1
+        if self.roll_len > 1:
+            if n <= 0:
+                raise ValueError("series shorter than roll_len")
+            idx = np.arange(self.roll_len)[None, :] + np.arange(n)[:, None]
+            windows = flat[idx]
+        else:
+            windows = flat[:, None]
+        mu, sd = windows.mean(), windows.std() + 1e-8
+        win_n = (windows - mu) / sd
+
+        hidden = max(2, int(windows.shape[1] * self.compress_rate))
+
+        class _AE(nn.Module):
+            @nn.compact
+            def __call__(self, x, training: bool = False):
+                h = nn.tanh(nn.Dense(hidden, name="enc")(x))
+                return nn.Dense(x.shape[-1], name="dec")(h)
+
+        est = Estimator.from_flax(_AE(), loss="mse", optimizer="adam",
+                                  learning_rate=self.lr)
+        est.fit({"x": win_n, "y": win_n}, epochs=self.epochs,
+                batch_size=self.batch_size)
+        recon = est.predict({"x": win_n}, batch_size=self.batch_size)
+        err_win = ((recon - win_n) ** 2).mean(axis=1)
+        # distribute window error back onto points (last point of window)
+        scores = np.zeros(len(flat), np.float32)
+        scores[self.roll_len - 1:] = err_win
+        self._scores = scores
+        return self
+
+    def score(self) -> np.ndarray:
+        if self._scores is None:
+            raise RuntimeError("call fit first")
+        return self._scores
+
+    def anomaly_indexes(self) -> np.ndarray:
+        s = self.score()
+        k = max(1, int(len(s) * self.ratio))
+        return np.sort(np.argsort(s)[-k:])
+
+
+class DBScanDetector:
+    """DBSCAN outlier detector (reference dbscan_detector.py): points
+    labeled -1 by sklearn DBSCAN are anomalies."""
+
+    def __init__(self, eps: float = 0.5, min_samples: int = 5, **kwargs):
+        self.eps = eps
+        self.min_samples = min_samples
+        self.kwargs = kwargs
+        self._labels = None
+
+    def fit(self, y: np.ndarray):
+        from sklearn.cluster import DBSCAN
+        y = np.asarray(y, np.float32).reshape(-1, 1)
+        self._labels = DBSCAN(eps=self.eps, min_samples=self.min_samples,
+                              **self.kwargs).fit_predict(y)
+        return self
+
+    def score(self) -> np.ndarray:
+        if self._labels is None:
+            raise RuntimeError("call fit first")
+        return (self._labels == -1).astype(np.float32)
+
+    def anomaly_indexes(self) -> np.ndarray:
+        return np.nonzero(self.score() > 0)[0]

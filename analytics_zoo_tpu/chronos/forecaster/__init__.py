@@ -1,0 +1,15 @@
+from analytics_zoo_tpu.chronos.forecaster.lstm_forecaster import (  # noqa: F401,E501
+    LSTMForecaster,
+)
+from analytics_zoo_tpu.chronos.forecaster.tcn_forecaster import (  # noqa: F401,E501
+    TCNForecaster,
+)
+from analytics_zoo_tpu.chronos.forecaster.seq2seq_forecaster import (  # noqa: F401,E501
+    Seq2SeqForecaster,
+)
+from analytics_zoo_tpu.chronos.forecaster.arima_forecaster import (  # noqa: F401,E501
+    ARIMAForecaster,
+)
+from analytics_zoo_tpu.chronos.forecaster.prophet_forecaster import (  # noqa: F401,E501
+    ProphetForecaster,
+)

@@ -1,0 +1,30 @@
+"""ARIMA forecaster (reference:
+/root/reference/pyzoo/zoo/chronos/forecaster/arima_forecaster.py — wraps
+pmdarima/statsmodels, an optional dependency there as here)."""
+
+from __future__ import annotations
+
+
+class ARIMAForecaster:
+    def __init__(self, *args, **kwargs):
+        try:
+            import statsmodels  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ARIMAForecaster requires statsmodels, which is not "
+                "installed in this environment; use LSTMForecaster/"
+                "TCNForecaster/Seq2SeqForecaster instead") from e
+        from statsmodels.tsa.arima.model import ARIMA  # pragma: no cover
+        self._cls = ARIMA
+        self._args, self._kwargs = args, kwargs
+        self._fitted = None
+
+    def fit(self, data, **kwargs):  # pragma: no cover
+        y = data[1] if isinstance(data, tuple) else data
+        self._fitted = self._cls(y, *self._args, **self._kwargs).fit()
+        return self
+
+    def predict(self, horizon: int = 1, **kwargs):  # pragma: no cover
+        if self._fitted is None:
+            raise RuntimeError("call fit first")
+        return self._fitted.forecast(horizon)

@@ -1,0 +1,130 @@
+"""Forecaster base (reference:
+/root/reference/pyzoo/zoo/chronos/forecaster/base_forecaster.py — the
+BasePytorchForecaster fit/predict/evaluate/save/load surface, here over the
+SPMD estimator)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.chronos.data.tsdataset import TSDataset
+
+
+def _resolve_data(data, lookback=None, horizon=None):
+    """Accept (x, y) tuples, dict {'x','y'}, or a rolled/rollable
+    TSDataset.  A cached roll is reused only when it matches the requested
+    lookback/horizon — a predict-time roll (horizon=0) must never poison a
+    later fit/evaluate, and a different forecaster's window length must
+    never leak through."""
+    if isinstance(data, TSDataset):
+        cache_ok = (data.numpy_x is not None
+                    and (lookback is None or data.lookback == lookback)
+                    and (horizon is None or data.horizon == horizon))
+        if not cache_ok:
+            if lookback is None or horizon is None:
+                raise ValueError(
+                    "TSDataset not rolled; call data.roll(lookback, horizon) "
+                    "or construct the forecaster with past_seq_len/"
+                    "future_seq_len")
+            data.roll(lookback, horizon)
+        x, y = data.to_numpy()
+        return x, y
+    if isinstance(data, dict):
+        return data.get("x"), data.get("y")
+    if isinstance(data, tuple):
+        return data
+    return data, None
+
+
+class BaseForecaster:
+    """Subclasses set self._model (flax module) and loss/metrics."""
+
+    loss = "mse"
+    metrics = ("mse",)
+
+    def __init__(self, past_seq_len: int, future_seq_len: int,
+                 input_feature_num: int, output_feature_num: int,
+                 optimizer: str = "adam", lr: float = 1e-3, seed: int = 0):
+        self.past_seq_len = past_seq_len
+        self.future_seq_len = future_seq_len
+        self.input_feature_num = input_feature_num
+        self.output_feature_num = output_feature_num
+        self._optimizer = optimizer
+        self._lr = lr
+        self._seed = seed
+        self._est = None
+
+    def _build_module(self):
+        raise NotImplementedError
+
+    def _estimator(self):
+        if self._est is None:
+            from analytics_zoo_tpu.orca.learn.estimator import Estimator
+            self._est = Estimator.from_flax(
+                self._build_module(), loss=self.loss,
+                optimizer=self._optimizer, learning_rate=self._lr,
+                metrics=list(self.metrics), seed=self._seed)
+        return self._est
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 32, **kwargs):
+        x, y = _resolve_data(data, self.past_seq_len, self.future_seq_len)
+        if y is None:
+            raise ValueError("fit requires targets")
+        y = _shape_y(y, self.future_seq_len, self.output_feature_num)
+        self._estimator().fit({"x": x, "y": y}, epochs=epochs,
+                              batch_size=batch_size, **kwargs)
+        return self
+
+    def predict(self, data, batch_size: int = 32):
+        x, _ = _resolve_data(data, self.past_seq_len, 0)
+        return self._estimator().predict({"x": x}, batch_size=batch_size)
+
+    def evaluate(self, data, batch_size: int = 32):
+        x, y = _resolve_data(data, self.past_seq_len, self.future_seq_len)
+        if y is None:
+            raise ValueError("evaluate requires targets")
+        y = _shape_y(y, self.future_seq_len, self.output_feature_num)
+        return self._estimator().evaluate({"x": x, "y": y},
+                                          batch_size=batch_size)
+
+    def save(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        payload = {
+            "config": self._config(),
+            "class": type(self).__name__,
+            "params": self._estimator().get_model()
+            if self._est is not None else None,
+        }
+        with open(path, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        return path
+
+    def _config(self):
+        return dict(past_seq_len=self.past_seq_len,
+                    future_seq_len=self.future_seq_len,
+                    input_feature_num=self.input_feature_num,
+                    output_feature_num=self.output_feature_num,
+                    optimizer=self._optimizer, lr=self._lr)
+
+    @classmethod
+    def load(cls, path: str):
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        model = cls(**payload["config"])
+        if payload["params"] is not None:
+            est = model._estimator()
+            est._params = payload["params"]
+        return model
+
+
+def _shape_y(y: np.ndarray, horizon: int, n_out: int) -> np.ndarray:
+    y = np.asarray(y, np.float32)
+    if y.ndim == 1:
+        y = y[:, None]
+    if y.ndim == 2:
+        y = y[:, :, None] if y.shape[1] == horizon else y[:, None, :]
+    return y

@@ -1,0 +1,70 @@
+"""LSTM forecaster (reference:
+/root/reference/pyzoo/zoo/chronos/model/VanillaLSTM_pytorch.py +
+forecaster/lstm_forecaster.py — stacked LSTM over the lookback window,
+dense head onto the horizon)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.chronos.forecaster.base import BaseForecaster
+
+
+class _VanillaLSTM(nn.Module):
+    hidden_dim: Sequence[int]
+    dropout: Sequence[float]
+    horizon: int
+    output_num: int
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        for i, width in enumerate(self.hidden_dim):
+            cell = nn.OptimizedLSTMCell(width, name=f"lstm_cell_{i}")
+            x = nn.RNN(cell, name=f"lstm_{i}")(x)
+            if i < len(self.dropout) and self.dropout[i]:
+                x = nn.Dropout(self.dropout[i])(
+                    x, deterministic=not training)
+        h = x[:, -1]
+        out = nn.Dense(self.horizon * self.output_num, name="head")(h)
+        return out.reshape(-1, self.horizon, self.output_num)
+
+
+class LSTMForecaster(BaseForecaster):
+    def __init__(self, past_seq_len: int, input_feature_num: int = 1,
+                 output_feature_num: int = 1, hidden_dim=32, layer_num=1,
+                 dropout=0.1, future_seq_len: int = 1, **kwargs):
+        super().__init__(past_seq_len, future_seq_len, input_feature_num,
+                         output_feature_num, **kwargs)
+        self.hidden_dim = ([hidden_dim] * layer_num
+                           if isinstance(hidden_dim, int) else
+                           list(hidden_dim))
+        self.dropout = ([dropout] * layer_num
+                        if isinstance(dropout, (int, float)) else
+                        list(dropout))
+
+    def _build_module(self):
+        return _VanillaLSTM(hidden_dim=tuple(self.hidden_dim),
+                            dropout=tuple(self.dropout),
+                            horizon=self.future_seq_len,
+                            output_num=self.output_feature_num)
+
+    def _config(self):
+        cfg = super()._config()
+        cfg.update(hidden_dim=self.hidden_dim, dropout=self.dropout,
+                   layer_num=len(self.hidden_dim))
+        return cfg
+
+    @classmethod
+    def load(cls, path: str):
+        import pickle
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        cfg = payload["config"]
+        cfg.pop("layer_num", None)
+        model = cls(**cfg)
+        if payload["params"] is not None:
+            model._estimator()._params = payload["params"]
+        return model

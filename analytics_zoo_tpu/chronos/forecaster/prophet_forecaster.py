@@ -1,0 +1,28 @@
+"""Prophet forecaster (reference:
+/root/reference/pyzoo/zoo/chronos/forecaster/prophet_forecaster.py — wraps
+fbprophet, an optional dependency there as here)."""
+
+from __future__ import annotations
+
+
+class ProphetForecaster:
+    def __init__(self, *args, **kwargs):
+        try:
+            import prophet  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ProphetForecaster requires the 'prophet' package, which is "
+                "not installed in this environment; use LSTMForecaster/"
+                "TCNForecaster/Seq2SeqForecaster instead") from e
+        from prophet import Prophet  # pragma: no cover
+        self._model = Prophet(*args, **kwargs)
+
+    def fit(self, df, **kwargs):  # pragma: no cover
+        self._model.fit(df, **kwargs)
+        return self
+
+    def predict(self, horizon: int = 1, freq: str = "D",
+                **kwargs):  # pragma: no cover
+        future = self._model.make_future_dataframe(periods=horizon,
+                                                   freq=freq)
+        return self._model.predict(future)

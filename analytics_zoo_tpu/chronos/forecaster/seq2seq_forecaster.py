@@ -1,0 +1,70 @@
+"""Seq2Seq forecaster (reference:
+/root/reference/pyzoo/zoo/chronos/model/Seq2Seq_pytorch.py +
+forecaster/seq2seq_forecaster.py — LSTM encoder over the lookback, LSTM
+decoder rolled out over the horizon)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.chronos.forecaster.base import BaseForecaster
+
+
+class _Seq2SeqForecastNet(nn.Module):
+    lstm_hidden_dim: int
+    lstm_layer_num: int
+    horizon: int
+    output_num: int
+
+    def setup(self):
+        self.enc_cells = [nn.OptimizedLSTMCell(self.lstm_hidden_dim)
+                          for _ in range(self.lstm_layer_num)]
+        self.enc_rnns = [nn.RNN(c, return_carry=True)
+                         for c in self.enc_cells]
+        self.dec_cells = [nn.OptimizedLSTMCell(self.lstm_hidden_dim)
+                          for _ in range(self.lstm_layer_num)]
+        self.head = nn.Dense(self.output_num)
+
+    def __call__(self, x, training: bool = False):
+        carries = []
+        h = x
+        for rnn in self.enc_rnns:
+            carry, h = rnn(h)
+            carries.append(carry)
+        # decoder: closed-loop rollout over the horizon, fed with the
+        # previous prediction projected back to feature space via the head
+        step_in = h[:, -1]
+        outs = []
+        for _ in range(self.horizon):
+            z = step_in
+            for i, cell in enumerate(self.dec_cells):
+                carries[i], z = cell(carries[i], z)
+            outs.append(self.head(z))
+            step_in = z
+        return jnp.stack(outs, axis=1)
+
+
+class Seq2SeqForecaster(BaseForecaster):
+    def __init__(self, past_seq_len: int, future_seq_len: int = 1,
+                 input_feature_num: int = 1, output_feature_num: int = 1,
+                 lstm_hidden_dim: int = 64, lstm_layer_num: int = 2,
+                 **kwargs):
+        super().__init__(past_seq_len, future_seq_len, input_feature_num,
+                         output_feature_num, **kwargs)
+        self.lstm_hidden_dim = lstm_hidden_dim
+        self.lstm_layer_num = lstm_layer_num
+
+    def _build_module(self):
+        return _Seq2SeqForecastNet(
+            lstm_hidden_dim=self.lstm_hidden_dim,
+            lstm_layer_num=self.lstm_layer_num,
+            horizon=self.future_seq_len,
+            output_num=self.output_feature_num)
+
+    def _config(self):
+        cfg = super()._config()
+        cfg.update(lstm_hidden_dim=self.lstm_hidden_dim,
+                   lstm_layer_num=self.lstm_layer_num)
+        return cfg

@@ -1,0 +1,2 @@
+from analytics_zoo_tpu.orca.automl.auto_estimator import AutoEstimator  # noqa: F401,E501
+from analytics_zoo_tpu.orca.automl import hp  # noqa: F401

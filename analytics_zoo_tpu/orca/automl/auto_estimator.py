@@ -1,0 +1,79 @@
+"""AutoEstimator (reference:
+/root/reference/pyzoo/zoo/orca/automl/auto_estimator.py:19-240 —
+model-creator + search space → best fitted model)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from analytics_zoo_tpu.orca.automl.search_engine import SearchEngine, Trial
+
+
+class AutoEstimator:
+    """`model_creator(config) -> Estimator` (an
+    analytics_zoo_tpu.orca.learn.Estimator, or anything with
+    fit/evaluate).  Search minimizes/maximizes `metric` on validation
+    data."""
+
+    def __init__(self, model_creator: Callable[[Dict], Any],
+                 metric: str = "loss", metric_mode: str = "min"):
+        self.model_creator = model_creator
+        self.metric = metric
+        self.metric_mode = metric_mode
+        self.best_trial: Optional[Trial] = None
+        self._engine: Optional[SearchEngine] = None
+
+    @staticmethod
+    def from_flax(model_creator: Callable[[Dict], Any], *,
+                  metric: str = "loss", metric_mode: str = "min"
+                  ) -> "AutoEstimator":
+        """`model_creator(config)` returns an orca Estimator built from a
+        flax module with config's hyperparameters applied."""
+        return AutoEstimator(model_creator, metric, metric_mode)
+
+    # reference naming parity
+    from_torch = from_flax
+    from_keras = from_flax
+
+    def fit(self, data, *, validation_data=None, search_space: Dict,
+            n_sampling: int = 4, epochs: int = 1, batch_size: int = 32,
+            grace_epochs: int = 1, feature_cols=None, label_cols=None,
+            **fit_kwargs):
+        val = validation_data if validation_data is not None else data
+
+        def trainable(config, state, add_epochs):
+            est = state
+            if est is None:
+                est = self.model_creator(config)
+            bs = int(config.get("batch_size", batch_size))
+            est.fit(data, epochs=add_epochs, batch_size=bs,
+                    feature_cols=feature_cols, label_cols=label_cols,
+                    **fit_kwargs)
+            stats = est.evaluate(val, batch_size=bs,
+                                 feature_cols=feature_cols,
+                                 label_cols=label_cols)
+            if self.metric not in stats:
+                raise KeyError(
+                    f"metric '{self.metric}' not in evaluate() stats "
+                    f"{sorted(stats)}")
+            return est, stats[self.metric]
+
+        self._engine = SearchEngine(
+            trainable, search_space, metric_mode=self.metric_mode,
+            n_sampling=n_sampling, epochs=epochs,
+            grace_epochs=grace_epochs)
+        self.best_trial = self._engine.run()
+        return self
+
+    def get_best_model(self):
+        if self.best_trial is None:
+            raise RuntimeError("call fit first")
+        return self.best_trial.state
+
+    def get_best_config(self) -> Dict:
+        if self.best_trial is None:
+            raise RuntimeError("call fit first")
+        return dict(self.best_trial.config)
+
+    def get_trial_table(self):
+        return self._engine.trial_table() if self._engine else []

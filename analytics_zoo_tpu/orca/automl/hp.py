@@ -1,0 +1,106 @@
+"""Hyperparameter search-space DSL (reference:
+/root/reference/pyzoo/zoo/orca/automl/hp.py — thin wrappers over Ray Tune's
+sample spaces; here self-contained samplers)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, List, Sequence
+
+
+class SampleSpace:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+    def grid_values(self) -> List[Any]:
+        raise NotImplementedError("this space does not support grid search")
+
+
+class Choice(SampleSpace):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+    def grid_values(self):
+        return list(self.categories)
+
+
+class Uniform(SampleSpace):
+    def __init__(self, lower: float, upper: float):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.uniform(self.lower, self.upper)
+
+
+class QUniform(SampleSpace):
+    def __init__(self, lower: float, upper: float, q: float = 1.0):
+        self.lower, self.upper, self.q = lower, upper, q
+
+    def sample(self, rng):
+        v = rng.uniform(self.lower, self.upper)
+        return round(v / self.q) * self.q
+
+
+class LogUniform(SampleSpace):
+    def __init__(self, lower: float, upper: float):
+        import math
+        self.log_lower = math.log(lower)
+        self.log_upper = math.log(upper)
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(self.log_lower, self.log_upper))
+
+
+class RandInt(SampleSpace):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class GridSearch(SampleSpace):
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return rng.choice(self.values)
+
+    def grid_values(self):
+        return list(self.values)
+
+
+def choice(categories):
+    return Choice(categories)
+
+
+def uniform(lower, upper):
+    return Uniform(lower, upper)
+
+
+def quniform(lower, upper, q=1.0):
+    return QUniform(lower, upper, q)
+
+
+def loguniform(lower, upper):
+    return LogUniform(lower, upper)
+
+
+def randint(lower, upper):
+    return RandInt(lower, upper)
+
+
+def grid_search(values):
+    return GridSearch(values)
+
+
+def sample_config(search_space: dict, rng: random.Random) -> dict:
+    """Resolve a search space dict into one concrete config."""
+    out = {}
+    for k, v in search_space.items():
+        out[k] = v.sample(rng) if isinstance(v, SampleSpace) else v
+    return out

@@ -1,0 +1,127 @@
+"""Hyperparameter search engine (reference:
+/root/reference/pyzoo/zoo/orca/automl/search/ray_tune/ray_tune_search_engine.py
+— Ray Tune trials over the RayOnSpark cluster).
+
+TPU-native re-design: TPU chips cannot be fractionally shared the way Tune
+oversubscribes CPUs (SURVEY.md §7 hard parts), so trials are scheduled
+*sequentially on the chip* (or the local device set) with successive-halving
+early stopping (ASHA-style rungs): every trial trains to the first rung,
+only the top 1/eta advance to the next, etc.  This preserves Tune's
+sample-efficiency levers (random + grid sampling, early stopping, metric
+modes) without a cluster scheduler.  On a pod, each host can run its own
+engine over a disjoint sample shard (slice-level placement).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from analytics_zoo_tpu.orca.automl import hp as hp_mod
+
+
+@dataclass
+class Trial:
+    trial_id: int
+    config: Dict[str, Any]
+    state: Any = None            # opaque per-trial state (e.g. estimator)
+    metric_history: List[float] = field(default_factory=list)
+    epochs_trained: int = 0
+    stopped: bool = False
+
+    @property
+    def best_metric(self):
+        return self.metric_history[-1] if self.metric_history else None
+
+
+class SearchEngine:
+    """trainable(config, state, epochs) -> (state, metric): train `state`
+    (None on first call) for `epochs` more epochs, return updated state and
+    the current validation metric."""
+
+    def __init__(self, trainable: Callable, search_space: Dict[str, Any],
+                 metric_mode: str = "min", n_sampling: int = 4,
+                 epochs: int = 1, grace_epochs: int = 1, eta: int = 2,
+                 seed: int = 0):
+        self.trainable = trainable
+        self.search_space = search_space
+        self.mode = metric_mode
+        if metric_mode not in ("min", "max"):
+            raise ValueError("metric_mode must be 'min' or 'max'")
+        self.n_sampling = n_sampling
+        self.epochs = epochs
+        self.grace_epochs = max(1, grace_epochs)
+        self.eta = max(2, eta)
+        self.rng = random.Random(seed)
+        self.trials: List[Trial] = []
+
+    # ------------------------------------------------------------------
+
+    def _configs(self) -> List[Dict[str, Any]]:
+        grid_keys = [k for k, v in self.search_space.items()
+                     if isinstance(v, hp_mod.GridSearch)]
+        if grid_keys:
+            # cartesian product over grid axes; non-grid hyperparameters are
+            # sampled ONCE and held fixed across combos so the grid compares
+            # like with like (n_sampling does not apply to grid mode)
+            base = hp_mod.sample_config(self.search_space, self.rng)
+            grids = [self.search_space[k].grid_values() for k in grid_keys]
+            configs = []
+            for combo in itertools.product(*grids):
+                cfg = dict(base)
+                cfg.update(dict(zip(grid_keys, combo)))
+                configs.append(cfg)
+            return configs
+        return [hp_mod.sample_config(self.search_space, self.rng)
+                for _ in range(self.n_sampling)]
+
+    def _sort_key(self, t: "Trial"):
+        """NaN metrics (diverged trials) always rank worst."""
+        import math
+        m = t.best_metric
+        if m is None or math.isnan(m):
+            return math.inf
+        return m if self.mode == "min" else -m
+
+    def _better(self, a: float, b: float) -> bool:
+        return a < b if self.mode == "min" else a > b
+
+    def run(self) -> Trial:
+        self.trials = [Trial(i, c) for i, c in enumerate(self._configs())]
+        alive = list(self.trials)
+        budget = self.grace_epochs
+        while alive:
+            # a lone survivor always trains to the full epoch budget
+            if len(alive) == 1:
+                budget = self.epochs
+            for t in alive:
+                add = min(budget, self.epochs) - t.epochs_trained
+                if add > 0:
+                    t.state, metric = self.trainable(t.config, t.state, add)
+                    t.epochs_trained += add
+                    t.metric_history.append(float(metric))
+            if budget >= self.epochs:
+                break
+            # successive halving: keep the top 1/eta (NaN trials drop first)
+            alive.sort(key=self._sort_key)
+            keep = max(1, len(alive) // self.eta)
+            for t in alive[keep:]:
+                t.stopped = True
+            alive = alive[:keep]
+            budget = min(self.epochs, budget * self.eta)
+        candidates = [t for t in self.trials if t.best_metric is not None]
+        best = min(candidates, key=self._sort_key)
+        import math
+        if best.best_metric is None or math.isnan(best.best_metric):
+            raise RuntimeError(
+                "all trials diverged (NaN metrics); widen/lower the "
+                "learning-rate space")
+        return best
+
+    def trial_table(self) -> List[Dict[str, Any]]:
+        return [{"trial_id": t.trial_id, "config": t.config,
+                 "metric": t.best_metric, "epochs": t.epochs_trained,
+                 "stopped": t.stopped} for t in self.trials]

@@ -232,14 +232,18 @@ class Estimator:
 
     def get_model(self):
         """Return current parameters as host numpy (reference estimators
-        return the trained model object)."""
+        return the trained model object).  Works on a loaded-but-not-yet-run
+        estimator by returning the staged parameters."""
+        if self._engine is None and self._params is not None:
+            return self._params
         self._require_engine()
         return self._engine.get_params()
 
     def get_model_state(self):
         """Mutable model collections (e.g. BatchNorm batch_stats) as host
         numpy."""
-        self._require_engine()
+        if self._engine is None:
+            return self._model_state or {}
         import jax
         return jax.device_get(self._engine.state.model_state)
 

@@ -1,0 +1,272 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.chronos.data import TSDataset
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context(cluster_mode="local")
+    yield
+
+
+def _series_df(n=200, ids=None):
+    t = pd.date_range("2020-01-01", periods=n, freq="h")
+    rng = np.random.default_rng(0)
+    if ids:
+        frames = []
+        for i in ids:
+            frames.append(pd.DataFrame({
+                "ts": t, "id": i,
+                "value": np.sin(np.arange(n) / 12) + 0.05 * rng.normal(size=n),
+                "extra": rng.normal(size=n)}))
+        return pd.concat(frames, ignore_index=True)
+    return pd.DataFrame({
+        "ts": t,
+        "value": np.sin(np.arange(n) / 12) + 0.05 * rng.normal(size=n),
+        "extra": rng.normal(size=n)})
+
+
+def test_tsdataset_from_pandas_split_roll():
+    df = _series_df(200)
+    train, val, test = TSDataset.from_pandas(
+        df, dt_col="ts", target_col="value", extra_feature_col="extra",
+        with_split=True, val_ratio=0.1, test_ratio=0.1)
+    assert len(train.df) == 160 and len(val.df) == 20 and len(test.df) == 20
+    train.roll(lookback=24, horizon=2)
+    x, y = train.to_numpy()
+    assert x.shape == (160 - 24 - 2 + 1, 24, 2)
+    assert y.shape == (x.shape[0], 2, 1)
+
+
+def test_tsdataset_impute_dedup_resample():
+    df = _series_df(100)
+    df.loc[5, "value"] = np.nan
+    df = pd.concat([df, df.iloc[[10]]], ignore_index=True)  # dup row
+    ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value",
+                               extra_feature_col="extra")
+    ts.deduplicate().impute(mode="linear")
+    assert len(ts.df) == 100
+    assert not ts.df["value"].isna().any()
+    ts.resample("2h")
+    assert len(ts.df) == 50
+
+
+def test_tsdataset_multi_id_and_dt_features():
+    df = _series_df(60, ids=["a", "b"])
+    ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value",
+                               id_col="id", extra_feature_col="extra")
+    ts.gen_dt_feature(["HOUR", "IS_WEEKEND"])
+    assert "HOUR" in ts.df.columns
+    ts.roll(lookback=12, horizon=1)
+    x, y = ts.to_numpy()
+    # two ids, each 60 long: 2 * (60 - 12 - 1 + 1) windows
+    assert x.shape[0] == 2 * (60 - 12)
+    assert x.shape[2] == 1 + 1 + 2  # target + extra + 2 dt features
+
+
+def test_tsdataset_scale_unscale_numpy():
+    df = _series_df(100)
+    ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value",
+                               extra_feature_col="extra")
+    raw = ts.df["value"].to_numpy().copy()
+    ts.scale()
+    assert abs(ts.df["value"].mean()) < 1e-6
+    pred = ts.df["value"].to_numpy()[:10].reshape(1, 10, 1)
+    restored = ts.unscale_numpy(pred)
+    np.testing.assert_allclose(restored.ravel(), raw[:10], rtol=1e-5)
+
+
+def test_lstm_forecaster_learns_sine():
+    from analytics_zoo_tpu.chronos.forecaster import LSTMForecaster
+    df = _series_df(300)
+    ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value")
+    ts.roll(lookback=24, horizon=1)
+    x, y = ts.to_numpy()
+    fc = LSTMForecaster(past_seq_len=24, input_feature_num=1,
+                        output_feature_num=1, hidden_dim=16, lr=1e-2)
+    fc.fit((x, y), epochs=5, batch_size=32)
+    stats = fc.evaluate((x, y))
+    assert stats["mse"] < 0.05, stats
+    preds = fc.predict((x, None))
+    assert preds.shape == (len(x), 1, 1)
+
+
+def test_tcn_forecaster_and_save_load(tmp_path):
+    from analytics_zoo_tpu.chronos.forecaster import TCNForecaster
+    df = _series_df(300)
+    ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value")
+    fc = TCNForecaster(past_seq_len=24, future_seq_len=2,
+                       input_feature_num=1, output_feature_num=1,
+                       num_channels=[8, 8], lr=1e-2)
+    fc.fit(ts, epochs=3, batch_size=32)
+    stats = fc.evaluate(ts)
+    preds1 = fc.predict(ts)
+    fc.save(str(tmp_path / "tcn.pkl"))
+    loaded = TCNForecaster.load(str(tmp_path / "tcn.pkl"))
+    preds2 = loaded.predict(ts)
+    np.testing.assert_allclose(preds1, preds2, atol=1e-5)
+    assert preds1.shape[1:] == (2, 1)
+
+
+def test_seq2seq_forecaster():
+    from analytics_zoo_tpu.chronos.forecaster import Seq2SeqForecaster
+    df = _series_df(200)
+    ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value")
+    fc = Seq2SeqForecaster(past_seq_len=16, future_seq_len=3,
+                           input_feature_num=1, output_feature_num=1,
+                           lstm_hidden_dim=16, lstm_layer_num=1, lr=1e-2)
+    fc.fit(ts, epochs=3, batch_size=32)
+    preds = fc.predict(ts)
+    assert preds.shape[1:] == (3, 1)
+
+
+def test_arima_prophet_gated():
+    from analytics_zoo_tpu.chronos.forecaster import (
+        ARIMAForecaster, ProphetForecaster)
+    with pytest.raises(ImportError, match="statsmodels"):
+        ARIMAForecaster()
+    with pytest.raises(ImportError, match="prophet"):
+        ProphetForecaster()
+
+
+def test_threshold_and_dbscan_detectors():
+    from analytics_zoo_tpu.chronos.detector.anomaly import (
+        DBScanDetector, ThresholdDetector)
+    y = np.sin(np.arange(200) / 5).astype(np.float32)
+    y[50] = 10.0
+    td = ThresholdDetector().set_params(threshold=(-2, 2))
+    td.fit(y)
+    assert 50 in td.anomaly_indexes()
+    db = DBScanDetector(eps=0.3, min_samples=4)
+    db.fit(y)
+    assert 50 in db.anomaly_indexes()
+
+
+def test_ae_detector():
+    from analytics_zoo_tpu.chronos.detector.anomaly import AEDetector
+    y = np.sin(np.arange(300) / 10).astype(np.float32)
+    y[120] = 6.0
+    det = AEDetector(roll_len=10, ratio=0.02, epochs=8)
+    det.fit(y)
+    idx = det.anomaly_indexes()
+    assert any(110 <= i <= 129 for i in idx), idx
+
+
+def test_autots_estimator_returns_pipeline(tmp_path):
+    from analytics_zoo_tpu.chronos.autots import AutoTSEstimator, TSPipeline
+    from analytics_zoo_tpu.orca.automl import hp
+    df = _series_df(200)
+    train, _, test = TSDataset.from_pandas(
+        df, dt_col="ts", target_col="value", with_split=True,
+        test_ratio=0.2)
+    auto = AutoTSEstimator(
+        model="lstm", past_seq_len=12, future_seq_len=1,
+        search_space={"hidden_dim": hp.choice([8, 16]),
+                      "layer_num": 1,
+                      "lr": hp.loguniform(5e-3, 2e-2)})
+    pipeline = auto.fit(train, epochs=2, n_sampling=3, batch_size=32)
+    assert isinstance(pipeline, TSPipeline)
+    stats = pipeline.evaluate(test)
+    assert "mse" in stats
+    cfg = auto.get_best_config()
+    assert cfg["hidden_dim"] in (8, 16)
+    pipeline.save(str(tmp_path / "pipe"))
+    loaded = TSPipeline.load(str(tmp_path / "pipe"))
+    p1 = pipeline.predict(test)
+    p2 = loaded.predict(test)
+    np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+
+def test_search_engine_halving():
+    from analytics_zoo_tpu.orca.automl.search_engine import SearchEngine
+    from analytics_zoo_tpu.orca.automl import hp
+    calls = []
+
+    def trainable(config, state, epochs):
+        state = (state or 0) + epochs
+        calls.append(config["p"])
+        # metric improves with epochs; config p is the quality
+        return state, config["p"] / state
+
+    eng = SearchEngine(trainable, {"p": hp.choice([1.0, 2.0, 4.0, 8.0])},
+                       metric_mode="min", n_sampling=8, epochs=4,
+                       grace_epochs=1)
+    best = eng.run()
+    assert best.best_metric == min(
+        t.best_metric for t in eng.trials if t.best_metric is not None)
+    # some trials must have been early-stopped
+    assert any(t.stopped for t in eng.trials)
+
+
+def test_predict_roll_does_not_poison_fit():
+    """Regression: predict-first (horizon=0 roll) then fit/evaluate."""
+    from analytics_zoo_tpu.chronos.forecaster import LSTMForecaster
+    df = _series_df(120)
+    ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value")
+    fc = LSTMForecaster(past_seq_len=12, input_feature_num=1,
+                        output_feature_num=1, hidden_dim=8, lr=1e-2)
+    preds = fc.predict(ts)
+    assert preds.shape[0] == 120 - 12 + 1
+    fc.fit(ts, epochs=1, batch_size=32)  # must re-roll with horizon=1
+    stats = fc.evaluate(ts)
+    assert "mse" in stats
+
+
+def test_search_engine_nan_never_wins():
+    from analytics_zoo_tpu.orca.automl.search_engine import SearchEngine
+    from analytics_zoo_tpu.orca.automl import hp
+
+    def trainable(config, state, epochs):
+        return (state or 0) + epochs, (float("nan") if config["p"] == 0
+                                       else config["p"])
+
+    eng = SearchEngine(trainable, {"p": hp.grid_search([0, 3.0, 2.0])},
+                       metric_mode="min", epochs=1)
+    best = eng.run()
+    assert best.config["p"] == 2.0
+
+
+def test_search_engine_lone_survivor_full_epochs():
+    from analytics_zoo_tpu.orca.automl.search_engine import SearchEngine
+    from analytics_zoo_tpu.orca.automl import hp
+
+    def trainable(config, state, epochs):
+        state = (state or 0) + epochs
+        return state, config["p"] / state
+
+    eng = SearchEngine(trainable, {"p": hp.choice([1.0, 2.0])},
+                       metric_mode="min", n_sampling=2, epochs=4,
+                       grace_epochs=1)
+    best = eng.run()
+    assert best.epochs_trained == 4, best
+
+
+def test_tspipeline_unscales_predictions():
+    from analytics_zoo_tpu.chronos.autots import AutoTSEstimator
+    from analytics_zoo_tpu.orca.automl import hp
+    df = _series_df(200)
+    # values far from zero so scaling matters
+    df["value"] = df["value"] * 10 + 100
+    ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value")
+    ts.scale()
+    auto = AutoTSEstimator(model="lstm", past_seq_len=12, future_seq_len=1,
+                           search_space={"hidden_dim": 16, "layer_num": 1,
+                                         "lr": 1e-2})
+    pipe = auto.fit(ts, epochs=3, n_sampling=1, batch_size=32)
+    preds = pipe.predict(ts)
+    # predictions must be back in original units (around 100, not 0)
+    assert 80 < float(np.median(preds)) < 120, float(np.median(preds))
+    stats = pipe.evaluate(ts)
+    assert stats["mse"] < 100, stats
+
+
+def test_threshold_detector_scalar_threshold():
+    from analytics_zoo_tpu.chronos.detector.anomaly import ThresholdDetector
+    y = np.zeros(50, np.float32)
+    y[7] = 9.0
+    td = ThresholdDetector().set_params(threshold=2.0)
+    td.fit(y)
+    assert list(td.anomaly_indexes()) == [7]
