@@ -21,11 +21,20 @@ from analytics_zoo_tpu.keras.engine import Layer
 
 
 class MultiHeadAttention(nn.Module):
+    """attn_impl selects the attention engine:
+      * "einsum" — ops.attention.dot_product_attention (bf16 MXU einsums)
+      * "flash"  — ops.pallas.flash_attention (tiled online softmax,
+        O(T) HBM; padding mask / attention dropout unsupported)
+      * "ring"   — parallel.ring_attention over the mesh "sp" axis
+        (sequence parallelism for long context; mask/dropout unsupported)
+      * "auto"   — flash when long + unmasked + no dropout, else einsum
+    """
     hidden_size: int
     n_head: int
     attn_dropout: float = 0.0
     causal: bool = False
     compute_dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, mask=None, training: bool = False):
@@ -40,12 +49,26 @@ class MultiHeadAttention(nn.Module):
             return a.reshape(b, t, h, self.hidden_size // h)
 
         q, k, v = heads(q), heads(k), heads(v)
-        drop_rng = (self.make_rng("dropout")
-                    if training and self.attn_dropout > 0 else None)
-        out = dot_product_attention(
-            q, k, v, mask=mask, causal=self.causal,
-            dropout_rate=self.attn_dropout if training else 0.0,
-            dropout_rng=drop_rng, compute_dtype=self.compute_dtype)
+        dropout = self.attn_dropout if training else 0.0
+        impl = self.attn_impl
+        if impl == "auto":
+            impl = ("flash" if (mask is None and dropout == 0.0
+                                and t >= 1024) else "einsum")
+        if impl == "ring":
+            from analytics_zoo_tpu.parallel.ring_attention import (
+                ring_self_attention)
+            out = ring_self_attention(q, k, v, causal=self.causal)
+        elif impl == "flash":
+            from analytics_zoo_tpu.ops.pallas.flash_attention import (
+                flash_attention)
+            out = flash_attention(q, k, v, causal=self.causal)
+        else:
+            drop_rng = (self.make_rng("dropout")
+                        if training and dropout > 0 else None)
+            out = dot_product_attention(
+                q, k, v, mask=mask, causal=self.causal,
+                dropout_rate=dropout, dropout_rng=drop_rng,
+                compute_dtype=self.compute_dtype)
         out = out.reshape(b, t, self.hidden_size)
         return nn.Dense(self.hidden_size, name="proj")(out)
 
@@ -58,6 +81,7 @@ class TransformerBlock(nn.Module):
     residual_dropout: float = 0.0
     causal: bool = False
     activation: str = "gelu"
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, mask=None, training: bool = False):
@@ -65,6 +89,7 @@ class TransformerBlock(nn.Module):
 
         a = MultiHeadAttention(self.hidden_size, self.n_head,
                                self.attn_dropout, self.causal,
+                               attn_impl=self.attn_impl,
                                name="attn")(x, mask, training)
         a = nn.Dropout(self.residual_dropout)(a, deterministic=not training)
         x = nn.LayerNorm(name="ln1")(x + a)
@@ -89,6 +114,7 @@ class TransformerEncoder(nn.Module):
     residual_dropout: float = 0.1
     causal: bool = False
     with_pooler: bool = False
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, input_ids, segment_ids=None, position_ids=None,
@@ -120,6 +146,7 @@ class TransformerEncoder(nn.Module):
             x = TransformerBlock(
                 self.hidden_size, self.n_head, self.intermediate_size,
                 self.attn_dropout, self.residual_dropout, self.causal,
+                attn_impl=self.attn_impl,
                 name=f"block_{i}")(x, mask, training)
 
         if self.with_pooler:

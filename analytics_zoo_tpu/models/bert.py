@@ -1,0 +1,146 @@
+"""BERT model family — the framework's flagship transformer.
+
+Reference: BERT fine-tune estimators
+(`pyzoo/zoo/tfpark/text/estimator/bert_{base,classifier,ner,squad}.py`,
+`pipeline/api/keras/layers/BERT.scala`) — BASELINE config #5 (BERT-base
+fine-tune tokens/sec).
+
+TPU-first: bf16 attention/matmuls on the MXU; tensor parallelism by
+sharding qkv/mlp kernels and embedding tables over "tp"
+(SHARD_RULES below feed `infer_param_shardings`); sequence parallelism for
+long context via `attn_impl="ring"` (ring attention over the "sp" axis);
+data parallelism over "dp"/"fsdp" from the engine's batch sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.layers.self_attention import TransformerEncoder
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+
+#: estimator shard_rules giving Megatron-style weight sharding over "tp"
+BERT_SHARD_RULES = {
+    "qkv": "tp", "proj": "tp", "fc1": "tp", "fc2": "tp",
+    "token_embed": "tp", "position_embed": "tp",
+}
+
+
+class BERTClassifier(nn.Module, ZooModel):
+    """BERT encoder + pooled classification head (reference
+    tfpark BERTClassifier)."""
+
+    num_classes: int = 2
+    vocab: int = 30522
+    hidden_size: int = 768
+    n_block: int = 12
+    n_head: int = 12
+    intermediate_size: int = 3072
+    max_position_len: int = 512
+    hidden_drop: float = 0.1
+    attn_drop: float = 0.1
+    attn_impl: str = "auto"
+
+    default_loss = "sparse_categorical_crossentropy"
+    default_metrics = ("accuracy",)
+
+    @nn.compact
+    def __call__(self, input_ids, segment_ids=None, attention_mask=None,
+                 training: bool = False):
+        _, pooled = TransformerEncoder(
+            vocab=self.vocab, hidden_size=self.hidden_size,
+            n_head=self.n_head, n_block=self.n_block,
+            intermediate_size=self.intermediate_size,
+            max_position_len=self.max_position_len, n_segments=2,
+            embedding_dropout=self.hidden_drop,
+            attn_dropout=self.attn_drop,
+            residual_dropout=self.hidden_drop,
+            causal=False, with_pooler=True, attn_impl=self.attn_impl,
+            name="bert")(input_ids, segment_ids, None, attention_mask,
+                         training)
+        pooled = nn.Dropout(self.hidden_drop)(pooled,
+                                              deterministic=not training)
+        return nn.Dense(self.num_classes, name="classifier")(pooled)
+
+    def estimator(self, **kwargs):
+        kwargs.setdefault("shard_rules", dict(BERT_SHARD_RULES))
+        return super().estimator(**kwargs)
+
+
+class BERTNER(nn.Module, ZooModel):
+    """Token-level tagging head (reference tfpark BERTNER)."""
+
+    num_entities: int = 9
+    vocab: int = 30522
+    hidden_size: int = 768
+    n_block: int = 12
+    n_head: int = 12
+    intermediate_size: int = 3072
+    max_position_len: int = 512
+    hidden_drop: float = 0.1
+    attn_impl: str = "auto"
+
+    default_loss = "sparse_categorical_crossentropy"
+    default_metrics = ("accuracy",)
+
+    @nn.compact
+    def __call__(self, input_ids, segment_ids=None, attention_mask=None,
+                 training: bool = False):
+        seq = TransformerEncoder(
+            vocab=self.vocab, hidden_size=self.hidden_size,
+            n_head=self.n_head, n_block=self.n_block,
+            intermediate_size=self.intermediate_size,
+            max_position_len=self.max_position_len, n_segments=2,
+            embedding_dropout=self.hidden_drop,
+            attn_dropout=self.hidden_drop,
+            residual_dropout=self.hidden_drop,
+            causal=False, with_pooler=False, attn_impl=self.attn_impl,
+            name="bert")(input_ids, segment_ids, None, attention_mask,
+                         training)
+        seq = nn.Dropout(self.hidden_drop)(seq, deterministic=not training)
+        return nn.Dense(self.num_entities, name="ner_head")(seq)
+
+    def estimator(self, **kwargs):
+        kwargs.setdefault("shard_rules", dict(BERT_SHARD_RULES))
+        return super().estimator(**kwargs)
+
+
+class BERTSQuAD(nn.Module, ZooModel):
+    """Span-extraction head: (start_logits, end_logits) (reference tfpark
+    BERTSQuAD)."""
+
+    vocab: int = 30522
+    hidden_size: int = 768
+    n_block: int = 12
+    n_head: int = 12
+    intermediate_size: int = 3072
+    max_position_len: int = 512
+    hidden_drop: float = 0.1
+    attn_impl: str = "auto"
+
+    default_loss = "sparse_categorical_crossentropy"
+    default_metrics = ()
+
+    @nn.compact
+    def __call__(self, input_ids, segment_ids=None, attention_mask=None,
+                 training: bool = False):
+        seq = TransformerEncoder(
+            vocab=self.vocab, hidden_size=self.hidden_size,
+            n_head=self.n_head, n_block=self.n_block,
+            intermediate_size=self.intermediate_size,
+            max_position_len=self.max_position_len, n_segments=2,
+            embedding_dropout=self.hidden_drop,
+            attn_dropout=self.hidden_drop,
+            residual_dropout=self.hidden_drop,
+            causal=False, with_pooler=False, attn_impl=self.attn_impl,
+            name="bert")(input_ids, segment_ids, None, attention_mask,
+                         training)
+        logits = nn.Dense(2, name="span_head")(seq)     # [b, t, 2]
+        return logits[..., 0], logits[..., 1]
+
+    def estimator(self, **kwargs):
+        kwargs.setdefault("shard_rules", dict(BERT_SHARD_RULES))
+        return super().estimator(**kwargs)

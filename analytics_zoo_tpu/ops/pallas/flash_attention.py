@@ -1,0 +1,228 @@
+"""Flash attention — Pallas TPU kernel.
+
+Tiled online-softmax attention: the [T, T] score matrix is never
+materialized in HBM.  Each grid step owns one (batch*head, q-block) tile
+held in VMEM; the kernel loops over K/V blocks with `fori_loop`, keeping
+running max / denominator / accumulator in VMEM scratch, so HBM traffic is
+O(T*d) instead of O(T^2) and the MXU stays fed from VMEM
+(/opt/skills/guides/pallas_guide.md patterns).
+
+Training: `flash_attention` carries a custom VJP whose backward recomputes
+attention blockwise in plain JAX (lax.scan over K blocks) — same
+O(T*d) memory, XLA-fused; the forward hot path is the Pallas kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                scale: float):
+    # q_ref: [1, block_q, d]; k_ref/v_ref: [1, t, d]; o_ref: [1, block_q, d]
+    _, block_q, d = q_ref.shape
+    t = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    num_k = t // block_k
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(ki, carry):
+        o_acc, m_acc, l_acc = carry
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_blk = s.max(axis=1)
+        m_new = jnp.maximum(m_acc, m_blk)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_acc - m_new)
+        l_new = l_acc * alpha + p.sum(axis=1)
+        o_new = o_acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    if causal:
+        # only K blocks at or before this Q block contribute
+        last = (qi + 1) * block_q // block_k
+        upper = jnp.minimum(num_k, last + (1 if block_q % block_k else 0))
+        upper = jnp.maximum(upper, 1)
+    else:
+        upper = num_k
+    o_acc, m_acc, l_acc = jax.lax.fori_loop(0, upper, body, (o0, m0, l0))
+    o_ref[0] = (o_acc / jnp.maximum(l_acc, 1e-20)[:, None]
+                ).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
+               interpret: bool):
+    """q, k, v: [bh, t, d] -> [bh, t, d]."""
+    bh, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    grid = (bh, t // block_q)
+    return pl.pallas_call(
+        partial(_fwd_kernel, block_k=block_k, causal=causal, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                                   memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference_attn(q, k, v, causal: bool):
+    """Blockwise-free reference in plain JAX (used for the VJP and as the
+    numerical oracle in tests).  [bh, t, d]."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("btd,bsd->bts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bts,bsd->btd", p.astype(v.dtype), v)
+
+
+def _causal_block_mask(t, block_k, ki):
+    """[t, block_k] bool mask: q position >= k position for block ki."""
+    q_pos = jnp.arange(t)[:, None]
+    k_pos = ki * block_k + jnp.arange(block_k)[None, :]
+    return q_pos >= k_pos
+
+
+def _row_stats(q, k, block_k, causal, scale):
+    """Blockwise recompute of the softmax row max m and denominator l
+    [bh, t] with O(t * block_k) live memory (lax.scan over K blocks)."""
+    bh, t, d = q.shape
+    num_k = t // block_k
+    qs = q.astype(jnp.float32) * scale
+
+    def body(carry, ki):
+        m_acc, l_acc = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(
+            k, ki * block_k, block_k, axis=1).astype(jnp.float32)
+        s = jnp.einsum("btd,bkd->btk", qs, k_blk)
+        if causal:
+            s = jnp.where(_causal_block_mask(t, block_k, ki)[None],
+                          s, NEG_INF)
+        m_new = jnp.maximum(m_acc, s.max(axis=-1))
+        l_new = (l_acc * jnp.exp(m_acc - m_new)
+                 + jnp.exp(s - m_new[..., None]).sum(axis=-1))
+        return (m_new, l_new), None
+
+    m0 = jnp.full((bh, t), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, t), jnp.float32)
+    (m, l), _ = jax.lax.scan(body, (m0, l0), jnp.arange(num_k))
+    return m, l
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, block_q, block_k, causal, interpret):
+    return _flash_fwd(q, k, v, block_q=block_q, block_k=block_k,
+                      causal=causal, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, block_q, block_k, causal, interpret):
+    out = _flash(q, k, v, block_q, block_k, causal, interpret)
+    return out, (q, k, v, out)
+
+
+def _flash_vjp_bwd(block_q, block_k, causal, interpret, res, g):
+    """Blockwise flash backward (lax.scan over K blocks): per-block
+    [bh, t, block_k] probabilities are recomputed from the saved row
+    max/denominator and consumed immediately — the [T, T] matrix is never
+    materialized, so bwd memory is O(T * block_k) like the forward."""
+    q, k, v, out = res
+    bh, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    g32 = g.astype(jnp.float32)
+    q32 = q.astype(jnp.float32)
+    m, l = _row_stats(q, k, block_k, causal, scale)
+    delta = (g32 * out.astype(jnp.float32)).sum(-1)      # [bh, t]
+    num_k = t // block_k
+
+    def body(dq_acc, ki):
+        k_blk = jax.lax.dynamic_slice_in_dim(
+            k, ki * block_k, block_k, axis=1).astype(jnp.float32)
+        v_blk = jax.lax.dynamic_slice_in_dim(
+            v, ki * block_k, block_k, axis=1).astype(jnp.float32)
+        s = jnp.einsum("btd,bkd->btk", q32, k_blk) * scale
+        if causal:
+            s = jnp.where(_causal_block_mask(t, block_k, ki)[None],
+                          s, NEG_INF)
+        p = jnp.exp(s - m[..., None]) / l[..., None]     # [bh, t, bk]
+        dp = jnp.einsum("btd,bkd->btk", g32, v_blk)
+        ds = p * (dp - delta[..., None])
+        dq_acc = dq_acc + jnp.einsum("btk,bkd->btd", ds, k_blk) * scale
+        dk_blk = jnp.einsum("btk,btd->bkd", ds, q32) * scale
+        dv_blk = jnp.einsum("btk,btd->bkd", p, g32)
+        return dq_acc, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((bh, t, d), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(body, dq0,
+                                              jnp.arange(num_k))
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, t, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, t, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = None):
+    """Flash attention over [batch, t, heads, d] (BTHD, same convention as
+    `ops.attention.dot_product_attention`).  Falls back to the reference
+    implementation when shapes don't tile (t % block sizes)."""
+    b, t, h, d = q.shape
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    def from_bh(x):
+        return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        return from_bh(_reference_attn(to_bh(q), to_bh(k), to_bh(v),
+                                       causal)).astype(q.dtype)
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), block_q, block_k, causal,
+                 interpret)
+    return from_bh(out)

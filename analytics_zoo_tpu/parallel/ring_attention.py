@@ -1,0 +1,119 @@
+"""Ring attention — sequence/context parallelism over the "sp" mesh axis.
+
+The reference has NO long-context parallelism (SURVEY.md §5: "Absent");
+this is the TPU-native extension that makes long sequences first-class.
+Design follows the ring-attention recipe: the sequence dim of Q, K, V is
+sharded over "sp"; each device computes blockwise attention of its Q shard
+against the K/V shard it currently holds, then rotates K/V one step around
+the ring with `lax.ppermute` (ICI neighbor exchange), accumulating the
+softmax online (running max / denominator), so the full [T, T] score matrix
+is never materialized and K/V transfer overlaps compute across the P steps.
+
+Usage: inside `shard_map` (or any context where a mapped axis named
+`axis_name` exists), with per-device shards q,k,v: [batch, t_local, heads,
+head_dim].
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: finite stand-in for -inf: a fully-masked block then yields exp(s - m) = 1
+#: with zero blend weight (beta = exp(-1e30 - m_acc) = 0) instead of the
+#: exp(-inf - (-inf)) = NaN that true -inf produces
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias):
+    """One blockwise attention step -> (unnormalized out, running max,
+    denom).  q: [b, tq, h, d]; k/v: [b, tk, h, d]; bias broadcastable to
+    [b, h, tq, tk] (additive, -inf for masked)."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    m = s.max(axis=-1)                                  # [b, h, q]
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)                                  # [b, h, q]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
+    """Per-device ring attention.  q, k, v: [batch, t_local, heads, d]
+    shards of the sequence dim over `axis_name`.  Returns the local output
+    shard [batch, t_local, heads, d].  Call under shard_map."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+
+    q32 = q.astype(jnp.float32)
+
+    def bias_for(step):
+        if not causal:
+            return None
+        # global positions of q rows and the k rows currently held
+        src_idx = (my_idx - step) % axis_size
+        q_pos = my_idx * t_local + jnp.arange(t_local)
+        k_pos = src_idx * t_local + jnp.arange(t_local)
+        mask = q_pos[:, None] >= k_pos[None, :]          # [tq, tk]
+        return jnp.where(mask, 0.0, NEG_INF)[None, None]
+
+    def step_fn(carry, step):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        o_blk, m_blk, l_blk = _block_attn(q32, k_cur.astype(jnp.float32),
+                                          v_cur, bias_for(step))
+        m_new = jnp.maximum(m_acc, m_blk)
+        # rescale previous accumulators to the new max
+        alpha = jnp.exp(m_acc - m_new)                   # [b, h, q]
+        beta = jnp.exp(m_blk - m_new)
+        l_new = l_acc * alpha + l_blk * beta
+        scale_old = alpha.transpose(0, 2, 1)[..., None]  # [b, q, h, 1]
+        scale_new = beta.transpose(0, 2, 1)[..., None]
+        o_new = o_acc * scale_old + o_blk.astype(jnp.float32) * scale_new
+        # rotate K/V one step around the ring (device i -> i+1)
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    m0 = jnp.full((b, h, t_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step_fn, (o0, m0, l0, k, v), jnp.arange(axis_size))
+    denom = l.transpose(0, 2, 1)[..., None]              # [b, q, h, 1]
+    return (o / jnp.maximum(denom, 1e-20)).astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
+                        causal: bool = False):
+    """Convenience wrapper: takes GLOBAL [batch, t, heads, d] arrays, shards
+    the sequence dim over the mesh's "sp" axis with shard_map, and runs
+    ring_attention.  Falls back to one-shot blockwise attention when the
+    mesh has no "sp" axis."""
+    from analytics_zoo_tpu.common.context import OrcaContext
+    mesh = mesh or OrcaContext.mesh
+    if "sp" not in mesh.axis_names or mesh.shape["sp"] == 1:
+        o, m, l = _block_attn(q.astype(jnp.float32),
+                              k.astype(jnp.float32), v,
+                              _causal_bias(q.shape[1]) if causal else None)
+        denom = l.transpose(0, 2, 1)[..., None]
+        return (o / jnp.maximum(denom, 1e-20)).astype(q.dtype)
+
+    spec = P(None, "sp", None, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name="sp", causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def _causal_bias(t):
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    return jnp.where(mask, 0.0, NEG_INF)[None, None]

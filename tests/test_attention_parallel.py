@@ -1,0 +1,113 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+
+
+def _qkv(b=2, t=128, h=4, d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _ref(q, k, v, causal):
+    from analytics_zoo_tpu.ops.pallas.flash_attention import _reference_attn
+    b, t, h, d = q.shape
+    bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    r = _reference_attn(bh(q), bh(k), bh(v), causal)
+    return r.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_reference(causal):
+    from analytics_zoo_tpu.ops.pallas.flash_attention import flash_attention
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    np.testing.assert_allclose(out, _ref(q, k, v, causal), atol=2e-5)
+
+
+def test_flash_attention_grad_finite():
+    from analytics_zoo_tpu.ops.pallas.flash_attention import flash_attention
+    q, k, v = _qkv(t=64)
+    g = jax.grad(lambda q: flash_attention(
+        q, k, v, causal=True, block_q=32, block_k=32).sum())(q)
+    assert bool(jnp.isfinite(g).all())
+
+
+def test_flash_attention_untiled_fallback():
+    from analytics_zoo_tpu.ops.pallas.flash_attention import flash_attention
+    q, k, v = _qkv(t=100)  # 100 not divisible by blocks -> reference path
+    out = flash_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(out, _ref(q, k, v, False), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    from jax.sharding import Mesh
+    from analytics_zoo_tpu.parallel.ring_attention import ring_self_attention
+    q, k, v = _qkv()
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 8), ("dp", "sp"))
+    out = ring_self_attention(q, k, v, mesh=mesh, causal=causal)
+    np.testing.assert_allclose(out, _ref(q, k, v, causal), atol=2e-5)
+
+
+def test_ring_attention_no_sp_fallback():
+    from jax.sharding import Mesh
+    from analytics_zoo_tpu.parallel.ring_attention import ring_self_attention
+    q, k, v = _qkv(t=32)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+    out = ring_self_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(out, _ref(q, k, v, True), atol=2e-5)
+
+
+def test_ring_attention_differentiable():
+    from jax.sharding import Mesh
+    from analytics_zoo_tpu.parallel.ring_attention import ring_self_attention
+    q, k, v = _qkv(t=64)
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 8), ("dp", "sp"))
+    g = jax.grad(lambda q: ring_self_attention(
+        q, k, v, mesh=mesh, causal=False).sum())(q)
+    gr = jax.grad(lambda q: _ref(q, k, v, False).sum())(q)
+    np.testing.assert_allclose(g, gr, atol=2e-4)
+
+
+def test_bert_classifier_train_small():
+    from analytics_zoo_tpu.models.bert import BERTClassifier
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    b, t = 32, 12
+    ids = rng.integers(0, 100, (b, t)).astype(np.int32)
+    seg = np.zeros((b, t), np.int32)
+    # learnable: label = parity of first token
+    y = (ids[:, 0] % 2).astype(np.int32)
+    model = BERTClassifier(num_classes=2, vocab=100, hidden_size=32,
+                           n_block=2, n_head=4, intermediate_size=64,
+                           max_position_len=t, hidden_drop=0.0,
+                           attn_drop=0.0)
+    est = model.estimator(learning_rate=5e-3)
+    est.fit({"x": [ids, seg], "y": y}, epochs=20, batch_size=16)
+    stats = est.evaluate({"x": [ids, seg], "y": y})
+    assert stats["accuracy"] > 0.8, stats
+
+
+def test_bert_tp_shard_rules_applied():
+    from analytics_zoo_tpu.models.bert import (BERT_SHARD_RULES,
+                                               BERTClassifier)
+    from analytics_zoo_tpu import OrcaContext
+    stop_orca_context()
+    init_orca_context(cluster_mode="local", mesh_shape={"dp": 2, "tp": 4})
+    model = BERTClassifier(num_classes=2, vocab=64, hidden_size=32,
+                           n_block=1, n_head=4, intermediate_size=64,
+                           max_position_len=8, hidden_drop=0.0,
+                           attn_drop=0.0)
+    est = model.estimator(learning_rate=1e-3)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (16, 8)).astype(np.int32)
+    seg = np.zeros((16, 8), np.int32)
+    y = rng.integers(0, 2, 16).astype(np.int32)
+    est.fit({"x": [ids, seg], "y": y}, epochs=1, batch_size=8)
+    qkv = est._engine.state.params["bert"]["block_0"]["attn"]["qkv"]["kernel"]
+    assert "tp" in str(qkv.sharding.spec)
+    stop_orca_context()
