@@ -24,10 +24,17 @@ class MultiHeadAttention(nn.Module):
     """attn_impl selects the attention engine:
       * "einsum" — ops.attention.dot_product_attention (bf16 MXU einsums)
       * "flash"  — ops.pallas.flash_attention (tiled online softmax,
-        O(T) HBM; padding mask / attention dropout unsupported)
+        O(T) HBM; key-padding masks supported, attention dropout not)
       * "ring"   — parallel.ring_attention over the mesh "sp" axis
-        (sequence parallelism for long context; mask/dropout unsupported)
-      * "auto"   — flash when long + unmasked + no dropout, else einsum
+        (sequence parallelism for long context; key-padding masks rotate
+        with K/V; dropout unsupported)
+      * "auto"   — flash when long + no dropout, else einsum
+
+    `mask` is a [batch, t] key-validity mask (1 = attend, 0 = padding),
+    understood by every impl.  A pre-built additive [b, 1|h, tq, tk] float
+    mask is also accepted for the einsum path only (flash/ring raise —
+    they cannot honor arbitrary additive biases; ADVICE r1: never drop a
+    mask silently).
     """
     hidden_size: int
     n_head: int
@@ -50,23 +57,43 @@ class MultiHeadAttention(nn.Module):
 
         q, k, v = heads(q), heads(k), heads(v)
         dropout = self.attn_dropout if training else 0.0
+        key_mask = additive_mask = None
+        if mask is not None:
+            if mask.ndim == 2:                    # [b, t] key validity
+                key_mask = mask
+                additive_mask = (1.0 - mask[:, None, None, :]
+                                 .astype(jnp.float32)) * -1e9
+            else:                                 # pre-built additive bias
+                additive_mask = mask
         impl = self.attn_impl
         if impl == "auto":
-            impl = ("flash" if (mask is None and dropout == 0.0
-                                and t >= 1024) else "einsum")
+            impl = ("flash" if (additive_mask is None or key_mask is not None)
+                    and dropout == 0.0 and t >= 1024 else "einsum")
+        if impl in ("flash", "ring"):
+            if dropout > 0:
+                raise ValueError(
+                    f"attn_impl='{impl}' does not support attention dropout; "
+                    "set attn_dropout=0 or use attn_impl='einsum'")
+            if additive_mask is not None and key_mask is None:
+                raise ValueError(
+                    f"attn_impl='{impl}' only supports [batch, t] key-"
+                    "validity masks, not additive bias masks; pass the raw "
+                    "attention_mask or use attn_impl='einsum'")
         if impl == "ring":
             from analytics_zoo_tpu.parallel.ring_attention import (
                 ring_self_attention)
-            out = ring_self_attention(q, k, v, causal=self.causal)
+            out = ring_self_attention(q, k, v, causal=self.causal,
+                                      kv_mask=key_mask)
         elif impl == "flash":
             from analytics_zoo_tpu.ops.pallas.flash_attention import (
                 flash_attention)
-            out = flash_attention(q, k, v, causal=self.causal)
+            out = flash_attention(q, k, v, causal=self.causal,
+                                  kv_mask=key_mask)
         else:
             drop_rng = (self.make_rng("dropout")
                         if training and dropout > 0 else None)
             out = dot_product_attention(
-                q, k, v, mask=mask, causal=self.causal,
+                q, k, v, mask=additive_mask, causal=self.causal,
                 dropout_rate=dropout, dropout_rng=drop_rng,
                 compute_dtype=self.compute_dtype)
         out = out.reshape(b, t, self.hidden_size)
@@ -137,11 +164,9 @@ class TransformerEncoder(nn.Module):
         x = nn.LayerNorm(name="embed_ln")(x)
         x = nn.Dropout(self.embedding_dropout)(x, deterministic=not training)
 
-        mask = None
-        if attention_mask is not None:
-            # [b, t] of 1/0 -> additive [b, 1, 1, t]
-            mask = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)
-                    ) * -1e9
+        # pass the raw [b, t] key-validity mask down: each attention impl
+        # (einsum/flash/ring) lowers it appropriately
+        mask = attention_mask
         for i in range(self.n_block):
             x = TransformerBlock(
                 self.hidden_size, self.n_head, self.intermediate_size,

@@ -1,15 +1,21 @@
 """Flash attention — Pallas TPU kernel.
 
 Tiled online-softmax attention: the [T, T] score matrix is never
-materialized in HBM.  Each grid step owns one (batch*head, q-block) tile
-held in VMEM; the kernel loops over K/V blocks with `fori_loop`, keeping
-running max / denominator / accumulator in VMEM scratch, so HBM traffic is
-O(T*d) instead of O(T^2) and the MXU stays fed from VMEM
-(/opt/skills/guides/pallas_guide.md patterns).
+materialized in HBM.  The grid is (batch*heads, q_blocks, k_blocks) with the
+K axis innermost: each grid step stages one [block_q, d] Q tile and one
+[block_k, d] K/V tile in VMEM (Pallas double-buffers the HBM->VMEM DMAs
+across k steps), keeping running max / denominator / output in VMEM scratch
+that persists along the k axis.  HBM traffic is O(T*d) per q-row block and
+max sequence length is bounded by HBM, not VMEM.
+
+Padding masks are supported: `kv_mask` is a [batch, t] 1/0 key-validity
+mask (1 = attend), broadcast over heads; masked positions contribute zero
+probability mass (fully-masked rows return zeros, not NaN).
 
 Training: `flash_attention` carries a custom VJP whose backward recomputes
 attention blockwise in plain JAX (lax.scan over K blocks) — same
-O(T*d) memory, XLA-fused; the forward hot path is the Pallas kernel.
+O(T*block_k) live memory, XLA-fused; the forward hot path is the Pallas
+kernel.
 """
 
 from __future__ import annotations
@@ -21,108 +27,168 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+_einsum = partial(jnp.einsum, precision=jax.lax.Precision.HIGHEST)
+
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                scale: float):
-    # q_ref: [1, block_q, d]; k_ref/v_ref: [1, t, d]; o_ref: [1, block_q, d]
-    _, block_q, d = q_ref.shape
-    t = k_ref.shape[1]
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    o0 = jnp.zeros((block_q, d), jnp.float32)
-    num_k = t // block_k
-
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-
-    def body(ki, carry):
-        o_acc, m_acc, l_acc = carry
-        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bq, bk]
-        if causal:
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_blk = s.max(axis=1)
-        m_new = jnp.maximum(m_acc, m_blk)
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_acc - m_new)
-        l_new = l_acc * alpha + p.sum(axis=1)
-        o_new = o_acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return o_new, m_new, l_new
-
-    if causal:
-        # only K blocks at or before this Q block contribute
-        last = (qi + 1) * block_q // block_k
-        upper = jnp.minimum(num_k, last + (1 if block_q % block_k else 0))
-        upper = jnp.maximum(upper, 1)
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q: int, block_k: int,
+                num_k: int, causal: bool, has_mask: bool, scale: float):
+    # q_ref: [1, block_q, d]; k_ref/v_ref: [1, block_k, d];
+    # (mask_ref: [1, 8, block_k] when has_mask — kv mask broadcast over 8
+    # sublanes, jax.experimental.pallas.ops.tpu.flash_attention layout);
+    # o_ref: [1, block_q, d];
+    # scratch: o_scr [block_q, d] f32, m_scr/l_scr [block_q, 128] f32.
+    if has_mask:
+        mask_ref, o_ref, o_scr, m_scr, l_scr = rest
     else:
-        upper = num_k
-    o_acc, m_acc, l_acc = jax.lax.fori_loop(0, upper, body, (o0, m0, l0))
-    o_ref[0] = (o_acc / jnp.maximum(l_acc, 1e-20)[:, None]
-                ).astype(o_ref.dtype)
+        o_ref, o_scr, m_scr, l_scr = rest
+        mask_ref = None
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        o_scr[:] = jnp.zeros_like(o_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # under causality, K blocks strictly after this Q block's last row are
+    # all-masked: skip their compute (the DMA still streams by, cheaply)
+    live = (k_start <= q_start + block_q - 1) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        keep = None
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            keep = q_pos >= k_pos
+        if has_mask:
+            valid = mask_ref[0, :1] != 0                   # [1, bk]
+            keep = valid if keep is None else (keep & valid)
+        if keep is not None:
+            s = jnp.where(keep, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]                             # [bq, 1]
+        l_prev = l_scr[:, 0:1]
+        m_blk = s.max(axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)
+        if keep is not None:
+            # exp(NEG_INF - NEG_INF) = 1 for fully-masked rows: zero it
+            p = jnp.where(keep, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)                    # [bq, 1]
+        l_new = l_prev * alpha + p.sum(axis=1, keepdims=True)
+        # HIGHEST on bf16 operands fails Mosaic lowering ("Bad lhs type");
+        # bf16 MXU dots are exact anyway (f32 accumulate), so only force
+        # 3-pass precision for f32 operands
+        pv_prec = (jax.lax.Precision.HIGHEST
+                   if v.dtype == jnp.float32 else None)
+        o_scr[:] = o_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            precision=pv_prec,
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, 0:1], 1e-20)
+        o_ref[0] = (o_scr[:] / l).astype(o_ref.dtype)
 
 
-def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
+def _flash_fwd(q, k, v, kv_mask, *, block_q: int, block_k: int, causal: bool,
                interpret: bool):
-    """q, k, v: [bh, t, d] -> [bh, t, d]."""
+    """q, k, v: [bh, t, d]; kv_mask: [bh, t] int32 or None -> [bh, t, d]."""
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    grid = (bh, t // block_q)
+    num_k = t // block_k
+    grid = (bh, t // block_q, num_k)
+    has_mask = kv_mask is not None
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q, k, v]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b, 0, j),
+                                     memory_space=pltpu.VMEM))
+        args.append(jnp.broadcast_to(
+            kv_mask.astype(jnp.int32)[:, None, :], (bh, 8, t)))
+
     return pl.pallas_call(
-        partial(_fwd_kernel, block_k=block_k, causal=causal, scale=scale),
+        partial(_fwd_kernel, block_q=block_q, block_k=block_k, num_k=num_k,
+                causal=causal, has_mask=has_mask, scale=scale),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-        grid_spec=pl.GridSpec(
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0),
-                             memory_space=pltpu.VMEM),
-            ],
-            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
-                                   memory_space=pltpu.VMEM),
-        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
-def _reference_attn(q, k, v, causal: bool):
-    """Blockwise-free reference in plain JAX (used for the VJP and as the
-    numerical oracle in tests).  [bh, t, d]."""
+def _reference_attn(q, k, v, causal: bool, kv_mask=None):
+    """Blockwise-free reference in plain JAX (used for the fallback path and
+    as the numerical oracle in tests).  [bh, t, d]; kv_mask [bh, t]."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jnp.einsum("btd,bsd->bts", q.astype(jnp.float32),
+    s = _einsum("btd,bsd->bts", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    keep = None
     if causal:
         t = q.shape[1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(mask[None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bts,bsd->btd", p.astype(v.dtype), v)
+        keep = jnp.tril(jnp.ones((t, t), bool))[None]
+    if kv_mask is not None:
+        valid = (kv_mask != 0)[:, None, :]
+        keep = valid if keep is None else (keep & valid)
+    if keep is not None:
+        s = jnp.where(keep, s, NEG_INF)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    if keep is not None:
+        p = jnp.where(keep, p, 0.0)
+    p = p / jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-20)
+    return _einsum("bts,bsd->btd", p.astype(v.dtype), v)
 
 
-def _causal_block_mask(t, block_k, ki):
-    """[t, block_k] bool mask: q position >= k position for block ki."""
-    q_pos = jnp.arange(t)[:, None]
-    k_pos = ki * block_k + jnp.arange(block_k)[None, :]
-    return q_pos >= k_pos
+def _keep_block(t, block_k, ki, causal, kv_mask):
+    """[bh|1, t, block_k] bool keep-mask for K block ki (None if unmasked)."""
+    keep = None
+    if causal:
+        q_pos = jnp.arange(t)[:, None]
+        k_pos = ki * block_k + jnp.arange(block_k)[None, :]
+        keep = (q_pos >= k_pos)[None]                      # [1, t, bk]
+    if kv_mask is not None:
+        valid = jax.lax.dynamic_slice_in_dim(
+            kv_mask != 0, ki * block_k, block_k, axis=1)[:, None, :]
+        keep = valid if keep is None else (keep & valid)
+    return keep
 
 
-def _row_stats(q, k, block_k, causal, scale):
+def _row_stats(q, k, block_k, causal, scale, kv_mask):
     """Blockwise recompute of the softmax row max m and denominator l
     [bh, t] with O(t * block_k) live memory (lax.scan over K blocks)."""
     bh, t, d = q.shape
@@ -133,13 +199,15 @@ def _row_stats(q, k, block_k, causal, scale):
         m_acc, l_acc = carry
         k_blk = jax.lax.dynamic_slice_in_dim(
             k, ki * block_k, block_k, axis=1).astype(jnp.float32)
-        s = jnp.einsum("btd,bkd->btk", qs, k_blk)
-        if causal:
-            s = jnp.where(_causal_block_mask(t, block_k, ki)[None],
-                          s, NEG_INF)
+        s = _einsum("btd,bkd->btk", qs, k_blk)
+        keep = _keep_block(t, block_k, ki, causal, kv_mask)
+        if keep is not None:
+            s = jnp.where(keep, s, NEG_INF)
         m_new = jnp.maximum(m_acc, s.max(axis=-1))
-        l_new = (l_acc * jnp.exp(m_acc - m_new)
-                 + jnp.exp(s - m_new[..., None]).sum(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        l_new = l_acc * jnp.exp(m_acc - m_new) + p.sum(axis=-1)
         return (m_new, l_new), None
 
     m0 = jnp.full((bh, t), NEG_INF, jnp.float32)
@@ -148,15 +216,15 @@ def _row_stats(q, k, block_k, causal, scale):
     return m, l
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, block_q, block_k, causal, interpret):
-    return _flash_fwd(q, k, v, block_q=block_q, block_k=block_k,
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, kv_mask, block_q, block_k, causal, interpret):
+    return _flash_fwd(q, k, v, kv_mask, block_q=block_q, block_k=block_k,
                       causal=causal, interpret=interpret)
 
 
-def _flash_vjp_fwd(q, k, v, block_q, block_k, causal, interpret):
-    out = _flash(q, k, v, block_q, block_k, causal, interpret)
-    return out, (q, k, v, out)
+def _flash_vjp_fwd(q, k, v, kv_mask, block_q, block_k, causal, interpret):
+    out = _flash(q, k, v, kv_mask, block_q, block_k, causal, interpret)
+    return out, (q, k, v, kv_mask, out)
 
 
 def _flash_vjp_bwd(block_q, block_k, causal, interpret, res, g):
@@ -164,13 +232,14 @@ def _flash_vjp_bwd(block_q, block_k, causal, interpret, res, g):
     [bh, t, block_k] probabilities are recomputed from the saved row
     max/denominator and consumed immediately — the [T, T] matrix is never
     materialized, so bwd memory is O(T * block_k) like the forward."""
-    q, k, v, out = res
+    q, k, v, kv_mask, out = res
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
     g32 = g.astype(jnp.float32)
     q32 = q.astype(jnp.float32)
-    m, l = _row_stats(q, k, block_k, causal, scale)
-    delta = (g32 * out.astype(jnp.float32)).sum(-1)      # [bh, t]
+    m, l = _row_stats(q, k, block_k, causal, scale, kv_mask)
+    l = jnp.maximum(l, 1e-20)
+    delta = (g32 * out.astype(jnp.float32)).sum(-1)        # [bh, t]
     num_k = t // block_k
 
     def body(dq_acc, ki):
@@ -178,16 +247,16 @@ def _flash_vjp_bwd(block_q, block_k, causal, interpret, res, g):
             k, ki * block_k, block_k, axis=1).astype(jnp.float32)
         v_blk = jax.lax.dynamic_slice_in_dim(
             v, ki * block_k, block_k, axis=1).astype(jnp.float32)
-        s = jnp.einsum("btd,bkd->btk", q32, k_blk) * scale
-        if causal:
-            s = jnp.where(_causal_block_mask(t, block_k, ki)[None],
-                          s, NEG_INF)
-        p = jnp.exp(s - m[..., None]) / l[..., None]     # [bh, t, bk]
-        dp = jnp.einsum("btd,bkd->btk", g32, v_blk)
+        s = _einsum("btd,bkd->btk", q32, k_blk) * scale
+        keep = _keep_block(t, block_k, ki, causal, kv_mask)
+        p = jnp.exp(s - m[..., None]) / l[..., None]       # [bh, t, bk]
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        dp = _einsum("btd,bkd->btk", g32, v_blk)
         ds = p * (dp - delta[..., None])
-        dq_acc = dq_acc + jnp.einsum("btk,bkd->btd", ds, k_blk) * scale
-        dk_blk = jnp.einsum("btk,btd->bkd", ds, q32) * scale
-        dv_blk = jnp.einsum("btk,btd->bkd", p, g32)
+        dq_acc = dq_acc + _einsum("btk,bkd->btd", ds, k_blk) * scale
+        dk_blk = _einsum("btk,btd->bkd", ds, q32) * scale
+        dv_blk = _einsum("btk,btd->bkd", p, g32)
         return dq_acc, (dk_blk, dv_blk)
 
     dq0 = jnp.zeros((bh, t, d), jnp.float32)
@@ -195,19 +264,24 @@ def _flash_vjp_bwd(block_q, block_k, causal, interpret, res, g):
                                               jnp.arange(num_k))
     dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, t, d)
     dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, t, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, *, causal: bool = False,
+def flash_attention(q, k, v, *, kv_mask=None, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = None):
     """Flash attention over [batch, t, heads, d] (BTHD, same convention as
-    `ops.attention.dot_product_attention`).  Falls back to the reference
-    implementation when shapes don't tile (t % block sizes)."""
+    `ops.attention.dot_product_attention`).
+
+    kv_mask: optional [batch, t] key-validity mask (1 = attend, 0 = pad),
+    broadcast over heads.  Falls back to the blockwise-free reference
+    implementation when shapes don't tile (t % block sizes).
+    """
     b, t, h, d = q.shape
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
@@ -218,11 +292,18 @@ def flash_attention(q, k, v, *, causal: bool = False,
     def from_bh(x):
         return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
+    mask_bh = None
+    if kv_mask is not None:
+        mask_bh = jnp.repeat(kv_mask.astype(jnp.int32), h, axis=0)  # [b*h, t]
+
     block_q = min(block_q, t)
     block_k = min(block_k, t)
-    if t % block_q or t % block_k:
+    untiled = t % block_q or t % block_k
+    # the mask BlockSpec (1, 8, block_k) needs a lane-aligned K block
+    mask_unaligned = mask_bh is not None and block_k % 128 and block_k != t
+    if untiled or mask_unaligned:
         return from_bh(_reference_attn(to_bh(q), to_bh(k), to_bh(v),
-                                       causal)).astype(q.dtype)
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), block_q, block_k, causal,
-                 interpret)
+                                       causal, mask_bh)).astype(q.dtype)
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), mask_bh, block_q, block_k,
+                 causal, interpret)
     return from_bh(out)

@@ -96,16 +96,21 @@ class Estimator:
     @staticmethod
     def from_torch(model, *, loss=None, optimizer=None, metrics=None,
                    model_dir=None, **kwargs) -> "Estimator":
-        """Import a torch.nn.Module (reference: pytorch/estimator.py:39).
-        The module is structurally converted to flax and its weights copied;
-        training then runs on the TPU mesh, not in torch."""
-        from analytics_zoo_tpu.orca.learn.torch_adapter import torch_to_flax
+        """Import a torch.nn.Module (reference: pytorch/estimator.py:39-108).
+        The module is fx-traced and interpreted with JAX ops, its weights
+        copied into flax params; training then runs on the TPU mesh, not in
+        torch.  `loss` additionally accepts torch criterion instances
+        (nn.CrossEntropyLoss() etc.), mapped to framework losses."""
+        from analytics_zoo_tpu.orca.learn.torch_adapter import (
+            resolve_torch_loss, torch_to_flax)
         module, params, model_state = torch_to_flax(model)
-        est = Estimator.from_flax(module, loss=loss, optimizer=optimizer,
+        est = Estimator.from_flax(module, loss=resolve_torch_loss(loss),
+                                  optimizer=optimizer,
                                   metrics=metrics, model_dir=model_dir,
                                   **kwargs)
-        est._params = params
-        est._model_state = model_state
+        if params is not None:
+            est._params = params
+            est._model_state = model_state
         return est
 
     # ------------------------------------------------------------------

@@ -73,7 +73,9 @@ def hinge(preds, labels):
     p, y = _first(preds), _first(labels)
     p = p.reshape(p.shape[0], -1)
     y = y.reshape(y.shape[0], -1).astype(p.dtype)
-    y = 2.0 * y - 1.0  # {0,1} -> {-1,1}
+    # accept both conventions: {0,1} labels are remapped to {-1,1};
+    # labels already containing negatives are used as-is
+    y = jnp.where(jnp.min(y) >= 0, 2.0 * y - 1.0, y)
     return jnp.maximum(0.0, 1.0 - y * p).mean(axis=-1)
 
 

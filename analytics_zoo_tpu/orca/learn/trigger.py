@@ -36,11 +36,19 @@ class SeveralIteration(Trigger):
 
 
 class MaxIteration(Trigger):
+    """Fires once, when `max_steps` is reached (reference Trigger.maxIteration)."""
+
     def __init__(self, max_steps: int):
         self.max = max_steps
+        self._fired = False
 
     def __call__(self, *, epoch, step, epoch_end):
-        return step >= self.max
+        if self._fired or epoch_end:
+            return False
+        if step >= self.max:
+            self._fired = True
+            return True
+        return False
 
 
 class MinLoss(Trigger):

@@ -9,6 +9,10 @@ the ring with `lax.ppermute` (ICI neighbor exchange), accumulating the
 softmax online (running max / denominator), so the full [T, T] score matrix
 is never materialized and K/V transfer overlaps compute across the P steps.
 
+Padding masks are first-class: `kv_mask` ([batch, t] key-validity, 1 =
+attend) is sharded over "sp" like K/V and rotates around the ring with
+them; masked keys contribute zero probability mass.
+
 Usage: inside `shard_map` (or any context where a mapped axis named
 `axis_name` exists), with per-device shards q,k,v: [batch, t_local, heads,
 head_dim].
@@ -32,42 +36,57 @@ NEG_INF = -1e30
 def _block_attn(q, k, v, bias):
     """One blockwise attention step -> (unnormalized out, running max,
     denom).  q: [b, tq, h, d]; k/v: [b, tk, h, d]; bias broadcastable to
-    [b, h, tq, tk] (additive, -inf for masked)."""
+    [b, h, tq, tk] (additive, NEG_INF for masked)."""
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if bias is not None:
         s = s + bias
     m = s.max(axis=-1)                                  # [b, h, q]
     p = jnp.exp(s - m[..., None])
+    if bias is not None:
+        # rows where every key is masked keep m = NEG_INF and would get
+        # exp(0) = 1 mass per masked entry — zero them explicitly
+        p = jnp.where(s > NEG_INF / 2, p, 0.0)
     l = p.sum(axis=-1)                                  # [b, h, q]
     o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
     return o, m, l
 
 
-def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                   kv_mask=None):
     """Per-device ring attention.  q, k, v: [batch, t_local, heads, d]
-    shards of the sequence dim over `axis_name`.  Returns the local output
-    shard [batch, t_local, heads, d].  Call under shard_map."""
+    shards of the sequence dim over `axis_name`; kv_mask: optional
+    [batch, t_local] key-validity shard (1 = attend).  Returns the local
+    output shard [batch, t_local, heads, d].  Call under shard_map."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
 
     q32 = q.astype(jnp.float32)
+    has_mask = kv_mask is not None
 
-    def bias_for(step):
-        if not causal:
-            return None
-        # global positions of q rows and the k rows currently held
-        src_idx = (my_idx - step) % axis_size
-        q_pos = my_idx * t_local + jnp.arange(t_local)
-        k_pos = src_idx * t_local + jnp.arange(t_local)
-        mask = q_pos[:, None] >= k_pos[None, :]          # [tq, tk]
-        return jnp.where(mask, 0.0, NEG_INF)[None, None]
+    def bias_for(step, mask_cur):
+        bias = None
+        if causal:
+            # global positions of q rows and the k rows currently held
+            src_idx = (my_idx - step) % axis_size
+            q_pos = my_idx * t_local + jnp.arange(t_local)
+            k_pos = src_idx * t_local + jnp.arange(t_local)
+            cm = q_pos[:, None] >= k_pos[None, :]        # [tq, tk]
+            bias = jnp.where(cm, 0.0, NEG_INF)[None, None]
+        if mask_cur is not None:
+            mb = jnp.where(mask_cur != 0, 0.0, NEG_INF
+                           )[:, None, None, :]           # [b, 1, 1, tk]
+            bias = mb if bias is None else bias + mb
+        return bias
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def step_fn(carry, step):
-        o_acc, m_acc, l_acc, k_cur, v_cur = carry
-        o_blk, m_blk, l_blk = _block_attn(q32, k_cur.astype(jnp.float32),
-                                          v_cur, bias_for(step))
+        o_acc, m_acc, l_acc, k_cur, v_cur, mask_cur = carry
+        o_blk, m_blk, l_blk = _block_attn(
+            q32, k_cur.astype(jnp.float32), v_cur,
+            bias_for(step, mask_cur if has_mask else None))
         m_new = jnp.maximum(m_acc, m_blk)
         # rescale previous accumulators to the new max
         alpha = jnp.exp(m_acc - m_new)                   # [b, h, q]
@@ -76,42 +95,58 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
         scale_old = alpha.transpose(0, 2, 1)[..., None]  # [b, q, h, 1]
         scale_new = beta.transpose(0, 2, 1)[..., None]
         o_new = o_acc * scale_old + o_blk.astype(jnp.float32) * scale_new
-        # rotate K/V one step around the ring (device i -> i+1)
-        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        # rotate K/V (and the mask travelling with them) around the ring
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+        mask_nxt = (jax.lax.ppermute(mask_cur, axis_name, perm)
+                    if has_mask else mask_cur)
+        return (o_new, m_new, l_new, k_nxt, v_nxt, mask_nxt), None
 
     o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
     m0 = jnp.full((b, h, t_local), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t_local), jnp.float32)
-    (o, m, l, _, _), _ = jax.lax.scan(
-        step_fn, (o0, m0, l0, k, v), jnp.arange(axis_size))
+    mask0 = (kv_mask.astype(jnp.int32) if has_mask
+             else jnp.zeros((b, t_local), jnp.int32))
+    (o, m, l, _, _, _), _ = jax.lax.scan(
+        step_fn, (o0, m0, l0, k, v, mask0), jnp.arange(axis_size))
     denom = l.transpose(0, 2, 1)[..., None]              # [b, q, h, 1]
     return (o / jnp.maximum(denom, 1e-20)).astype(q.dtype)
 
 
 def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
-                        causal: bool = False):
+                        causal: bool = False, kv_mask=None):
     """Convenience wrapper: takes GLOBAL [batch, t, heads, d] arrays, shards
     the sequence dim over the mesh's "sp" axis with shard_map, and runs
-    ring_attention.  Falls back to one-shot blockwise attention when the
-    mesh has no "sp" axis."""
+    ring_attention.  kv_mask: optional [batch, t] key-validity mask.  Falls
+    back to one-shot blockwise attention when the mesh has no "sp" axis."""
     from analytics_zoo_tpu.common.context import OrcaContext
     mesh = mesh or OrcaContext.mesh
     if "sp" not in mesh.axis_names or mesh.shape["sp"] == 1:
+        bias = None
+        if causal:
+            bias = _causal_bias(q.shape[1])
+        if kv_mask is not None:
+            mb = jnp.where(kv_mask != 0, 0.0, NEG_INF)[:, None, None, :]
+            bias = mb if bias is None else bias + mb
         o, m, l = _block_attn(q.astype(jnp.float32),
-                              k.astype(jnp.float32), v,
-                              _causal_bias(q.shape[1]) if causal else None)
+                              k.astype(jnp.float32), v, bias)
         denom = l.transpose(0, 2, 1)[..., None]
         return (o / jnp.maximum(denom, 1e-20)).astype(q.dtype)
 
     spec = P(None, "sp", None, None)
+    if kv_mask is None:
+        fn = jax.shard_map(
+            partial(ring_attention, axis_name="sp", causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(q, k, v)
+    mspec = P(None, "sp")
     fn = jax.shard_map(
-        partial(ring_attention, axis_name="sp", causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        lambda q, k, v, m: ring_attention(q, k, v, axis_name="sp",
+                                          causal=causal, kv_mask=m),
+        mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
         check_vma=False)
-    return fn(q, k, v)
+    return fn(q, k, v, kv_mask)
 
 
 def _causal_bias(t):

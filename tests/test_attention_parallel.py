@@ -111,3 +111,105 @@ def test_bert_tp_shard_rules_applied():
     qkv = est._engine.state.params["bert"]["block_0"]["attn"]["qkv"]["kernel"]
     assert "tp" in str(qkv.sharding.spec)
     stop_orca_context()
+
+
+def _kv_mask(b=2, t=128, seed=1):
+    rng = np.random.default_rng(seed)
+    m = np.ones((b, t), np.int32)
+    for i in range(b):
+        m[i, int(rng.integers(t // 2, t)):] = 0
+    return jnp.asarray(m)
+
+
+def _ref_masked(q, k, v, causal, mask):
+    from analytics_zoo_tpu.ops.pallas.flash_attention import _reference_attn
+    b, t, h, d = q.shape
+    bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    mb = jnp.repeat(mask, h, axis=0)
+    r = _reference_attn(bh(q), bh(k), bh(v), causal, mb)
+    return r.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_masked(causal):
+    from analytics_zoo_tpu.ops.pallas.flash_attention import flash_attention
+    q, k, v = _qkv(t=256)
+    mask = _kv_mask(t=256)
+    out = flash_attention(q, k, v, kv_mask=mask, causal=causal,
+                          block_q=128, block_k=128)
+    np.testing.assert_allclose(out, _ref_masked(q, k, v, causal, mask),
+                               atol=2e-5)
+
+
+def test_flash_attention_masked_grad():
+    from analytics_zoo_tpu.ops.pallas.flash_attention import flash_attention
+    q, k, v = _qkv(t=256)
+    mask = _kv_mask(t=256)
+    g = jax.grad(lambda q: flash_attention(
+        q, k, v, kv_mask=mask, block_q=128, block_k=128).sum())(q)
+    gr = jax.grad(lambda q: _ref_masked(q, k, v, False, mask).sum())(q)
+    np.testing.assert_allclose(g, gr, atol=2e-4)
+
+
+def test_flash_attention_fully_masked_rows_zero():
+    from analytics_zoo_tpu.ops.pallas.flash_attention import flash_attention
+    q, k, v = _qkv(t=128)
+    mask = jnp.zeros((2, 128), jnp.int32)
+    out = flash_attention(q, k, v, kv_mask=mask, block_q=128, block_k=128)
+    assert bool(jnp.isfinite(out).all())
+    np.testing.assert_allclose(out, np.zeros_like(out), atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_masked(causal):
+    from jax.sharding import Mesh
+    from analytics_zoo_tpu.parallel.ring_attention import ring_self_attention
+    q, k, v = _qkv()
+    mask = _kv_mask()
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 8), ("dp", "sp"))
+    out = ring_self_attention(q, k, v, mesh=mesh, causal=causal,
+                              kv_mask=mask)
+    np.testing.assert_allclose(out, _ref_masked(q, k, v, causal, mask),
+                               atol=2e-5)
+
+
+def test_mha_rejects_additive_mask_on_flash():
+    from analytics_zoo_tpu.keras.layers.self_attention import (
+        MultiHeadAttention)
+    m = MultiHeadAttention(hidden_size=32, n_head=4, attn_impl="flash")
+    x = jnp.ones((2, 16, 32))
+    additive = jnp.zeros((2, 1, 1, 16))
+    with pytest.raises(ValueError, match="key-"):
+        m.init(jax.random.PRNGKey(0), x, additive)
+
+
+def test_mha_key_mask_all_impls_agree():
+    """einsum / flash / ring must agree on a padded batch."""
+    from analytics_zoo_tpu.keras.layers.self_attention import (
+        MultiHeadAttention)
+    from jax.sharding import Mesh
+    from analytics_zoo_tpu.common.context import OrcaContextMeta
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 128, 32)),
+                    jnp.float32)
+    mask = _kv_mask(t=128)
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 8), ("dp", "sp"))
+    prev = (OrcaContextMeta._mesh, OrcaContextMeta._initialized)
+    OrcaContextMeta._mesh = mesh
+    OrcaContextMeta._initialized = True
+    try:
+        outs = {}
+        for impl in ("einsum", "flash", "ring"):
+            m = MultiHeadAttention(hidden_size=32, n_head=4,
+                                   compute_dtype=jnp.float32,
+                                   attn_impl=impl)
+            params = m.init(jax.random.PRNGKey(0), x, mask)
+            outs[impl] = m.apply(params, x, mask)
+        # padded positions produce finite values in all impls; compare only
+        # valid query rows (padded q rows attend to nothing under flash)
+        valid = np.asarray(mask, bool)
+        for impl in ("flash", "ring"):
+            a = np.asarray(outs[impl])[valid]
+            b = np.asarray(outs["einsum"])[valid]
+            np.testing.assert_allclose(a, b, atol=2e-4, err_msg=impl)
+    finally:
+        OrcaContextMeta._mesh, OrcaContextMeta._initialized = prev

@@ -1,0 +1,321 @@
+"""Estimator.from_torch — torch.fx → JAX import path (reference:
+pyzoo/zoo/orca/learn/pytorch/estimator.py:39-108; BASELINE config #3,
+apps/dogs-vs-cats torch ResNet)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+import jax  # noqa: E402
+
+from analytics_zoo_tpu import init_orca_context  # noqa: E402
+
+
+class _Block(tnn.Module):
+    """ResNet BasicBlock (conv/bn/residual), the dogs-vs-cats workhorse."""
+
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(cout)
+        self.conv2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(cout)
+        self.down = (tnn.Sequential(
+            tnn.Conv2d(cin, cout, 1, stride, bias=False),
+            tnn.BatchNorm2d(cout))
+            if (stride != 1 or cin != cout) else tnn.Identity())
+        self.relu = tnn.ReLU()
+
+    def forward(self, x):
+        idt = self.down(x)
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return self.relu(out + idt)
+
+
+class _TinyResNet(tnn.Module):
+    def __init__(self, n_classes=2):
+        super().__init__()
+        self.stem = tnn.Sequential(
+            tnn.Conv2d(3, 8, 3, 1, 1, bias=False),
+            tnn.BatchNorm2d(8), tnn.ReLU())
+        self.layer1 = _Block(8, 8)
+        self.layer2 = _Block(8, 16, stride=2)
+        self.pool = tnn.AdaptiveAvgPool2d((1, 1))
+        self.fc = tnn.Linear(16, n_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = self.pool(x)
+        x = torch.flatten(x, 1)
+        return self.fc(x)
+
+
+def _forward_parity(tm, x, atol=1e-3):
+    from analytics_zoo_tpu.orca.learn.flax_adapter import (flax_apply_fn,
+                                                           init_flax)
+    from analytics_zoo_tpu.orca.learn.torch_adapter import torch_to_flax
+    tm = tm.eval()
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x)).numpy()
+    mod, _, _ = torch_to_flax(tm)
+    params, mstate = init_flax(mod, (x[:1],))
+    out, _ = flax_apply_fn(mod)(params, mstate, (x,),
+                                jax.random.PRNGKey(0), False)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=atol)
+
+
+def test_resnet_forward_parity():
+    x = np.random.default_rng(0).standard_normal(
+        (4, 3, 16, 16)).astype(np.float32)
+    _forward_parity(_TinyResNet(), x)
+
+
+def test_mlp_forward_parity():
+    m = tnn.Sequential(
+        tnn.Linear(10, 32), tnn.ReLU(), tnn.LayerNorm(32),
+        tnn.Linear(32, 16), tnn.GELU(), tnn.Linear(16, 3),
+        tnn.Softmax(dim=-1))
+    x = np.random.default_rng(1).standard_normal((8, 10)).astype(np.float32)
+    _forward_parity(m, x, atol=1e-4)
+
+
+def test_functional_ops_parity():
+    class M(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = tnn.Linear(12, 12)
+
+        def forward(self, x):
+            a = torch.relu(self.fc(x))
+            b = a.view(-1, 3, 4).permute(0, 2, 1).reshape(x.shape[0], 12)
+            c = torch.cat([a, b], dim=1)
+            return torch.mean(c, dim=1, keepdim=True) + a.sum(
+                dim=1, keepdim=True)
+
+    x = np.random.default_rng(2).standard_normal((5, 12)).astype(np.float32)
+    _forward_parity(M(), x, atol=1e-4)
+
+
+def test_embedding_parity():
+    class M(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = tnn.Embedding(20, 8)
+            self.fc = tnn.Linear(8, 2)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids).mean(dim=1))
+
+    from analytics_zoo_tpu.orca.learn.flax_adapter import (flax_apply_fn,
+                                                           init_flax)
+    from analytics_zoo_tpu.orca.learn.torch_adapter import torch_to_flax
+    tm = M().eval()
+    ids = np.random.default_rng(3).integers(0, 20, (6, 5)).astype(np.int64)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(ids)).numpy()
+    mod, _, _ = torch_to_flax(tm)
+    params, mstate = init_flax(mod, (ids.astype(np.int32)[:1],))
+    out, _ = flax_apply_fn(mod)(params, mstate, (ids.astype(np.int32),),
+                                jax.random.PRNGKey(0), False)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_from_torch_trains_to_accuracy():
+    """BASELINE config #3 analog: torch CNN classifier through
+    Estimator.fit on the 8-device mesh."""
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    n = 256
+    y = rng.integers(0, 2, n).astype(np.int32)
+    x = rng.standard_normal((n, 3, 16, 16)).astype(np.float32) * 0.5
+    x[y == 1, 0] += 1.0
+
+    est = Estimator.from_torch(_TinyResNet(), loss=tnn.CrossEntropyLoss(),
+                               metrics=["accuracy"], learning_rate=5e-3)
+    est.fit({"x": x, "y": y}, epochs=8, batch_size=32)
+    stats = est.evaluate({"x": x, "y": y})
+    assert stats["accuracy"] > 0.9, stats
+
+
+def test_from_torch_batchnorm_stats_update():
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(1)
+    x = (rng.standard_normal((64, 3, 8, 8)) * 3 + 5).astype(np.float32)
+    y = rng.integers(0, 2, 64).astype(np.int32)
+    tm = _TinyResNet()
+    before = tm.stem[1].running_mean.numpy().copy()
+    est = Estimator.from_torch(tm, loss="sparse_categorical_crossentropy",
+                               learning_rate=1e-3)
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=32)
+    ms = est.get_model_state()["batch_stats"]
+    after = np.asarray(ms["stem_1_mean"])
+    assert not np.allclose(before, after), "BN running stats never updated"
+
+
+def test_from_torch_predict_and_checkpoint(tmp_path):
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((16, 3, 8, 8)).astype(np.float32)
+    y = rng.integers(0, 2, 16).astype(np.int32)
+    est = Estimator.from_torch(_TinyResNet(),
+                               loss="sparse_categorical_crossentropy",
+                               learning_rate=1e-3)
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=8)
+    preds = est.predict({"x": x}, batch_size=8)
+    assert preds.shape == (16, 2)
+    path = est.save(str(tmp_path / "ckpt"))
+    est2 = Estimator.from_torch(_TinyResNet(),
+                                loss="sparse_categorical_crossentropy",
+                                learning_rate=1e-3)
+    est2.load(path)
+    preds2 = est2.predict({"x": x}, batch_size=8)
+    np.testing.assert_allclose(preds, preds2, atol=1e-5)
+
+
+def test_from_torch_loss_mapping():
+    from analytics_zoo_tpu.orca.learn.torch_adapter import resolve_torch_loss
+    assert resolve_torch_loss(tnn.CrossEntropyLoss()) == \
+        "sparse_categorical_crossentropy"
+    assert resolve_torch_loss(tnn.MSELoss()) == "mse"
+    assert resolve_torch_loss("mae") == "mae"
+    with pytest.raises(ValueError):
+        resolve_torch_loss(tnn.TripletMarginLoss())
+
+
+def test_from_torch_unsupported_module_message():
+    from analytics_zoo_tpu.orca.learn.torch_adapter import torch_to_flax
+
+    class M(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.rnn = tnn.LSTM(4, 4)
+
+        def forward(self, x):
+            return self.rnn(x)[0]
+
+    mod, _, _ = torch_to_flax(M())
+    x = np.zeros((2, 3, 4), np.float32)
+    with pytest.raises(NotImplementedError, match="LSTM"):
+        mod.init(jax.random.PRNGKey(0), x)
+
+
+def test_pool_ceil_mode_and_dilation_parity():
+    class M(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.mp = tnn.MaxPool2d(3, 2, ceil_mode=True)
+            self.mpd = tnn.MaxPool2d(3, 1, padding=1, dilation=2)
+            self.ap = tnn.AvgPool2d(3, 2, padding=1, ceil_mode=True)
+            self.ap2 = tnn.AvgPool2d(2, 2, padding=1,
+                                     count_include_pad=False)
+
+        def forward(self, x):
+            return self.ap2(self.ap(self.mpd(self.mp(x))))
+
+    x = np.random.default_rng(4).standard_normal(
+        (2, 3, 17, 17)).astype(np.float32)
+    _forward_parity(M(), x, atol=1e-5)
+
+
+def test_chunk_uneven_parity():
+    class M(tnn.Module):
+        def forward(self, x):
+            a, b, c = torch.chunk(x, 3, dim=1)
+            return a.sum(dim=1) + b.sum(dim=1) + c.sum(dim=1)
+
+    x = np.random.default_rng(5).standard_normal((2, 7)).astype(np.float32)
+    _forward_parity(M(), x, atol=1e-6)
+
+
+def test_batchnorm_no_running_stats():
+    m = tnn.Sequential(tnn.Conv2d(3, 4, 3),
+                       tnn.BatchNorm2d(4, track_running_stats=False),
+                       tnn.ReLU())
+    x = np.random.default_rng(6).standard_normal(
+        (4, 3, 8, 8)).astype(np.float32)
+    # torch eval-mode BN without running stats uses batch stats
+    _forward_parity(m, x, atol=1e-4)
+
+
+def test_loss_mapping_rejects_configured_criteria():
+    from analytics_zoo_tpu.orca.learn.torch_adapter import resolve_torch_loss
+    with pytest.raises(ValueError, match="ignore_index"):
+        resolve_torch_loss(tnn.CrossEntropyLoss(ignore_index=0))
+    with pytest.raises(ValueError, match="label_smoothing"):
+        resolve_torch_loss(tnn.CrossEntropyLoss(label_smoothing=0.1))
+    with pytest.raises(ValueError, match="weight"):
+        resolve_torch_loss(
+            tnn.CrossEntropyLoss(weight=torch.ones(3)))
+
+
+def test_gelu_exact_and_conv1d_same_padding():
+    class M(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c = tnn.Conv1d(4, 8, 3, padding="same")
+            self.g = tnn.GELU()
+
+        def forward(self, x):
+            return self.g(self.c(x)).sum(dim=-1)
+
+    x = (np.random.default_rng(7).standard_normal((2, 4, 16)) * 3
+         ).astype(np.float32)
+    _forward_parity(M(), x, atol=1e-4)
+
+
+def test_from_torch_does_not_mutate_model_mode():
+    tm = _TinyResNet().train()
+    from analytics_zoo_tpu.orca.learn.torch_adapter import torch_to_flax
+    torch_to_flax(tm)
+    assert tm.training, "from_torch must not leave the model in eval mode"
+
+
+def test_huber_delta_respected():
+    from analytics_zoo_tpu.orca.learn.torch_adapter import resolve_torch_loss
+    import jax.numpy as jnp
+    fn = resolve_torch_loss(tnn.HuberLoss(delta=2.0))
+    p = jnp.asarray([[4.0]]); y = jnp.asarray([[0.0]])
+    # |d|=4 > delta=2: torch huber = delta*(|d| - 0.5*delta) = 2*(4-1) = 6
+    np.testing.assert_allclose(np.asarray(fn(p, y)), [6.0], atol=1e-6)
+    with pytest.raises(ValueError, match="reduction"):
+        resolve_torch_loss(tnn.MSELoss(reduction="sum"))
+
+
+def test_sigmoid_silu_modules_and_expand():
+    class M(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = tnn.Linear(6, 4)
+            self.act = tnn.SiLU()
+            self.sig = tnn.Sigmoid()
+            self.bias = tnn.Parameter(torch.randn(4))
+
+        def forward(self, x):
+            h = self.act(self.fc(x))
+            b = self.bias.expand(x.shape[0], -1)
+            return self.sig(h + b)
+
+    x = np.random.default_rng(8).standard_normal((3, 6)).astype(np.float32)
+    _forward_parity(M(), x, atol=1e-5)
+
+
+def test_nll_loss_segmentation_layout():
+    from analytics_zoo_tpu.orca.learn.torch_adapter import resolve_torch_loss
+    import jax.numpy as jnp
+    fn = resolve_torch_loss(tnn.NLLLoss())
+    rng = np.random.default_rng(9)
+    logp = np.log(np.full((2, 3, 4, 4), 1 / 3, np.float32))
+    y = rng.integers(0, 3, (2, 4, 4))
+    out = np.asarray(fn(jnp.asarray(logp), jnp.asarray(y)))
+    ref = torch.nn.functional.nll_loss(
+        torch.from_numpy(logp), torch.from_numpy(y),
+        reduction="none").mean(dim=(1, 2)).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-6)
