@@ -1,0 +1,15 @@
+"""Friesian — recommender-system feature engineering (L6).
+
+Reference: `pyzoo/zoo/friesian/feature/table.py` (FeatureTable over Spark
+DataFrames with Scala kernels, `friesian/feature/Utils.scala:34-180`).
+Here tables are XShards of pandas DataFrames: shard-local pandas ops run in
+parallel across shards, and statistics that need the whole table (median,
+min/max, frequency counts, string indices) do a global reduce over
+shard-local partials — the same two-phase pattern as the reference's
+Spark SQL kernels.
+"""
+
+from analytics_zoo_tpu.friesian.table import (FeatureTable, StringIndex,
+                                              Table)
+
+__all__ = ["Table", "FeatureTable", "StringIndex"]

@@ -1,0 +1,605 @@
+"""FeatureTable / StringIndex on XShards-of-pandas (reference:
+`/root/reference/pyzoo/zoo/friesian/feature/table.py:42-740` Table,
+`:714` FeatureTable, `:1930` StringIndex).
+
+Every transform returns a NEW table (immutable semantics like the
+reference's DataFrame lineage).  Shard-local work runs through
+`XShards.transform_shard` (parallel across shards); global statistics
+(median/min/max/frequencies/string indices) reduce shard partials on the
+driver — the analog of the reference's Spark aggregations.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.orca.data.shard import XShards
+
+
+def _as_list(x) -> List[str]:
+    if x is None:
+        return []
+    if isinstance(x, str):
+        return [x]
+    return list(x)
+
+
+class Table:
+    """Base distributed table: XShards of pandas DataFrames."""
+
+    def __init__(self, shards: XShards):
+        if not isinstance(shards, XShards):
+            raise TypeError(f"expected XShards, got {type(shards)}")
+        self.shards = shards
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def from_pandas(cls, df: pd.DataFrame, num_shards: Optional[int] = None):
+        return cls(XShards.partition(df, num_shards))
+
+    @classmethod
+    def from_shards(cls, shards: XShards):
+        return cls(shards)
+
+    @classmethod
+    def read_parquet(cls, paths):
+        from analytics_zoo_tpu.orca.data.pandas import read_parquet
+        return cls(read_parquet(paths))
+
+    @classmethod
+    def read_csv(cls, paths, **kwargs):
+        from analytics_zoo_tpu.orca.data.pandas import read_csv
+        return cls(read_csv(paths, **kwargs))
+
+    # -- basic ops (reference Table :103-711) ---------------------------
+
+    def _map(self, fn: Callable[[pd.DataFrame], pd.DataFrame]) -> "Table":
+        return type(self)(self.shards.transform_shard(fn))
+
+    def compute(self) -> "Table":
+        self.shards.collect()
+        return self
+
+    def to_pandas(self) -> pd.DataFrame:
+        parts = self.shards.collect()
+        return pd.concat(parts, ignore_index=True)
+
+    def size(self) -> int:
+        return sum(len(df) for df in self.shards.collect())
+
+    def __len__(self) -> int:
+        return self.size()
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self.shards.get(0).columns)
+
+    def select(self, *cols) -> "Table":
+        cols = [c for group in cols for c in _as_list(group)]
+        return self._map(lambda df: df[cols])
+
+    def drop(self, *cols) -> "Table":
+        cols = [c for group in cols for c in _as_list(group)]
+        return self._map(lambda df: df.drop(columns=cols))
+
+    def rename(self, columns: Dict[str, str]) -> "Table":
+        return self._map(lambda df: df.rename(columns=columns))
+
+    def fillna(self, value, columns=None) -> "Table":
+        cols = _as_list(columns)
+
+        def f(df):
+            df = df.copy()
+            if cols:
+                df[cols] = df[cols].fillna(value)
+            else:
+                df = df.fillna(value)
+            return df
+        return self._map(f)
+
+    def dropna(self, columns=None, how: str = "any") -> "Table":
+        cols = _as_list(columns) or None
+        return self._map(lambda df: df.dropna(subset=cols, how=how)
+                         .reset_index(drop=True))
+
+    def distinct(self) -> "Table":
+        # local dedup per shard, then a global pass on the driver
+        local = self._map(lambda df: df.drop_duplicates())
+        merged = local.to_pandas().drop_duplicates().reset_index(drop=True)
+        return type(self).from_pandas(merged,
+                                      self.shards.num_partitions())
+
+    def filter(self, predicate: Callable[[pd.DataFrame], pd.Series]
+               ) -> "Table":
+        return self._map(lambda df: df[predicate(df)]
+                         .reset_index(drop=True))
+
+    def clip(self, columns, min=None, max=None) -> "Table":
+        cols = _as_list(columns)
+
+        def f(df):
+            df = df.copy()
+            for c in cols:
+                df[c] = df[c].clip(lower=min, upper=max)
+            return df
+        return self._map(f)
+
+    def log(self, columns, clipping: bool = True) -> "Table":
+        """log(x + 1); clips negatives to 0 first like the reference."""
+        cols = _as_list(columns)
+
+        def f(df):
+            df = df.copy()
+            for c in cols:
+                v = df[c].astype(np.float64)
+                if clipping:
+                    v = v.clip(lower=0)
+                df[c] = np.log1p(v)
+            return df
+        return self._map(f)
+
+    def cast(self, columns, dtype) -> "Table":
+        cols = _as_list(columns)
+
+        def f(df):
+            df = df.copy()
+            for c in cols:
+                df[c] = df[c].astype(dtype)
+            return df
+        return self._map(f)
+
+    def add(self, columns, value=1) -> "Table":
+        cols = _as_list(columns)
+
+        def f(df):
+            df = df.copy()
+            for c in cols:
+                df[c] = df[c] + value
+            return df
+        return self._map(f)
+
+    def apply(self, in_col, out_col, func, dtype=None) -> "Table":
+        in_cols = _as_list(in_col)
+
+        def f(df):
+            df = df.copy()
+            if len(in_cols) == 1:
+                out = df[in_cols[0]].map(func)
+            else:
+                out = df[in_cols].apply(lambda r: func(*r), axis=1)
+            if dtype is not None:
+                out = out.astype(dtype)
+            df[out_col] = out
+            return df
+        return self._map(f)
+
+    def append_column(self, name, value) -> "Table":
+        def f(df):
+            df = df.copy()
+            df[name] = value
+            return df
+        return self._map(f)
+
+    def sample(self, fraction: float, seed=None) -> "Table":
+        return type(self)(self.shards.sample(fraction, seed))
+
+    def drop_duplicates(self, subset=None) -> "Table":
+        local = self._map(
+            lambda df: df.drop_duplicates(subset=_as_list(subset) or None))
+        merged = local.to_pandas().drop_duplicates(
+            subset=_as_list(subset) or None).reset_index(drop=True)
+        return type(self).from_pandas(merged,
+                                      self.shards.num_partitions())
+
+    # -- global stats (reference get_stats/median/min/max) --------------
+
+    def min(self, columns) -> Dict[str, Any]:
+        cols = _as_list(columns)
+        partials = self.shards.transform_shard(
+            lambda df: df[cols].min()).collect()
+        return dict(pd.concat(partials, axis=1).min(axis=1))
+
+    def max(self, columns) -> Dict[str, Any]:
+        cols = _as_list(columns)
+        partials = self.shards.transform_shard(
+            lambda df: df[cols].max()).collect()
+        return dict(pd.concat(partials, axis=1).max(axis=1))
+
+    def median(self, columns) -> Dict[str, float]:
+        """Exact global median (gathers only the requested columns)."""
+        cols = _as_list(columns)
+        vals = self.shards.transform_shard(lambda df: df[cols]).collect()
+        merged = pd.concat(vals, ignore_index=True)
+        return {c: float(merged[c].median()) for c in cols}
+
+    def fill_median(self, columns) -> "Table":
+        med = self.median(columns)
+
+        def f(df):
+            df = df.copy()
+            for c, m in med.items():
+                df[c] = df[c].fillna(m)
+            return df
+        return self._map(f)
+
+    def write_parquet(self, path: str) -> str:
+        import os
+        os.makedirs(path, exist_ok=True)
+        for j, df in enumerate(self.shards.collect()):
+            df.to_parquet(os.path.join(path, f"part-{j:05d}.parquet"))
+        return path
+
+    def show(self, n: int = 20):
+        print(self.shards.get(0).head(n))
+
+
+class StringIndex(Table):
+    """A (value -> contiguous id) mapping table (reference
+    table.py:1930).  Columns: [col_name, "id"]; ids start at 1, matching
+    the reference (0 is reserved for unknown/OOV)."""
+
+    def __init__(self, shards: XShards, col_name: str):
+        super().__init__(shards)
+        self.col_name = col_name
+
+    @classmethod
+    def from_dict(cls, indices: Dict[Any, int], col_name: str):
+        df = pd.DataFrame({col_name: list(indices.keys()),
+                           "id": list(indices.values())})
+        t = Table.from_pandas(df)
+        return cls(t.shards, col_name)
+
+    def to_dict(self) -> Dict[Any, int]:
+        merged = self.to_pandas()
+        return dict(zip(merged[self.col_name], merged["id"]))
+
+    def write_parquet(self, path: str) -> str:
+        import os
+        os.makedirs(path, exist_ok=True)
+        self.to_pandas().to_parquet(
+            os.path.join(path, f"{self.col_name}.parquet"))
+        return path
+
+    @classmethod
+    def read_parquet(cls, path: str, col_name: Optional[str] = None):
+        import glob
+        import os
+        files = sorted(glob.glob(os.path.join(path, "*.parquet")))
+        if col_name is None:
+            col_name = os.path.splitext(os.path.basename(files[0]))[0]
+        df = pd.concat([pd.read_parquet(f) for f in files],
+                       ignore_index=True)
+        return cls(Table.from_pandas(df).shards, col_name)
+
+
+def _hash_bucket(values: pd.Series, bins: int, method: str = "md5"
+                 ) -> pd.Series:
+    hasher = getattr(hashlib, method)
+
+    def h(v):
+        return int(hasher(str(v).encode()).hexdigest(), 16) % bins
+    return values.map(h)
+
+
+class FeatureTable(Table):
+    """Recsys feature ops (reference table.py:714)."""
+
+    # -- string/category encoding --------------------------------------
+
+    def gen_string_idx(self, columns, freq_limit: Optional[int] = None,
+                       order_by_freq: bool = False
+                       ) -> Union[StringIndex, List[StringIndex]]:
+        """Build StringIndex mappings from value frequencies — a global
+        count-reduce over shard partials (reference table.py:1013, the
+        Spark groupBy.count analog)."""
+        cols = _as_list(columns)
+        out = []
+        for c in cols:
+            partials = self.shards.transform_shard(
+                lambda df, c=c: df[c].value_counts()).collect()
+            counts = pd.concat(partials).groupby(level=0).sum()
+            if freq_limit:
+                counts = counts[counts >= freq_limit]
+            if order_by_freq:
+                counts = counts.sort_values(ascending=False)
+            else:
+                counts = counts.sort_index()
+            mapping = {v: j + 1 for j, v in enumerate(counts.index)}
+            out.append(StringIndex.from_dict(mapping, c))
+        return out[0] if len(out) == 1 else out
+
+    def encode_string(self, columns, indices,
+                      keep_most_frequent: bool = False) -> "FeatureTable":
+        """Map string values to ids via StringIndex(es); unseen values
+        get 0 (reference table.py:755)."""
+        cols = _as_list(columns)
+        idxs = indices if isinstance(indices, list) else [indices]
+        maps = {}
+        for c, ix in zip(cols, idxs):
+            maps[c] = ix.to_dict() if isinstance(ix, StringIndex) else ix
+
+        def f(df):
+            df = df.copy()
+            for c in cols:
+                df[c] = df[c].map(maps[c]).fillna(0).astype(np.int64)
+            return df
+        return self._map(f)
+
+    def category_encode(self, columns, freq_limit=None,
+                        order_by_freq=False):
+        """gen_string_idx + encode_string in one call (reference
+        table.py:888).  Returns (encoded_table, indices)."""
+        cols = _as_list(columns)
+        indices = self.gen_string_idx(cols, freq_limit, order_by_freq)
+        idx_list = indices if isinstance(indices, list) else [indices]
+        return self.encode_string(cols, idx_list), indices
+
+    def filter_by_frequency(self, columns, min_freq: int = 2
+                            ) -> "FeatureTable":
+        """Keep rows whose value combination occurs >= min_freq times
+        globally (reference table.py:820)."""
+        cols = _as_list(columns)
+        partials = self.shards.transform_shard(
+            lambda df: df.groupby(cols).size()).collect()
+        counts = pd.concat(partials).groupby(level=list(range(len(cols)))
+                                             ).sum()
+        keep = set(counts[counts >= min_freq].index)
+
+        def f(df):
+            if len(cols) == 1:
+                m = df[cols[0]].isin(keep)
+            else:
+                m = df[cols].apply(tuple, axis=1).isin(keep)
+            return df[m].reset_index(drop=True)
+        return self._map(f)
+
+    def hash_encode(self, columns, bins: int, method: str = "md5"
+                    ) -> "FeatureTable":
+        """Hash-bucket string/int values into [0, bins) (reference
+        table.py:841, Utils.scala hash kernel)."""
+        cols = _as_list(columns)
+
+        def f(df):
+            df = df.copy()
+            for c in cols:
+                df[c] = _hash_bucket(df[c], bins, method)
+            return df
+        return self._map(f)
+
+    def cross_hash_encode(self, columns, bins: int,
+                          cross_col_name: Optional[str] = None,
+                          method: str = "md5") -> "FeatureTable":
+        """Hash the concatenation of several columns into one crossed
+        feature (reference table.py:862)."""
+        cols = _as_list(columns)
+        name = cross_col_name or "_".join(cols)
+
+        def f(df):
+            df = df.copy()
+            joined = df[cols].astype(str).agg("_".join, axis=1)
+            df[name] = _hash_bucket(joined, bins, method)
+            return df
+        return self._map(f)
+
+    # matches the reference's older cross_columns API
+    def cross_columns(self, crossed_columns, bucket_sizes
+                      ) -> "FeatureTable":
+        t = self
+        for cols, bins in zip(crossed_columns, bucket_sizes):
+            t = t.cross_hash_encode(cols, bins)
+        return t
+
+    def one_hot_encode(self, columns, sizes=None, prefix=None
+                       ) -> "FeatureTable":
+        """Expand int columns into 0/1 indicator columns (reference
+        table.py:922)."""
+        cols = _as_list(columns)
+        if sizes is None:
+            sizes = [int(self.max([c])[c]) + 1 for c in cols]
+        sizes = sizes if isinstance(sizes, list) else [sizes]
+        prefixes = _as_list(prefix) or cols
+
+        def f(df):
+            df = df.copy()
+            for c, n, px in zip(cols, sizes, prefixes):
+                v = df[c].astype(int).to_numpy()
+                onehot = np.zeros((len(df), n), np.int8)
+                valid = (v >= 0) & (v < n)
+                onehot[np.arange(len(df))[valid], v[valid]] = 1
+                for j in range(n):
+                    df[f"{px}_{j}"] = onehot[:, j]
+                df = df.drop(columns=[c])
+            return df
+        return self._map(f)
+
+    # -- scaling --------------------------------------------------------
+
+    def min_max_scale(self, columns, min: float = 0.0, max: float = 1.0):
+        """Global min-max scaling; returns (table, {col: (min, max)})
+        (reference table.py:1130)."""
+        cols = _as_list(columns)
+        gmin = self.min(cols)
+        gmax = self.max(cols)
+        stats = {c: (float(gmin[c]), float(gmax[c])) for c in cols}
+
+        def f(df):
+            df = df.copy()
+            for c in cols:
+                lo, hi = stats[c]
+                span = (hi - lo) or 1.0
+                df[c] = (df[c].astype(np.float64) - lo) / span \
+                    * (max - min) + min
+            return df
+        return self._map(f), stats
+
+    def transform_min_max_scale(self, columns, min_max_dict,
+                                min: float = 0.0, max: float = 1.0
+                                ) -> "FeatureTable":
+        """Apply a previously-computed scaling (reference table.py:1206)."""
+        cols = _as_list(columns)
+
+        def f(df):
+            df = df.copy()
+            for c in cols:
+                lo, hi = min_max_dict[c]
+                span = (hi - lo) or 1.0
+                df[c] = (df[c].astype(np.float64) - lo) / span \
+                    * (max - min) + min
+            return df
+        return self._map(f)
+
+    # -- recsys sample generation --------------------------------------
+
+    def add_negative_samples(self, item_size: int, item_col: str = "item",
+                             label_col: str = "label", neg_num: int = 1
+                             ) -> "FeatureTable":
+        """For each positive row, append neg_num rows with random items
+        and label 0 (reference table.py:1263; items indexed from 1)."""
+        def f(df):
+            rng = np.random.default_rng(abs(hash(str(df.index[:1]))) % (2**32)
+                                        if len(df) else 0)
+            pos = df.copy()
+            pos[label_col] = 1
+            negs = []
+            for _ in range(neg_num):
+                neg = df.copy()
+                neg[item_col] = rng.integers(1, item_size + 1, len(df))
+                neg[label_col] = 0
+                negs.append(neg)
+            return pd.concat([pos] + negs, ignore_index=True)
+        return self._map(f)
+
+    def add_hist_seq(self, cols, user_col: str, sort_col: str = "time",
+                     min_len: int = 1, max_len: int = 100
+                     ) -> "FeatureTable":
+        """Per-user rolling history sequences (reference table.py:1277).
+        Repartitions by user first so each user's rows are co-shardent."""
+        cols = _as_list(cols)
+        t = FeatureTable(self.shards.partition_by(user_col))
+
+        def f(df):
+            df = df.sort_values([user_col, sort_col])
+            out_rows = []
+            for _, g in df.groupby(user_col):
+                hist = {c: [] for c in cols}
+                for _, row in g.iterrows():
+                    if len(hist[cols[0]]) >= min_len:
+                        r = row.to_dict()
+                        for c in cols:
+                            r[f"{c}_hist_seq"] = list(
+                                hist[c][-max_len:])
+                        out_rows.append(r)
+                    for c in cols:
+                        hist[c].append(row[c])
+            return pd.DataFrame(out_rows) if out_rows else pd.DataFrame(
+                columns=list(df.columns) + [f"{c}_hist_seq" for c in cols])
+        return FeatureTable(t.shards.transform_shard(f))
+
+    def pad(self, cols, seq_len: int = 100, mask_cols=None
+            ) -> "FeatureTable":
+        """Pad list-valued columns to seq_len (+ optional 0/1 mask
+        columns) (reference table.py:1309,1321)."""
+        cols = _as_list(cols)
+        mask_cols = _as_list(mask_cols)
+
+        def f(df):
+            df = df.copy()
+            for c in cols:
+                padded, masks = [], []
+                for v in df[c]:
+                    v = list(v)[:seq_len]
+                    m = [1] * len(v) + [0] * (seq_len - len(v))
+                    padded.append(v + [0] * (seq_len - len(v)))
+                    masks.append(m)
+                df[c] = padded
+                if c in mask_cols:
+                    df[f"{c}_mask"] = masks
+            return df
+        return self._map(f)
+
+    # -- joins / grouping ----------------------------------------------
+
+    def join(self, other: "Table", on=None, how: str = "inner"
+             ) -> "FeatureTable":
+        """Broadcast-style join: the smaller table is collected to the
+        driver and merged into every shard (reference table.py:1358 with
+        broadcast=True semantics)."""
+        right = other.to_pandas()
+        on_cols = _as_list(on) or None
+        return FeatureTable(self.shards.transform_shard(
+            lambda df: df.merge(right, on=on_cols, how=how)))
+
+    def group_by(self, columns, agg: Union[str, Dict[str, str]] = "count"
+                 ) -> "FeatureTable":
+        """Global groupby-aggregate via local partials + driver reduce
+        (reference table.py:1458)."""
+        cols = _as_list(columns)
+        merged = self.to_pandas()
+        g = merged.groupby(cols)
+        if agg == "count":
+            out = g.size().reset_index(name="count")
+        elif isinstance(agg, dict):
+            out = g.agg(agg).reset_index()
+        else:
+            out = g.agg(agg).reset_index()
+        return FeatureTable.from_pandas(out,
+                                        self.shards.num_partitions())
+
+    def target_encode(self, cat_cols, target_cols, smooth: int = 20
+                      ) -> "FeatureTable":
+        """Mean-target encoding with additive smoothing (reference
+        table.py:1541, simplified: no kfold)."""
+        cat_cols = _as_list(cat_cols)
+        target_cols = _as_list(target_cols)
+        merged = self.to_pandas()
+        out = self
+
+        for c in cat_cols:
+            for t in target_cols:
+                global_mean = merged[t].mean()
+                stats = merged.groupby(c)[t].agg(["mean", "count"])
+                enc = ((stats["mean"] * stats["count"]
+                        + global_mean * smooth)
+                       / (stats["count"] + smooth)).to_dict()
+                name = f"{c}_te_{t}"
+                out = FeatureTable(out.shards.transform_shard(
+                    lambda df, c=c, enc=enc, name=name:
+                    df.assign(**{name: df[c].map(enc)
+                                 .fillna(global_mean)})))
+        return out
+
+    def cut_bins(self, columns, bins, labels=None, out_cols=None,
+                 drop: bool = True) -> "FeatureTable":
+        """Bucketize numeric columns (reference table.py:1849)."""
+        cols = _as_list(columns)
+        out_names = _as_list(out_cols) or [f"{c}_bin" for c in cols]
+
+        def f(df):
+            df = df.copy()
+            for c, o in zip(cols, out_names):
+                df[o] = pd.cut(df[c], bins=bins, labels=labels).cat.codes \
+                    if labels is None else pd.cut(df[c], bins=bins,
+                                                  labels=labels)
+                if drop and o != c:
+                    df = df.drop(columns=[c])
+            return df
+        return self._map(f)
+
+    def split(self, ratio: float, seed: Optional[int] = None):
+        """Random row split into (left, right) with P(left) = ratio
+        (reference table.py:1527)."""
+        def mk(keep_left):
+            def f(df):
+                rng = np.random.default_rng(
+                    (seed or 0) + (abs(hash(str(df.head(1).to_dict())))
+                                   % (2**31)))
+                m = rng.random(len(df)) < ratio
+                return df[m if keep_left else ~m].reset_index(drop=True)
+            return f
+        return (FeatureTable(self.shards.transform_shard(mk(True))),
+                FeatureTable(self.shards.transform_shard(mk(False))))

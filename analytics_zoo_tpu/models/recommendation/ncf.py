@@ -18,8 +18,10 @@ from typing import Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
 
-class NeuralCF(nn.Module):
+
+class NeuralCF(nn.Module, ZooModel):
     user_count: int
     item_count: int
     class_num: int = 2

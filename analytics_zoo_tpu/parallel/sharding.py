@@ -65,14 +65,23 @@ def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
     """Turn a pytree of *process-local* numpy arrays into global sharded
     `jax.Array`s, batch dim split over the data axes.
 
-    Uses `jax.make_array_from_process_local_data`, which on a multi-host pod
-    assembles a global array from each host's local shard (the TPU-native
-    analog of RayXShards' locality-aware partition→actor assignment,
-    pyzoo/zoo/orca/data/ray_xshards.py:252) and degenerates to a plain
-    device_put on one host.
+    Single-host fast path: one asynchronous `jax.device_put` of the whole
+    pytree — the transfer overlaps the previous step's compute, which is
+    what keeps `Estimator.fit` near the raw-loop ceiling (a per-leaf
+    `make_array_from_process_local_data` costs ~10ms/batch of host-side
+    assembly and blocks the pipeline).
+
+    Multi-host: `jax.make_array_from_process_local_data` assembles a global
+    array from each host's local shard (the TPU-native analog of
+    RayXShards' locality-aware partition→actor assignment,
+    pyzoo/zoo/orca/data/ray_xshards.py:252).
     """
     mesh = mesh or OrcaContext.mesh
     sharding = batch_sharding(mesh)
+
+    if jax.process_count() == 1:
+        return jax.device_put(
+            jax.tree_util.tree_map(np.asarray, batch), sharding)
 
     def _one(x):
         x = np.asarray(x)

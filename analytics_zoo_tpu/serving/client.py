@@ -1,0 +1,96 @@
+"""Serving python client (reference: `pyzoo/zoo/serving/client.py` —
+`InputQueue.enqueue/predict` :95,157 and `OutputQueue.dequeue` :247,251).
+
+The reference enqueues base64 payloads into Redis streams; here the wire is
+the serving server's HTTP API with the same usage shape:
+
+    input_q = InputQueue(host, port)
+    input_q.enqueue("my-img", t=np.array(...))      # async
+    out = OutputQueue(host, port).dequeue("my-img")  # poll result
+
+    preds = input_q.predict(np.array(...))           # sync
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.codec import decode_ndarray, encode_ndarray
+
+
+def _post(url: str, payload: Dict[str, Any], timeout: float = 60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        # error responses carry a JSON body ({"error": ...}) — surface it
+        body = e.read()
+        try:
+            return json.loads(body)
+        except Exception:
+            raise e from None
+
+
+def _get(url: str, timeout: float = 60.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class InputQueue:
+    def __init__(self, host: str = "127.0.0.1", port: int = 10020):
+        self.base = f"http://{host}:{port}"
+
+    def predict(self, *inputs: np.ndarray, batched: bool = False):
+        """Synchronous prediction.  By default each input is ONE record
+        (no batch dim) — the server adds it to a dynamic batch; pass
+        batched=True to send pre-batched [n, ...] arrays."""
+        arrays = [np.asarray(a) for a in inputs]
+        if not batched:
+            arrays = [a[None] for a in arrays]
+        resp = _post(f"{self.base}/predict",
+                     {"inputs": [encode_ndarray(a) for a in arrays]})
+        if "error" in resp:
+            raise RuntimeError(f"serving error: {resp['error']}")
+        outs = [decode_ndarray(o) for o in resp["outputs"]]
+        if not batched:
+            outs = [o[0] for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def enqueue(self, uri: str, **inputs) -> str:
+        """Async enqueue of one record (reference InputQueue.enqueue);
+        fetch via OutputQueue.dequeue(uri)."""
+        arrays = [np.asarray(a)[None] for a in inputs.values()]
+        resp = _post(f"{self.base}/enqueue",
+                     {"uri": uri,
+                      "inputs": [encode_ndarray(a) for a in arrays]})
+        if resp.get("status") != "queued":
+            raise RuntimeError(f"enqueue failed: {resp}")
+        return resp["uri"]
+
+
+class OutputQueue:
+    def __init__(self, host: str = "127.0.0.1", port: int = 10020):
+        self.base = f"http://{host}:{port}"
+
+    def dequeue(self, uri: str, timeout: float = 30.0,
+                poll_interval: float = 0.01):
+        """Poll until the async result for `uri` is ready (reference
+        OutputQueue.dequeue over Redis)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            resp = _get(f"{self.base}/result/{uri}")
+            if resp.get("status") == "ok":
+                outs = [decode_ndarray(o)[0] for o in resp["outputs"]]
+                return outs[0] if len(outs) == 1 else tuple(outs)
+            if resp.get("status") == "error":
+                raise RuntimeError(f"serving error: {resp['error']}")
+            time.sleep(poll_interval)
+        raise TimeoutError(f"no result for {uri} within {timeout}s")

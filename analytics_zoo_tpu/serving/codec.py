@@ -1,0 +1,24 @@
+"""ndarray ⇄ JSON wire encoding for serving (reference: the base64 ndarray
+encoding of `pyzoo/zoo/serving/client.py:157` InputQueue.enqueue)."""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict
+
+import numpy as np
+
+
+def encode_ndarray(a: np.ndarray) -> Dict[str, Any]:
+    a = np.ascontiguousarray(a)
+    return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+            "dtype": str(a.dtype), "shape": list(a.shape)}
+
+
+def decode_ndarray(enc: Any) -> np.ndarray:
+    if isinstance(enc, dict) and "b64" in enc:
+        a = np.frombuffer(base64.b64decode(enc["b64"]),
+                          dtype=np.dtype(enc["dtype"]))
+        return a.reshape(enc["shape"]).copy()
+    # plain nested lists are accepted too
+    return np.asarray(enc)

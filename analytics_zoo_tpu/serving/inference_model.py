@@ -1,0 +1,179 @@
+"""InferenceModel — thread-safe concurrent inference over a jitted model.
+
+Reference: `pipeline/inference/InferenceModel.scala` (a blocking queue of
+`supported_concurrent_num` model copies for thread-safe serving) and
+`pyzoo/zoo/pipeline/inference/inference_model.py:24-190` (load/predict
+surface).
+
+TPU-native design: there is ONE set of device-resident params (copying the
+model N times would waste HBM — the JVM needed copies because BigDL layers
+carry mutable scratch; jitted JAX functions are pure).  Concurrency is a
+semaphore bounding in-flight callers, matching the reference's pool
+semantics; XLA serializes the actual device work.
+
+Recompile avoidance: inputs are padded up to power-of-two batch buckets
+(≤ max_batch_size), so any request size hits one of O(log B) compiled
+programs — the reference dodges this with dynamic JVM graphs; XLA needs
+static shapes (SURVEY.md §7 "serving concurrency" hard part).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _bucket(n: int, max_batch: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, max(n, max_batch)) if b > max_batch else b
+
+
+class InferenceModel:
+    """Loadable, thread-safe, jit-compiled predictor."""
+
+    def __init__(self, supported_concurrent_num: int = 4,
+                 max_batch_size: int = 256):
+        self._sem = threading.Semaphore(supported_concurrent_num)
+        self.supported_concurrent_num = supported_concurrent_num
+        self.max_batch_size = max_batch_size
+        self._predict_fn: Optional[Callable] = None
+        self._params = None
+        self._model_state = None
+        self._lock = threading.Lock()
+        self._n_predict = 0
+
+    # ------------------------------------------------------------------
+    # loading (reference: doLoadBigDL/doLoadTF/doLoadOpenVINO... — here
+    # the one engine is jitted JAX)
+    # ------------------------------------------------------------------
+
+    def load_flax(self, module, params, model_state=None):
+        """Serve a flax module with given params."""
+        import jax
+
+        variables = {"params": params, **(model_state or {})}
+        variables = jax.device_put(variables)
+
+        from analytics_zoo_tpu.orca.learn.flax_adapter import _mode_kwarg
+        kw, invert = _mode_kwarg(module)
+        kwargs = {kw: True if invert else False} if kw else {}
+
+        @jax.jit
+        def fn(variables, *feats):
+            return module.apply(variables, *feats, **kwargs)
+
+        self._predict_fn = lambda *feats: fn(variables, *feats)
+        return self
+
+    def load_apply_fn(self, apply_fn: Callable, params, model_state=None):
+        """Serve a pure `apply_fn(params, model_state, features, rng,
+        training)` (the SPMD engine convention)."""
+        import jax
+
+        params = jax.device_put(params)
+        model_state = jax.device_put(model_state or {})
+        rng = jax.random.PRNGKey(0)
+
+        @jax.jit
+        def fn(params, model_state, *feats):
+            preds, _ = apply_fn(params, model_state, feats, rng, False)
+            return preds
+
+        self._predict_fn = lambda *feats: fn(params, model_state, *feats)
+        return self
+
+    def load_model(self, path: str, model_cls=None):
+        """Load a `ZooModel.save_model` directory (reference
+        doLoadModel); `model_cls` overrides the saved class lookup."""
+        import pickle
+        import os
+
+        with open(os.path.join(path, "config.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        with open(os.path.join(path, "weights.pkl"), "rb") as f:
+            saved = pickle.load(f)
+        if model_cls is None:
+            model_cls = _find_zoo_model_class(meta["class"])
+        module = model_cls(**meta["config"])
+        if hasattr(module, "module"):
+            module = module.module()
+        return self.load_flax(module, saved["params"],
+                              saved.get("model_state") or {})
+
+    def load_estimator(self, estimator):
+        """Serve a (possibly still-training) Estimator's current params."""
+        est = estimator
+        est._require_engine()
+        eng = est._engine
+        return self.load_apply_fn(eng.apply_fn, eng.get_params(),
+                                  est.get_model_state())
+
+    # ------------------------------------------------------------------
+    # predict (reference: doPredict through the model pool)
+    # ------------------------------------------------------------------
+
+    def predict(self, *inputs: np.ndarray):
+        """Batched prediction; thread-safe.  Each input is a [n, ...]
+        ndarray; returns ndarray (or tuple) with leading dim n."""
+        if self._predict_fn is None:
+            raise RuntimeError("InferenceModel: no model loaded")
+        inputs = tuple(np.asarray(a) for a in inputs)
+        n = len(inputs[0])
+        if n > self.max_batch_size:
+            # chunk large requests through the buckets
+            parts = [self.predict(*(a[s:s + self.max_batch_size]
+                                    for a in inputs))
+                     for s in range(0, n, self.max_batch_size)]
+            if isinstance(parts[0], tuple):
+                return tuple(np.concatenate([p[i] for p in parts])
+                             for i in range(len(parts[0])))
+            return np.concatenate(parts)
+        target = _bucket(n, self.max_batch_size)
+        padded = tuple(_pad_to(a, target) for a in inputs)
+        with self._sem:
+            out = self._predict_fn(*padded)
+            with self._lock:
+                self._n_predict += n
+        import jax
+        out = jax.device_get(out)
+        if isinstance(out, (tuple, list)):
+            return tuple(np.asarray(o)[:n] for o in out)
+        return np.asarray(out)[:n]
+
+    @property
+    def records_served(self) -> int:
+        return self._n_predict
+
+
+def _pad_to(a: np.ndarray, target: int) -> np.ndarray:
+    if len(a) == target:
+        return a
+    pad = [(0, target - len(a))] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad)
+
+
+def _find_zoo_model_class(name: str):
+    """Resolve a saved ZooModel class name to its class (the model zoo's
+    public namespaces)."""
+    import importlib
+
+    for mod in ("analytics_zoo_tpu.models.recommendation",
+                "analytics_zoo_tpu.models.textclassification",
+                "analytics_zoo_tpu.models.textmatching",
+                "analytics_zoo_tpu.models.seq2seq",
+                "analytics_zoo_tpu.models.anomalydetection",
+                "analytics_zoo_tpu.models.image.imageclassification",
+                "analytics_zoo_tpu.models.bert",
+                "analytics_zoo_tpu.models"):
+        try:
+            m = importlib.import_module(mod)
+        except ImportError:
+            continue
+        if hasattr(m, name):
+            return getattr(m, name)
+    raise ValueError(f"cannot resolve saved model class {name!r}; pass "
+                     "model_cls explicitly")

@@ -1,0 +1,211 @@
+"""Serving HTTP frontend with request batching.
+
+Reference: Cluster Serving's streaming pipeline — `FlinkRedisSource` →
+`FlinkInference.map` (dynamic batching, `ClusterServing.scala:57-70`) →
+`FlinkRedisSink`, with the akka-http frontend (`serving/http/FrontEndApp.scala`).
+
+TPU-native design: one process, no Flink/Redis hop.  A ThreadingHTTPServer
+accepts requests; a single batcher thread drains the request queue, packs
+up to `max_batch_size` single-record payloads into one device batch
+(bounded by `batch_timeout_ms`, the same knob as the reference's batching
+guidance, ClusterServingGuide/ProgrammingGuide.md:254), runs the
+InferenceModel once, and fans results back out to the waiting requests.
+
+Endpoints:
+  POST /predict  — synchronous: {"inputs": [enc, ...]} -> {"outputs": [...]}
+                   where enc is the client's base64 ndarray encoding; a
+                   request may carry one record (joins the dynamic batch)
+                   or a pre-batched array.
+  POST /enqueue  — async: {"uri": id, "inputs": [...]}; result fetched via
+  GET  /result/<uri> — {"status": "pending"|"ok", "outputs": [...]}
+  GET  /healthz  — liveness + records served
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.codec import decode_ndarray, encode_ndarray
+from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+
+class _Pending:
+    __slots__ = ("inputs", "event", "outputs", "error")
+
+    def __init__(self, inputs: Tuple[np.ndarray, ...]):
+        self.inputs = inputs
+        self.event = threading.Event()
+        self.outputs = None
+        self.error: Optional[str] = None
+
+
+class ServingServer:
+    """start() serves until stop(); thread-safe for concurrent clients."""
+
+    def __init__(self, model: InferenceModel, host: str = "127.0.0.1",
+                 port: int = 0, max_batch_size: int = 32,
+                 batch_timeout_ms: float = 5.0):
+        self.model = model
+        self.max_batch_size = max_batch_size
+        self.batch_timeout_s = batch_timeout_ms / 1e3
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self._results: Dict[str, Any] = {}
+        self._results_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._batches_run = 0
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            daemon_threads = True
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _json(self, code: int, payload: Dict[str, Any]):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, {
+                        "status": "ok",
+                        "records_served": server.model.records_served,
+                        "batches_run": server._batches_run})
+                    return
+                if self.path.startswith("/result/"):
+                    uri = self.path[len("/result/"):]
+                    with server._results_lock:
+                        if uri in server._results:
+                            self._json(200, server._results.pop(uri))
+                            return
+                    self._json(200, {"status": "pending"})
+                    return
+                self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(length))
+                except Exception as e:
+                    self._json(400, {"error": f"bad json: {e}"})
+                    return
+                try:
+                    inputs = tuple(decode_ndarray(x)
+                                   for x in req.get("inputs", []))
+                    if not inputs:
+                        raise ValueError("no inputs")
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                if self.path == "/predict":
+                    out, err = server._submit(inputs)
+                    if err:
+                        self._json(500, {"error": err})
+                    else:
+                        self._json(200, {"outputs": [
+                            encode_ndarray(o) for o in out]})
+                    return
+                if self.path == "/enqueue":
+                    uri = req.get("uri") or f"req-{time.monotonic_ns()}"
+                    threading.Thread(
+                        target=server._submit_async, args=(uri, inputs),
+                        daemon=True).start()
+                    self._json(200, {"status": "queued", "uri": uri})
+                    return
+                self._json(404, {"error": "not found"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------------
+
+    def _submit(self, inputs: Tuple[np.ndarray, ...]):
+        """Single-record (or pre-batched) request → joins the dynamic
+        batch; blocks until its results are ready."""
+        p = _Pending(inputs)
+        self._queue.put(p)
+        p.event.wait()
+        return p.outputs, p.error
+
+    def _submit_async(self, uri: str, inputs):
+        out, err = self._submit(inputs)
+        payload = ({"status": "error", "error": err} if err else
+                   {"status": "ok",
+                    "outputs": [encode_ndarray(o) for o in out]})
+        with self._results_lock:
+            self._results[uri] = payload
+
+    def _batcher(self):
+        """Drain the queue into device-batches (the FlinkInference.map
+        analog)."""
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = time.monotonic() + self.batch_timeout_s
+            while len(batch) < self.max_batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self._run_batch(batch)
+
+    def _run_batch(self, batch: List[_Pending]):
+        try:
+            # group by input signature; same-shape single records stack
+            sizes = [len(p.inputs[0]) for p in batch]
+            stacked = tuple(
+                np.concatenate([p.inputs[i] for p in batch])
+                for i in range(len(batch[0].inputs)))
+            outs = self.model.predict(*stacked)
+            self._batches_run += 1
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            off = 0
+            for p, n in zip(batch, sizes):
+                p.outputs = [o[off:off + n] for o in outs]
+                off += n
+                p.event.set()
+        except Exception as e:
+            # heterogenous shapes in one batch: fall back to per-request
+            if len(batch) > 1:
+                for p in batch:
+                    self._run_batch([p])
+                return
+            batch[0].error = f"{type(e).__name__}: {e}"
+            batch[0].event.set()
+
+    # ------------------------------------------------------------------
+
+    def start(self, block: bool = False):
+        t1 = threading.Thread(target=self._batcher, daemon=True)
+        t2 = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t1.start()
+        t2.start()
+        self._threads = [t1, t2]
+        if block:
+            t2.join()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()

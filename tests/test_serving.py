@@ -1,0 +1,177 @@
+"""Serving layer tests (reference test model: the embedded-Redis serving
+specs under zoo/src/test/.../serving/ — here the server runs in-process
+threads, SURVEY.md §4.3 distributed-without-a-cluster)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.serving import (InferenceModel, InputQueue,
+                                       OutputQueue, ServingServer)
+
+
+def _make_model():
+    import flax.linen as nn
+    import jax
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(3)(x)
+
+    m = MLP()
+    params = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))
+    return m, params["params"]
+
+
+@pytest.fixture(scope="module")
+def server():
+    init_orca_context(cluster_mode="local")
+    module, params = _make_model()
+    im = InferenceModel(supported_concurrent_num=4).load_flax(module, params)
+    srv = ServingServer(im, port=0, max_batch_size=16, batch_timeout_ms=3)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_inference_model_predict_matches_direct():
+    module, params = _make_model()
+    im = InferenceModel().load_flax(module, params)
+    x = np.random.default_rng(0).standard_normal((5, 8)).astype(np.float32)
+    out = im.predict(x)
+    direct = np.asarray(module.apply({"params": params}, x))
+    np.testing.assert_allclose(out, direct, atol=1e-6)
+    assert out.shape == (5, 3)
+
+
+def test_inference_model_bucketing_no_recompile():
+    import jax
+
+    module, params = _make_model()
+    im = InferenceModel(max_batch_size=64).load_flax(module, params)
+    rng = np.random.default_rng(1)
+    # sizes 3 and 4 share the 4-bucket; 5..8 share the 8-bucket
+    for n in (3, 4, 5, 7, 8, 64, 130):
+        x = rng.standard_normal((n, 8)).astype(np.float32)
+        out = im.predict(x)
+        assert out.shape == (n, 3)
+    assert im.records_served == 3 + 4 + 5 + 7 + 8 + 64 + 130
+
+
+def test_inference_model_concurrent_consistency():
+    module, params = _make_model()
+    im = InferenceModel(supported_concurrent_num=3).load_flax(module, params)
+    rng = np.random.default_rng(2)
+    xs = [rng.standard_normal((4, 8)).astype(np.float32) for _ in range(16)]
+    expected = [np.asarray(module.apply({"params": params}, x)) for x in xs]
+    results = [None] * len(xs)
+
+    def worker(j):
+        results[j] = im.predict(xs[j])
+
+    threads = [threading.Thread(target=worker, args=(j,))
+               for j in range(len(xs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for r, e in zip(results, expected):
+        np.testing.assert_allclose(r, e, atol=1e-6)
+
+
+def test_inference_model_from_estimator():
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+    module, _ = _make_model()
+    x = np.random.default_rng(3).standard_normal((32, 8)).astype(np.float32)
+    y = np.random.default_rng(3).integers(0, 3, 32).astype(np.int32)
+    est = Estimator.from_flax(module, loss="sparse_categorical_crossentropy",
+                              learning_rate=1e-2)
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=16)
+    im = InferenceModel().load_estimator(est)
+    np.testing.assert_allclose(im.predict(x),
+                               est.predict({"x": x}, batch_size=32),
+                               atol=1e-5)
+
+
+def test_serving_sync_predict(server):
+    module, params = _make_model()
+    client = InputQueue(server.host, server.port)
+    x = np.random.default_rng(4).standard_normal(8).astype(np.float32)
+    out = client.predict(x)
+    assert out.shape == (3,)
+
+
+def test_serving_prebatched_predict(server):
+    client = InputQueue(server.host, server.port)
+    x = np.random.default_rng(5).standard_normal((6, 8)).astype(np.float32)
+    out = client.predict(x, batched=True)
+    assert out.shape == (6, 3)
+
+
+def test_serving_async_enqueue_dequeue(server):
+    iq = InputQueue(server.host, server.port)
+    oq = OutputQueue(server.host, server.port)
+    x = np.random.default_rng(6).standard_normal(8).astype(np.float32)
+    uri = iq.enqueue("test-record-1", t=x)
+    out = oq.dequeue(uri)
+    assert out.shape == (3,)
+
+
+def test_serving_dynamic_batching_and_throughput(server):
+    """Concurrent single-record clients get batched into fewer device
+    calls; everyone gets the right answer."""
+    client = InputQueue(server.host, server.port)
+    rng = np.random.default_rng(7)
+    xs = [rng.standard_normal(8).astype(np.float32) for _ in range(32)]
+    outs = [None] * len(xs)
+
+    def call(j):
+        outs[j] = client.predict(xs[j])
+
+    before = server._batches_run
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=call, args=(j,))
+               for j in range(len(xs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    latency = time.perf_counter() - t0
+    assert all(o is not None and o.shape == (3,) for o in outs)
+    # the batcher must have coalesced at least some requests
+    assert server._batches_run - before < len(xs)
+    assert latency < 30.0
+    # spot-check correctness against a bigger batch round trip
+    stacked = client.predict(np.stack(xs), batched=True)
+    for j in (0, 7, 31):
+        np.testing.assert_allclose(outs[j], stacked[j], atol=1e-6)
+
+
+def test_serving_error_reporting(server):
+    client = InputQueue(server.host, server.port)
+    with pytest.raises(RuntimeError, match="serving error"):
+        # wrong feature width -> model apply fails, error surfaces
+        client.predict(np.zeros(5, np.float32))
+
+
+def test_inference_model_load_saved_zoo_model(tmp_path):
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    init_orca_context(cluster_mode="local")
+    model = NeuralCF(user_count=50, item_count=30)
+    rng = np.random.default_rng(8)
+    u = rng.integers(1, 51, 64).astype(np.int32)
+    i = rng.integers(1, 31, 64).astype(np.int32)
+    y = ((u + i) % 2).astype(np.int32)
+    model.fit({"x": [u, i], "y": y}, epochs=1, batch_size=32)
+    path = model.save_model(str(tmp_path / "ncf"))
+    im = InferenceModel().load_model(path)
+    out = im.predict(u, i)
+    assert out.shape == (64, 2)
+    direct = model.predict({"x": [u, i]})
+    np.testing.assert_allclose(out, direct, atol=1e-5)
