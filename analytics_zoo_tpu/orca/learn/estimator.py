@@ -157,9 +157,7 @@ class Estimator:
         val_ds = (HostDataset.from_data(validation_data, feature_cols,
                                         label_cols)
                   if validation_data is not None else None)
-        first = next(ds.batches(min(batch_size, max(1, ds.n)),
-                                pad_to_multiple_of=1))
-        self._ensure_engine(first)
+        self._ensure_engine(ds.probe(batch_size))
         eng = self._engine
         trigger = checkpoint_trigger
         if trigger is None and self.model_dir:
@@ -201,13 +199,11 @@ class Estimator:
     def evaluate(self, data, batch_size: int = 32,
                  feature_cols=None, label_cols=None) -> Dict[str, float]:
         ds = HostDataset.from_data(data, feature_cols, label_cols)
-        if not ds.labels:
+        if not ds.has_labels:
             raise ValueError(
                 "evaluate requires labels: pass {'x': ..., 'y': ...}, an "
                 "(x, y) tuple, or label_cols for DataFrame input")
-        first = next(ds.batches(min(batch_size, max(1, ds.n)),
-                                pad_to_multiple_of=1))
-        self._ensure_engine(first)
+        self._ensure_engine(ds.probe(batch_size))
         return self._engine.run_epoch(
             ds.batches(batch_size,
                        pad_to_multiple_of=self._engine.pad_multiple()),
@@ -217,9 +213,7 @@ class Estimator:
         """Returns stacked predictions (numpy).  For XShards/DataFrame input
         the row order of the input is preserved."""
         ds = HostDataset.from_data(data, feature_cols, None)
-        first = next(ds.batches(min(batch_size, max(1, ds.n)),
-                                pad_to_multiple_of=1))
-        self._ensure_engine(first)
+        self._ensure_engine(ds.probe(batch_size))
         outs = self._engine.predict_all(
             ds.batches(batch_size,
                        pad_to_multiple_of=self._engine.pad_multiple()))

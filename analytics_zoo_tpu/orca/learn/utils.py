@@ -11,11 +11,21 @@ parallelism *for XLA sharding*, so instead of constraining the user we
 pad the final partial batch and carry an explicit `mask` column that the
 loss/metrics consume — static shapes for XLA, exact results for the user
 (SURVEY.md §7 "hard parts": global-batch ↔ per-host shard math).
+
+XShards input STREAMS: shards are pulled one at a time (with a depth-2
+background loader overlapping disk/pickle IO with device compute), rows
+re-chunked into fixed-size batches with carry-over, so the DISK storage
+tier (FeatureSet.scala:557 DiskFeatureSet analog) holds at most a couple of
+shards in RAM end to end — the estimator never materializes the dataset.
+Shuffling is two-level (shard order + within shard), the streaming analog
+of the reference's RDD-partition shuffle.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -41,9 +51,10 @@ def _stack_cols(df, cols: Sequence[str]) -> Tuple[np.ndarray, ...]:
 
 
 class HostDataset:
-    """The host-resident, already-merged (features, labels) arrays this
-    process will feed to its devices.  One instance per fit/evaluate/predict
-    call; the TPU-native stand-in for FeatureSet's cached RDD partitions."""
+    """The host-resident (features, labels) view this process feeds to its
+    devices.  One instance per fit/evaluate/predict call; the TPU-native
+    stand-in for FeatureSet's cached RDD partitions.  Array-backed by
+    default; `from_data` returns a streaming subclass for XShards input."""
 
     def __init__(self, features: Tuple[np.ndarray, ...],
                  labels: Tuple[np.ndarray, ...]):
@@ -57,18 +68,19 @@ class HostDataset:
                   label_cols: Optional[Sequence[str]] = None) -> "HostDataset":
         """Accepts: dict {"x": ndarray(s), "y": ndarray(s)} (the reference
         XShards convention), (x, y) tuples, bare ndarrays/tuples (no labels),
-        pandas DataFrames (+feature_cols/label_cols), or XShards of any of
-        those."""
+        pandas DataFrames (+feature_cols/label_cols), XShards of any of
+        those (streamed, never materialized), or a zero-arg callable
+        returning any of the above (the reference's data-creator-fn
+        convention, tf2/estimator.py)."""
         import pandas as pd
 
+        if callable(data) and not isinstance(data, (XShards, pd.DataFrame)):
+            data = data()
+
         if isinstance(data, XShards):
-            shards = data.collect()
-            if not shards:
+            if data.num_partitions() == 0:
                 raise ValueError("empty XShards")
-            if isinstance(shards[0], pd.DataFrame):
-                data = pd.concat(shards, ignore_index=True)
-            else:
-                data = _concat_shards(shards)
+            return _StreamingHostDataset(data, feature_cols, label_cols)
 
         if isinstance(data, pd.DataFrame):
             if not feature_cols:
@@ -90,6 +102,18 @@ class HostDataset:
 
         return HostDataset(_np_tuple(data), ())
 
+    # ------------------------------------------------------------------
+
+    @property
+    def has_labels(self) -> bool:
+        return bool(self.labels)
+
+    def probe(self, batch_size: int) -> Dict[str, Any]:
+        """A first batch for engine bring-up (shape/dtype probe) without
+        touching more than the head of the dataset."""
+        return next(self.batches(min(batch_size, max(1, self.n)),
+                                 pad_to_multiple_of=1))
+
     def batches(self, batch_size: int, *, shuffle: bool = False,
                 seed: int = 0, pad_to_multiple_of: int = 1,
                 epoch: int = 0) -> Iterator[Dict[str, Any]]:
@@ -108,6 +132,158 @@ class HostDataset:
 
     def steps_per_epoch(self, batch_size: int) -> int:
         return max(1, int(np.ceil(self.n / batch_size)))
+
+
+class _StreamingHostDataset(HostDataset):
+    """HostDataset over XShards that never concatenates the dataset: shards
+    stream through `batches()` one at a time (DISK-tier shards are unpickled
+    on a background loader thread, depth 2, overlapping IO with compute) and
+    rows are re-chunked into fixed-size batches with carry-over."""
+
+    def __init__(self, xshards: XShards,
+                 feature_cols: Optional[Sequence[str]],
+                 label_cols: Optional[Sequence[str]]):
+        self._xs = xshards
+        self._fc = feature_cols
+        self._lc = label_cols
+        self._n: Optional[int] = None
+        self._first: Optional[Tuple[Tuple, Tuple]] = None
+
+    # -- row count: lazy; set as a side effect of the first full pass ----
+    @property
+    def n(self) -> int:
+        if self._n is None:
+            total = 0
+            for feats, _ in self._shard_iter(np.arange(self._num_shards())):
+                total += len(feats[0]) if feats else 0
+            self._n = total
+        return self._n
+
+    @property
+    def has_labels(self) -> bool:
+        return bool(self._head()[1])
+
+    @property
+    def features(self):  # head shard's features (shape/dtype probing only)
+        return self._head()[0]
+
+    @property
+    def labels(self):
+        return self._head()[1]
+
+    def _head(self):
+        if self._first is None:
+            self._first = self._extract(self._xs._store.get(0))
+        return self._first
+
+    def probe(self, batch_size: int) -> Dict[str, Any]:
+        feats, labels = self._head()
+        k = min(batch_size, len(feats[0]))
+        return pad_batch(tuple(a[:k] for a in feats),
+                         tuple(a[:k] for a in labels), k, 1)
+
+    def _num_shards(self) -> int:
+        return self._xs.num_partitions()
+
+    def _extract(self, shard) -> Tuple[Tuple[np.ndarray, ...],
+                                       Tuple[np.ndarray, ...]]:
+        import pandas as pd
+
+        if isinstance(shard, pd.DataFrame):
+            if not self._fc:
+                raise ValueError("feature_cols required for DataFrame shards")
+            feats = _stack_cols(shard, self._fc)
+            labels = (_stack_cols(shard, _as_tuple(self._lc))
+                      if self._lc else ())
+            return feats, labels
+        if isinstance(shard, dict):
+            x = shard.get("x")
+            if x is None:
+                raise ValueError('dict shards must have an "x" key')
+            return _np_tuple(x), _np_tuple(shard.get("y"))
+        if isinstance(shard, tuple) and len(shard) == 2:
+            return _np_tuple(shard[0]), _np_tuple(shard[1])
+        return _np_tuple(shard), ()
+
+    def _shard_iter(self, order: np.ndarray):
+        """Yield extracted shards in `order`, loading one ahead on a
+        background thread (pickle/pandas IO releases the GIL; the device
+        upload itself stays on the caller thread — see SPMDEngine._prefetch)."""
+        q: "queue.Queue" = queue.Queue(maxsize=2)
+        _END, _ERR = object(), object()
+
+        def loader():
+            try:
+                for i in order:
+                    q.put(self._extract(self._xs._store.get(int(i))))
+                q.put(_END)
+            except BaseException as e:  # surface on the consumer thread
+                q.put((_ERR, e))
+
+        t = threading.Thread(target=loader, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+                raise item[1]
+            yield item
+        t.join()
+
+    def batches(self, batch_size: int, *, shuffle: bool = False,
+                seed: int = 0, pad_to_multiple_of: int = 1,
+                epoch: int = 0) -> Iterator[Dict[str, Any]]:
+        order = np.arange(self._num_shards())
+        rng = np.random.default_rng(seed + epoch) if shuffle else None
+        if rng is not None:
+            rng.shuffle(order)
+
+        # carry-over row buffer: list of (feats, labels) chunks
+        chunks: List[Tuple[Tuple, Tuple]] = []
+        buffered = 0
+        total = 0
+
+        def drain(target: int):
+            """Pop exactly `target` rows off the front of the buffer."""
+            nonlocal buffered
+            feats_parts, label_parts, got = [], [], 0
+            while got < target:
+                f, l = chunks[0]
+                take = min(target - got, len(f[0]))
+                feats_parts.append(tuple(a[:take] for a in f))
+                label_parts.append(tuple(a[:take] for a in l))
+                if take == len(f[0]):
+                    chunks.pop(0)
+                else:
+                    chunks[0] = (tuple(a[take:] for a in f),
+                                 tuple(a[take:] for a in l))
+                got += take
+            buffered -= target
+            feats = tuple(np.concatenate([p[i] for p in feats_parts])
+                          for i in range(len(feats_parts[0])))
+            labels = tuple(np.concatenate([p[i] for p in label_parts])
+                           for i in range(len(label_parts[0])))
+            return feats, labels
+
+        for feats, labels in self._shard_iter(order):
+            nrows = len(feats[0]) if feats else 0
+            if nrows == 0:
+                continue
+            if rng is not None:
+                perm = rng.permutation(nrows)
+                feats = tuple(a[perm] for a in feats)
+                labels = tuple(a[perm] for a in labels)
+            chunks.append((feats, labels))
+            buffered += nrows
+            total += nrows
+            while buffered >= batch_size:
+                f, l = drain(batch_size)
+                yield pad_batch(f, l, batch_size, pad_to_multiple_of)
+        if buffered:
+            f, l = drain(buffered)
+            yield pad_batch(f, l, batch_size, pad_to_multiple_of)
+        self._n = total
 
 
 def _np_tuple(x) -> Tuple[np.ndarray, ...]:
