@@ -28,6 +28,25 @@ def _as_list(x) -> List[str]:
     return list(x)
 
 
+def _shard_dataframe(df: pd.DataFrame, num_shards: Optional[int] = None
+                     ) -> XShards:
+    """Row-range split a DataFrame into XShards of DataFrames (NOT
+    XShards.partition, which flattens to ndarray leaves)."""
+    import math
+
+    from analytics_zoo_tpu.common.context import OrcaContext
+    from analytics_zoo_tpu.orca.data.shard import _pool_size
+    if num_shards is None:
+        if OrcaContext.shard_size:
+            num_shards = max(1, math.ceil(len(df) / OrcaContext.shard_size))
+        else:
+            num_shards = _pool_size()
+    num_shards = max(1, min(num_shards, max(1, len(df))))
+    bounds = np.linspace(0, len(df), num_shards + 1).astype(int)
+    return XShards([df.iloc[bounds[i]:bounds[i + 1]].reset_index(drop=True)
+                    for i in range(num_shards)])
+
+
 class Table:
     """Base distributed table: XShards of pandas DataFrames."""
 
@@ -36,11 +55,16 @@ class Table:
             raise TypeError(f"expected XShards, got {type(shards)}")
         self.shards = shards
 
+    def _new(self, shards: XShards) -> "Table":
+        """Rebuild the same table type around new shards (subclasses with
+        extra constructor state override this)."""
+        return type(self)(shards)
+
     # -- construction ---------------------------------------------------
 
     @classmethod
     def from_pandas(cls, df: pd.DataFrame, num_shards: Optional[int] = None):
-        return cls(XShards.partition(df, num_shards))
+        return cls(_shard_dataframe(df, num_shards))
 
     @classmethod
     def from_shards(cls, shards: XShards):
@@ -59,7 +83,7 @@ class Table:
     # -- basic ops (reference Table :103-711) ---------------------------
 
     def _map(self, fn: Callable[[pd.DataFrame], pd.DataFrame]) -> "Table":
-        return type(self)(self.shards.transform_shard(fn))
+        return self._new(self.shards.transform_shard(fn))
 
     def compute(self) -> "Table":
         self.shards.collect()
@@ -77,7 +101,7 @@ class Table:
 
     @property
     def columns(self) -> List[str]:
-        return list(self.shards.get(0).columns)
+        return list(self.shards.get_shard(0).columns)
 
     def select(self, *cols) -> "Table":
         cols = [c for group in cols for c in _as_list(group)]
@@ -111,8 +135,8 @@ class Table:
         # local dedup per shard, then a global pass on the driver
         local = self._map(lambda df: df.drop_duplicates())
         merged = local.to_pandas().drop_duplicates().reset_index(drop=True)
-        return type(self).from_pandas(merged,
-                                      self.shards.num_partitions())
+        return self._new(_shard_dataframe(merged,
+                                          self.shards.num_partitions()))
 
     def filter(self, predicate: Callable[[pd.DataFrame], pd.Series]
                ) -> "Table":
@@ -193,8 +217,8 @@ class Table:
             lambda df: df.drop_duplicates(subset=_as_list(subset) or None))
         merged = local.to_pandas().drop_duplicates(
             subset=_as_list(subset) or None).reset_index(drop=True)
-        return type(self).from_pandas(merged,
-                                      self.shards.num_partitions())
+        return self._new(_shard_dataframe(merged,
+                                          self.shards.num_partitions()))
 
     # -- global stats (reference get_stats/median/min/max) --------------
 
@@ -209,6 +233,16 @@ class Table:
         partials = self.shards.transform_shard(
             lambda df: df[cols].max()).collect()
         return dict(pd.concat(partials, axis=1).max(axis=1))
+
+    def min_max(self, columns):
+        """Global (min, max) dicts in ONE pass over the shards (the DISK
+        tier unpickles every shard per pass, so combined beats min()+max())."""
+        cols = _as_list(columns)
+        partials = self.shards.transform_shard(
+            lambda df: df[cols].agg(["min", "max"])).collect()
+        lo = pd.concat([p.loc["min"] for p in partials], axis=1).min(axis=1)
+        hi = pd.concat([p.loc["max"] for p in partials], axis=1).max(axis=1)
+        return dict(lo), dict(hi)
 
     def median(self, columns) -> Dict[str, float]:
         """Exact global median (gathers only the requested columns)."""
@@ -235,7 +269,7 @@ class Table:
         return path
 
     def show(self, n: int = 20):
-        print(self.shards.get(0).head(n))
+        print(self.shards.get_shard(0).head(n))
 
 
 class StringIndex(Table):
@@ -246,6 +280,9 @@ class StringIndex(Table):
     def __init__(self, shards: XShards, col_name: str):
         super().__init__(shards)
         self.col_name = col_name
+
+    def _new(self, shards: XShards) -> "StringIndex":
+        return StringIndex(shards, self.col_name)
 
     @classmethod
     def from_dict(cls, indices: Dict[Any, int], col_name: str):
@@ -423,8 +460,7 @@ class FeatureTable(Table):
         """Global min-max scaling; returns (table, {col: (min, max)})
         (reference table.py:1130)."""
         cols = _as_list(columns)
-        gmin = self.min(cols)
-        gmax = self.max(cols)
+        gmin, gmax = self.min_max(cols)
         stats = {c: (float(gmin[c]), float(gmax[c])) for c in cols}
 
         def f(df):
@@ -459,10 +495,13 @@ class FeatureTable(Table):
                              label_col: str = "label", neg_num: int = 1
                              ) -> "FeatureTable":
         """For each positive row, append neg_num rows with random items
-        and label 0 (reference table.py:1263; items indexed from 1)."""
-        def f(df):
-            rng = np.random.default_rng(abs(hash(str(df.index[:1]))) % (2**32)
-                                        if len(df) else 0)
+        and label 0 (reference table.py:1263; items indexed from 1).
+        Each shard draws from an independent spawned RNG stream."""
+        seeds = np.random.SeedSequence(0).spawn(
+            self.shards.num_partitions())
+
+        def f(i, df):
+            rng = np.random.default_rng(seeds[i])
             pos = df.copy()
             pos[label_col] = 1
             negs = []
@@ -472,7 +511,7 @@ class FeatureTable(Table):
                 neg[label_col] = 0
                 negs.append(neg)
             return pd.concat([pos] + negs, ignore_index=True)
-        return self._map(f)
+        return FeatureTable(self.shards.transform_shard_with_index(f))
 
     def add_hist_seq(self, cols, user_col: str, sort_col: str = "time",
                      min_len: int = 1, max_len: int = 100
@@ -526,13 +565,43 @@ class FeatureTable(Table):
 
     def join(self, other: "Table", on=None, how: str = "inner"
              ) -> "FeatureTable":
-        """Broadcast-style join: the smaller table is collected to the
+        """Broadcast-style join: the right table is collected to the
         driver and merged into every shard (reference table.py:1358 with
-        broadcast=True semantics)."""
+        broadcast=True semantics).  For right/outer joins the unmatched
+        right rows are appended exactly once (per-shard merges would
+        duplicate them once per shard)."""
+        import itertools
+
         right = other.to_pandas()
         on_cols = _as_list(on) or None
-        return FeatureTable(self.shards.transform_shard(
-            lambda df: df.merge(right, on=on_cols, how=how)))
+        if how in ("inner", "left"):
+            return FeatureTable(self.shards.transform_shard(
+                lambda df: df.merge(right, on=on_cols, how=how)))
+        if how not in ("right", "outer"):
+            raise ValueError(f"unsupported join type: {how!r}")
+
+        left_cols = self.columns
+        keys = on_cols or [c for c in left_cols if c in right.columns]
+        per_shard = "left" if how == "outer" else "inner"
+        merged = self.shards.transform_shard(
+            lambda df: df.merge(right, on=keys, how=per_shard))
+        # right rows matched by NO left row, appended once as an extra shard
+        matched = pd.concat(self.shards.transform_shard(
+            lambda df: df[keys].drop_duplicates()).collect()
+        ).drop_duplicates()
+        flagged = right.merge(matched, on=keys, how="left", indicator=True)
+        unmatched = flagged[flagged["_merge"] == "left_only"].drop(
+            columns="_merge")
+        if len(unmatched):
+            # non-key columns shared with the left get pandas' "_y" suffix
+            # in the merge output; rename so reindex keeps their values
+            unmatched = unmatched.rename(columns={
+                c: f"{c}_y" for c in right.columns
+                if c not in keys and c in left_cols})
+            out_cols = list(merged.get_shard(0).columns)
+            extra = unmatched.reindex(columns=out_cols)
+            merged = XShards(itertools.chain(merged._store.iter(), [extra]))
+        return FeatureTable(merged)
 
     def group_by(self, columns, agg: Union[str, Dict[str, str]] = "count"
                  ) -> "FeatureTable":
@@ -547,8 +616,8 @@ class FeatureTable(Table):
             out = g.agg(agg).reset_index()
         else:
             out = g.agg(agg).reset_index()
-        return FeatureTable.from_pandas(out,
-                                        self.shards.num_partitions())
+        return FeatureTable(_shard_dataframe(out,
+                                             self.shards.num_partitions()))
 
     def target_encode(self, cat_cols, target_cols, smooth: int = 20
                       ) -> "FeatureTable":
@@ -575,16 +644,29 @@ class FeatureTable(Table):
 
     def cut_bins(self, columns, bins, labels=None, out_cols=None,
                  drop: bool = True) -> "FeatureTable":
-        """Bucketize numeric columns (reference table.py:1849)."""
+        """Bucketize numeric columns (reference table.py:1849).  An integer
+        `bins` is resolved to GLOBAL equal-width edges first — per-shard
+        min/max would put the same value in different buckets on different
+        shards."""
         cols = _as_list(columns)
         out_names = _as_list(out_cols) or [f"{c}_bin" for c in cols]
+        if isinstance(bins, int):
+            gmin, gmax = self.min_max(cols)
+            edges = {}
+            for c in cols:
+                lo, hi = float(gmin[c]), float(gmax[c])
+                if lo == hi:  # constant column: one bucket, no dup edges
+                    lo, hi = lo - 0.5, hi + 0.5
+                edges[c] = np.linspace(lo, hi, bins + 1)
+        else:
+            edges = {c: bins for c in cols}
 
         def f(df):
             df = df.copy()
             for c, o in zip(cols, out_names):
-                df[o] = pd.cut(df[c], bins=bins, labels=labels).cat.codes \
-                    if labels is None else pd.cut(df[c], bins=bins,
-                                                  labels=labels)
+                cut = pd.cut(df[c], bins=edges[c], labels=labels,
+                             include_lowest=True)
+                df[o] = cut.cat.codes if labels is None else cut
                 if drop and o != c:
                     df = df.drop(columns=[c])
             return df
@@ -592,14 +674,19 @@ class FeatureTable(Table):
 
     def split(self, ratio: float, seed: Optional[int] = None):
         """Random row split into (left, right) with P(left) = ratio
-        (reference table.py:1527)."""
+        (reference table.py:1527).  Per-shard RNG streams are spawned from
+        `seed` (SeedSequence), so the split is reproducible across
+        processes and the two halves are exact complements."""
+        seeds = np.random.SeedSequence(seed or 0).spawn(
+            self.shards.num_partitions())
+
         def mk(keep_left):
-            def f(df):
-                rng = np.random.default_rng(
-                    (seed or 0) + (abs(hash(str(df.head(1).to_dict())))
-                                   % (2**31)))
+            def f(i, df):
+                rng = np.random.default_rng(seeds[i])
                 m = rng.random(len(df)) < ratio
                 return df[m if keep_left else ~m].reset_index(drop=True)
             return f
-        return (FeatureTable(self.shards.transform_shard(mk(True))),
-                FeatureTable(self.shards.transform_shard(mk(False))))
+        return (FeatureTable(self.shards.transform_shard_with_index(
+                    mk(True))),
+                FeatureTable(self.shards.transform_shard_with_index(
+                    mk(False))))

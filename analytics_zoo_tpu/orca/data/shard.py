@@ -158,6 +158,17 @@ class XShards:
         mapped = _parallel_map(lambda s: func(s, *args), self._store.iter())
         return XShards(mapped)
 
+    def transform_shard_with_index(self, func: Callable) -> "XShards":
+        """Apply `func(index, shard)` to every shard — for transforms that
+        need a stable per-shard identity (e.g. independent RNG streams)."""
+        mapped = _parallel_map(lambda t: func(t[0], t[1]),
+                               enumerate(self._store.iter()))
+        return XShards(mapped)
+
+    def get_shard(self, i: int) -> Any:
+        """Fetch a single shard (loads from spill under the DISK tier)."""
+        return self._store.get(i)
+
     def collect(self) -> List[Any]:
         return self._store.all()
 

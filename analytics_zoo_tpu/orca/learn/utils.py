@@ -208,28 +208,46 @@ class _StreamingHostDataset(HostDataset):
     def _shard_iter(self, order: np.ndarray):
         """Yield extracted shards in `order`, loading one ahead on a
         background thread (pickle/pandas IO releases the GIL; the device
-        upload itself stays on the caller thread — see SPMDEngine._prefetch)."""
+        upload itself stays on the caller thread — see SPMDEngine._prefetch).
+        If the consumer abandons the generator mid-epoch, the `finally`
+        sets `stop` so the loader exits instead of blocking on q.put
+        forever holding shard memory."""
         q: "queue.Queue" = queue.Queue(maxsize=2)
+        stop = threading.Event()
         _END, _ERR = object(), object()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def loader():
             try:
                 for i in order:
-                    q.put(self._extract(self._xs._store.get(int(i))))
-                q.put(_END)
+                    if not put(self._extract(self._xs._store.get(int(i)))):
+                        return
+                put(_END)
             except BaseException as e:  # surface on the consumer thread
-                q.put((_ERR, e))
+                put((_ERR, e))
 
         t = threading.Thread(target=loader, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
-                raise item[1]
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if (isinstance(item, tuple) and len(item) == 2
+                        and item[0] is _ERR):
+                    raise item[1]
+                yield item
+        finally:
+            stop.set()
+            t.join()
 
     def batches(self, batch_size: int, *, shuffle: bool = False,
                 seed: int = 0, pad_to_multiple_of: int = 1,

@@ -51,12 +51,20 @@ class ServingServer:
 
     def __init__(self, model: InferenceModel, host: str = "127.0.0.1",
                  port: int = 0, max_batch_size: int = 32,
-                 batch_timeout_ms: float = 5.0):
+                 batch_timeout_ms: float = 5.0,
+                 result_ttl_s: float = 600.0, max_results: int = 10_000):
         self.model = model
         self.max_batch_size = max_batch_size
         self.batch_timeout_s = batch_timeout_ms / 1e3
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
-        self._results: Dict[str, Any] = {}
+        # async results are evicted after result_ttl_s or when the store
+        # exceeds max_results (oldest first) — abandoned uris must not
+        # accumulate forever in a long-running server.  Evicted uris leave
+        # a bounded tombstone so pollers see "expired", not "pending".
+        self._results: Dict[str, Tuple[float, Any]] = {}
+        self._expired: Dict[str, float] = {}
+        self._result_ttl_s = result_ttl_s
+        self._max_results = max_results
         self._results_lock = threading.Lock()
         self._stop = threading.Event()
         self._batches_run = 0
@@ -88,7 +96,10 @@ class ServingServer:
                     uri = self.path[len("/result/"):]
                     with server._results_lock:
                         if uri in server._results:
-                            self._json(200, server._results.pop(uri))
+                            self._json(200, server._results.pop(uri)[1])
+                            return
+                        if uri in server._expired:
+                            self._json(200, {"status": "expired"})
                             return
                     self._json(200, {"status": "pending"})
                     return
@@ -145,8 +156,20 @@ class ServingServer:
         payload = ({"status": "error", "error": err} if err else
                    {"status": "ok",
                     "outputs": [encode_ndarray(o) for o in out]})
+        now = time.monotonic()
         with self._results_lock:
-            self._results[uri] = payload
+            for k in [k for k, (t, _) in self._results.items()
+                      if now - t > self._result_ttl_s]:
+                del self._results[k]
+                self._expired[k] = now
+            while len(self._results) >= self._max_results:
+                # dicts iterate in insertion order: evict the oldest
+                k = next(iter(self._results))
+                del self._results[k]
+                self._expired[k] = now
+            while len(self._expired) > self._max_results:
+                del self._expired[next(iter(self._expired))]
+            self._results[uri] = (now, payload)
 
     def _batcher(self):
         """Drain the queue into device-batches (the FlinkInference.map
