@@ -50,6 +50,8 @@ class OrcaContextMeta(type):
     _shard_size = None
     _log_output = False
     _train_data_store = "DRAM"
+    _failure_retry_times = 5
+    _failure_retry_interval_s = 1.0
 
     # --- TPU runtime state ---
     _mesh = None
@@ -114,6 +116,32 @@ class OrcaContextMeta(type):
         if value != "DRAM" and not value.startswith("DISK"):
             raise ValueError("train_data_store must be 'DRAM' or 'DISK_n'")
         cls._train_data_store = value
+
+    @property
+    def failure_retry_times(cls):
+        """How many times Estimator.fit restores the latest checkpoint and
+        resumes after a training failure (reference: `bigdl.failure.
+        retryTimes` sysprop driving the retry loop in
+        Topology.scala:1255-1310)."""
+        return cls._failure_retry_times
+
+    @failure_retry_times.setter
+    def failure_retry_times(cls, value):
+        if int(value) < 0:
+            raise ValueError("failure_retry_times must be >= 0")
+        cls._failure_retry_times = int(value)
+
+    @property
+    def failure_retry_interval_s(cls):
+        """Seconds to wait between failure retries (reference:
+        `bigdl.failure.retryTimeInterval`)."""
+        return cls._failure_retry_interval_s
+
+    @failure_retry_interval_s.setter
+    def failure_retry_interval_s(cls, value):
+        if float(value) < 0:
+            raise ValueError("failure_retry_interval_s must be >= 0")
+        cls._failure_retry_interval_s = float(value)
 
     @property
     def mesh(cls):

@@ -244,8 +244,7 @@ class XShards:
         n_parts = self.num_partitions()
         child_seeds = np.random.SeedSequence(seed).spawn(n_parts)
 
-        def _s(item):
-            i, shard = item
+        def _s(i, shard):
             rng = np.random.default_rng(child_seeds[i])
             if _is_array_like(shard):
                 flat, rebuild = _flatten(shard)
@@ -254,9 +253,7 @@ class XShards:
                 return rebuild([a[idx] for a in flat])
             return shard.sample(frac=frac,
                                 random_state=int(rng.integers(0, 2**31)))
-        # stream (index, shard) pairs so DISK-tier datasets never fully
-        # materialize and no intermediate store is written
-        return XShards(_parallel_map(_s, enumerate(self._store.iter())))
+        return self.transform_shard_with_index(_s)
 
     def __len__(self) -> int:
         total = 0

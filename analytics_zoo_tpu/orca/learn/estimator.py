@@ -21,11 +21,19 @@ reference's Estimators over XShards/Spark DataFrames.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class NaNLossError(RuntimeError):
+    """Raised under nan_policy='raise' when a training epoch hit
+    non-finite loss/gradients (the skipped steps are reported)."""
 
 from analytics_zoo_tpu.common.context import OrcaContext
 from analytics_zoo_tpu.orca.learn import losses as losses_mod
@@ -63,6 +71,8 @@ class Estimator:
         self.train_summary: List[Dict[str, Any]] = []
         self.val_summary: List[Dict[str, Any]] = []
         self._epoch = 0
+        #: failure-retry count across fit calls (observability)
+        self.retries = 0
 
     # ------------------------------------------------------------------
     # factories
@@ -152,17 +162,55 @@ class Estimator:
             label_cols: Optional[Sequence[str]] = None,
             validation_data=None,
             checkpoint_trigger: Optional[Trigger] = None,
-            shuffle: bool = True) -> "Estimator":
+            shuffle: bool = True,
+            nan_policy: str = "warn",
+            max_failures: Optional[int] = None) -> "Estimator":
+        """Train for `epochs`.  On a training failure the latest checkpoint
+        under `model_dir` is restored and training resumes, up to
+        `max_failures` times (default `OrcaContext.failure_retry_times`) —
+        the reference's DP-1 retry loop (Topology.scala:1255-1310,
+        `bigdl.failure.retryTimes`).  Steps with non-finite loss/gradients
+        are skipped on-device; `nan_policy` is "warn" (log and continue)
+        or "raise" (abort the fit with NaNLossError)."""
+        if nan_policy not in ("warn", "raise"):
+            raise ValueError("nan_policy must be 'warn' or 'raise'")
         ds = HostDataset.from_data(data, feature_cols, label_cols)
         val_ds = (HostDataset.from_data(validation_data, feature_cols,
                                         label_cols)
                   if validation_data is not None else None)
         self._ensure_engine(ds.probe(batch_size))
-        eng = self._engine
         trigger = checkpoint_trigger
         if trigger is None and self.model_dir:
             trigger = EveryEpoch()
+        start_epoch = self._epoch
+        start_step = int(np.asarray(self._engine.state.step))
+        target_epoch = self._epoch + epochs
+        retries_left = (OrcaContext.failure_retry_times
+                        if max_failures is None else max_failures)
 
+        while self._epoch < target_epoch:
+            try:
+                self._fit_one_epoch(ds, val_ds, batch_size, trigger,
+                                    shuffle, nan_policy)
+            except (NaNLossError, KeyboardInterrupt):
+                raise
+            except Exception as e:
+                if retries_left <= 0 or not self.model_dir:
+                    raise
+                retries_left -= 1
+                self.retries += 1
+                logger.warning(
+                    "training failed (%s: %s); restoring latest checkpoint "
+                    "and retrying (%d retries left)",
+                    type(e).__name__, e, retries_left)
+                time.sleep(OrcaContext.failure_retry_interval_s)
+                self._restore_latest(ds, batch_size, start_epoch,
+                                     start_step, target_epoch)
+        return self
+
+    def _fit_one_epoch(self, ds, val_ds, batch_size, trigger, shuffle,
+                       nan_policy):
+        eng = self._engine
         mult = eng.pad_multiple()
 
         def on_step(step):
@@ -171,30 +219,57 @@ class Estimator:
                     epoch=self._epoch, step=step, epoch_end=False):
                 self.save_checkpoint()
 
-        for _ in range(epochs):
-            t0 = time.time()
-            stats = eng.run_epoch(
-                ds.batches(batch_size, shuffle=shuffle, seed=self._seed,
-                           pad_to_multiple_of=mult, epoch=self._epoch),
-                train=True, on_step=on_step)
-            self._epoch += 1
-            if trigger is not None and hasattr(trigger, "last_loss"):
-                trigger.last_loss = stats.get("loss")
-            step = int(np.asarray(eng.state.step))
-            stats.update(epoch=self._epoch, step=step,
-                         wall_s=time.time() - t0,
-                         samples_per_s=ds.n / max(time.time() - t0, 1e-9))
-            self.train_summary.append(stats)
-            if val_ds is not None:
-                vstats = eng.run_epoch(
-                    val_ds.batches(batch_size, pad_to_multiple_of=mult),
-                    train=False)
-                vstats.update(epoch=self._epoch, step=step)
-                self.val_summary.append(vstats)
-            if trigger and self.model_dir and trigger(
-                    epoch=self._epoch, step=step, epoch_end=True):
-                self.save_checkpoint()
-        return self
+        t0 = time.time()
+        stats = eng.run_epoch(
+            ds.batches(batch_size, shuffle=shuffle, seed=self._seed,
+                       pad_to_multiple_of=mult, epoch=self._epoch),
+            train=True, on_step=on_step)
+        self._epoch += 1
+        if trigger is not None and hasattr(trigger, "last_loss"):
+            trigger.last_loss = stats.get("loss")
+        step = int(np.asarray(eng.state.step))
+        stats.update(epoch=self._epoch, step=step,
+                     wall_s=time.time() - t0,
+                     samples_per_s=ds.n / max(time.time() - t0, 1e-9))
+        self.train_summary.append(stats)
+        if val_ds is not None:
+            vstats = eng.run_epoch(
+                val_ds.batches(batch_size,
+                               pad_to_multiple_of=eng.pad_multiple()),
+                train=False)
+            vstats.update(epoch=self._epoch, step=step)
+            self.val_summary.append(vstats)
+        if trigger and self.model_dir and trigger(
+                epoch=self._epoch, step=step, epoch_end=True):
+            self.save_checkpoint()
+        # epoch bookkeeping (summary, checkpoint) is complete before a NaN
+        # abort, so a caller catching NaNLossError sees consistent state;
+        # the offending steps themselves never touched the params
+        if stats.get("nan_steps"):
+            msg = (f"{int(stats['nan_steps'])} training step(s) in epoch "
+                   f"{self._epoch} had non-finite loss/gradients and were "
+                   "skipped")
+            if nan_policy == "raise":
+                raise NaNLossError(msg)
+            logger.warning(msg)
+
+    def _restore_latest(self, ds, batch_size, start_epoch, start_step,
+                        target_epoch):
+        """Rewind to the newest checkpoint under model_dir (or keep the
+        in-memory state if none was written yet) and recompute the epoch
+        cursor from the steps taken SINCE THIS fit CALL began — older
+        checkpoints may have been written under a different batch size or
+        dataset, so their absolute step counts don't map to our epochs."""
+        from analytics_zoo_tpu.orca.learn.checkpoint import (
+            find_latest_checkpoint)
+        try:
+            ckpt = find_latest_checkpoint(self.model_dir)
+        except (FileNotFoundError, OSError):
+            return  # nothing written yet: retry from current state
+        self.load(ckpt)
+        step = int(np.asarray(self._engine.state.step))
+        done = max(0, step - start_step) // ds.steps_per_epoch(batch_size)
+        self._epoch = min(start_epoch + done, target_epoch - 1)
 
     def evaluate(self, data, batch_size: int = 32,
                  feature_cols=None, label_cols=None) -> Dict[str, float]:

@@ -130,6 +130,9 @@ class ServingServer:
                     return
                 if self.path == "/enqueue":
                     uri = req.get("uri") or f"req-{time.monotonic_ns()}"
+                    with server._results_lock:
+                        # a re-used uri must not inherit a stale tombstone
+                        server._expired.pop(uri, None)
                     threading.Thread(
                         target=server._submit_async, args=(uri, inputs),
                         daemon=True).start()
@@ -169,6 +172,7 @@ class ServingServer:
                 self._expired[k] = now
             while len(self._expired) > self._max_results:
                 del self._expired[next(iter(self._expired))]
+            self._expired.pop(uri, None)
             self._results[uri] = (now, payload)
 
     def _batcher(self):
