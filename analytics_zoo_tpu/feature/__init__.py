@@ -1,0 +1,18 @@
+"""Feature pipelines (L3'): chainable Preprocessing transformers plus the
+ImageSet / TextSet domain pipelines (reference: pyzoo/zoo/feature/)."""
+
+from analytics_zoo_tpu.feature.common import (
+    ArrayToTensor,
+    ChainedPreprocessing,
+    FeatureLabelPreprocessing,
+    Preprocessing,
+    ScalarToTensor,
+    SeqToTensor,
+    TensorToSample,
+)
+
+__all__ = [
+    "Preprocessing", "ChainedPreprocessing", "ScalarToTensor",
+    "SeqToTensor", "ArrayToTensor", "FeatureLabelPreprocessing",
+    "TensorToSample",
+]

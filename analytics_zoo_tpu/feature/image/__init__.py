@@ -1,0 +1,21 @@
+"""Image pipeline: ImageSet + numpy/OpenCV transforms
+(reference: pyzoo/zoo/feature/image/)."""
+
+from analytics_zoo_tpu.feature.image.imageset import ImageSet
+from analytics_zoo_tpu.feature.image.transforms import (
+    ImageBrightness,
+    ImageCenterCrop,
+    ImageChannelNormalize,
+    ImageHFlip,
+    ImageMatToTensor,
+    ImagePixelNormalize,
+    ImageRandomCrop,
+    ImageResize,
+    ImageSetToSample,
+)
+
+__all__ = [
+    "ImageSet", "ImageResize", "ImageBrightness", "ImageChannelNormalize",
+    "ImagePixelNormalize", "ImageCenterCrop", "ImageRandomCrop",
+    "ImageHFlip", "ImageMatToTensor", "ImageSetToSample",
+]

@@ -1,0 +1,6 @@
+"""Text pipeline: TextSet tokenize/normalize/index
+(reference: pyzoo/zoo/feature/text/)."""
+
+from analytics_zoo_tpu.feature.text.text_set import TextSet
+
+__all__ = ["TextSet"]

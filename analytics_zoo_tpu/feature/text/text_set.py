@@ -1,0 +1,244 @@
+"""TextSet — sharded text records with the tokenize → normalize →
+word2idx → shape_sequence → generate_sample pipeline.
+
+Reference: `pyzoo/zoo/feature/text/text_set.py` (tokenize:203,
+normalize:213, word2idx:224 with remove_topN/max_words_num/min_freq/
+existing_map, shape_sequence:273, generate_sample:286, read:302 reading
+class folders, random_split:193) over scala `TextSet.scala` transformers.
+
+TPU-native design: records are dicts {"text", "tokens", "indices",
+"label", "uri"} in XShards; word2idx is a global frequency reduce over
+shard partials (the Spark `reduceByKey` analog); `to_dataset()` emits the
+{"x", "y"} convention consumed by `Estimator.fit`.  Indices start at 1 —
+0 is the pad id, matching the reference (`word2idx` doc: index 0 reserved
+for padding)."""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import string
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.orca.data.shard import XShards
+
+_TOKEN_RE = re.compile(r"\s+")
+_PUNCT_TABLE = str.maketrans("", "", string.punctuation)
+
+
+class TextSet:
+    """Sharded text corpus."""
+
+    def __init__(self, shards: XShards,
+                 word_index: Optional[Dict[str, int]] = None):
+        self.shards = shards
+        self._word_index = word_index
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def from_texts(cls, texts: Sequence[str],
+                   labels: Optional[Sequence[int]] = None,
+                   num_shards: Optional[int] = None) -> "TextSet":
+        records = [{"text": t, "uri": str(i)} for i, t in enumerate(texts)]
+        if labels is not None:
+            for r, y in zip(records, labels):
+                r["label"] = int(y)
+        n = num_shards or min(len(records), 8)
+        bounds = np.linspace(0, len(records), n + 1).astype(int)
+        return cls(XShards([records[bounds[i]:bounds[i + 1]]
+                            for i in range(n)]))
+
+    @classmethod
+    def read(cls, path: str, num_shards: Optional[int] = None) -> "TextSet":
+        """Read class-folder text files: path/<category>/<file>.txt, one
+        text per file, labeled by sorted folder order (reference
+        text_set.py:302)."""
+        texts, labels = [], []
+        classes = sorted(d for d in os.listdir(path)
+                         if os.path.isdir(os.path.join(path, d)))
+        for i, c in enumerate(classes):
+            for f in sorted(os.listdir(os.path.join(path, c))):
+                with open(os.path.join(path, c, f), encoding="utf-8",
+                          errors="replace") as fh:
+                    texts.append(fh.read())
+                labels.append(i)
+        if not texts:
+            raise FileNotFoundError(f"no text files under {path}")
+        return cls.from_texts(texts, labels, num_shards)
+
+    @classmethod
+    def read_csv(cls, path: str, num_shards: Optional[int] = None
+                 ) -> "TextSet":
+        """uri,text[,label] rows (reference text_set.py:332)."""
+        import pandas as pd
+        df = pd.read_csv(path)
+        ts = cls.from_texts(df.iloc[:, 1].astype(str).tolist(),
+                            df.iloc[:, 2].tolist() if df.shape[1] > 2
+                            else None, num_shards)
+        uris = df.iloc[:, 0].astype(str).tolist()
+
+        def set_uri(shard):
+            for r in shard:
+                r["uri"] = uris[int(r["uri"])]
+            return shard
+        return TextSet(ts.shards.transform_shard(set_uri))
+
+    # -- pipeline -------------------------------------------------------
+
+    def transform(self, transformer) -> "TextSet":
+        return TextSet(
+            self.shards.transform_shard(
+                lambda shard: [transformer.apply(r) for r in shard]),
+            self._word_index)
+
+    def tokenize(self) -> "TextSet":
+        """Whitespace tokenization (reference :203)."""
+        def f(shard):
+            return [{**r, "tokens": _TOKEN_RE.split(r["text"].strip())}
+                    for r in shard]
+        return TextSet(self.shards.transform_shard(f), self._word_index)
+
+    def normalize(self) -> "TextSet":
+        """Lower-case and strip punctuation per token (reference :213)."""
+        def f(shard):
+            return [{**r, "tokens": [
+                t.translate(_PUNCT_TABLE).lower()
+                for t in r["tokens"] if t.translate(_PUNCT_TABLE)]}
+                for r in shard]
+        return TextSet(self.shards.transform_shard(f), self._word_index)
+
+    def word2idx(self, remove_topN: int = 0, max_words_num: int = -1,
+                 min_freq: int = 1,
+                 existing_map: Optional[Dict[str, int]] = None
+                 ) -> "TextSet":
+        """Build the vocabulary from global token frequencies and map
+        tokens to indices (reference :224).  Words are ranked by
+        descending frequency; the `remove_topN` most frequent are dropped;
+        at most `max_words_num` kept; ids start at 1 (0 = padding);
+        `existing_map` words keep their given ids and new words extend."""
+        partials = self.shards.transform_shard(
+            lambda shard: Counter(
+                t for r in shard for t in r["tokens"])).collect()
+        freq = Counter()
+        for p in partials:
+            freq.update(p)
+        ranked = [w for w, c in freq.most_common() if c >= min_freq]
+        ranked = ranked[remove_topN:]
+        if max_words_num > 0:
+            ranked = ranked[:max_words_num]
+        if existing_map:
+            word_index = dict(existing_map)
+            nxt = max(word_index.values(), default=0) + 1
+            for w in ranked:
+                if w not in word_index:
+                    word_index[w] = nxt
+                    nxt += 1
+        else:
+            word_index = {w: i + 1 for i, w in enumerate(ranked)}
+
+        def f(shard):
+            return [{**r, "indices": np.asarray(
+                [word_index[t] for t in r["tokens"] if t in word_index],
+                np.int32)} for r in shard]
+        return TextSet(self.shards.transform_shard(f), word_index)
+
+    def shape_sequence(self, len: int, trunc_mode: str = "pre",
+                       pad_element: int = 0) -> "TextSet":
+        """Pad (post) / truncate to a fixed length (reference :273;
+        trunc_mode "pre" keeps the LAST `len` tokens, "post" the first)."""
+        target = len
+        if trunc_mode not in ("pre", "post"):
+            raise ValueError("trunc_mode must be 'pre' or 'post'")
+
+        def f(shard):
+            out = []
+            for r in shard:
+                idx = np.asarray(r["indices"], np.int32)
+                if idx.shape[0] > target:
+                    idx = idx[-target:] if trunc_mode == "pre" \
+                        else idx[:target]
+                elif idx.shape[0] < target:
+                    idx = np.concatenate([
+                        idx, np.full(target - idx.shape[0], pad_element,
+                                     np.int32)])
+                out.append({**r, "indices": idx})
+            return out
+        return TextSet(self.shards.transform_shard(f), self._word_index)
+
+    def generate_sample(self) -> "TextSet":
+        """Materialize {"x", "y"} per record (reference :286)."""
+        def f(shard):
+            return [{**r, "sample":
+                     {"x": r["indices"],
+                      **({"y": r["label"]} if "label" in r else {})}}
+                    for r in shard]
+        return TextSet(self.shards.transform_shard(f), self._word_index)
+
+    # -- vocab ----------------------------------------------------------
+
+    def get_word_index(self) -> Optional[Dict[str, int]]:
+        return self._word_index
+
+    def save_word_index(self, path: str):
+        with open(path, "w") as f:
+            json.dump(self._word_index, f)
+
+    @classmethod
+    def load_word_index(cls, path: str) -> Dict[str, int]:
+        with open(path) as f:
+            return json.load(f)
+
+    def set_word_index(self, vocab: Dict[str, int]) -> "TextSet":
+        return TextSet(self.shards, dict(vocab))
+
+    # -- access ---------------------------------------------------------
+
+    def get_texts(self) -> List[str]:
+        return [r["text"] for s in self.shards.collect() for r in s]
+
+    def get_labels(self) -> List[int]:
+        return [r.get("label") for s in self.shards.collect() for r in s]
+
+    def get_samples(self) -> List[Dict]:
+        return [r["sample"] for s in self.shards.collect() for r in s]
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.shards.collect())
+
+    def random_split(self, weights: Sequence[float], seed: int = 0
+                     ) -> List["TextSet"]:
+        """Split records by weighted random assignment (reference :193)."""
+        w = np.asarray(weights, np.float64)
+        w = w / w.sum()
+        seeds = np.random.SeedSequence(seed).spawn(
+            self.shards.num_partitions())
+        splits: List[List] = [[] for _ in w]
+
+        def assign(i, shard):
+            rng = np.random.default_rng(seeds[i])
+            draws = rng.choice(np.arange(w.size), size=len(shard), p=w)
+            return [(int(d), r) for d, r in zip(draws, shard)]
+
+        for shard in self.shards.transform_shard_with_index(
+                assign).collect():
+            for d, r in shard:
+                splits[d].append(r)
+        return [TextSet(XShards([part]) if part else XShards([[]]),
+                        self._word_index) for part in splits]
+
+    def to_dataset(self) -> XShards:
+        """Lower to XShards of {"x": [n, len] int32, "y": labels} for
+        `Estimator.fit`."""
+        def pack(shard):
+            xs = np.stack([np.asarray(r["indices"], np.int32)
+                           for r in shard])
+            out = {"x": xs}
+            if shard and "label" in shard[0]:
+                out["y"] = np.asarray([r["label"] for r in shard])
+            return out
+        return self.shards.transform_shard(pack)

@@ -1,0 +1,20 @@
+"""Image dataset writers/loaders: parquet, TFRecord, MNIST, VOC
+(reference: pyzoo/zoo/orca/data/image/)."""
+
+from analytics_zoo_tpu.orca.data.image.parquet_dataset import (
+    ParquetDataset,
+    read_parquet_as_xshards,
+    write_from_directory,
+    write_mnist,
+    write_parquet,
+    write_voc,
+)
+from analytics_zoo_tpu.orca.data.image.tfrecord_dataset import (
+    TFRecordDataset,
+)
+
+__all__ = [
+    "ParquetDataset", "TFRecordDataset", "write_parquet",
+    "write_from_directory", "write_mnist", "write_voc",
+    "read_parquet_as_xshards",
+]

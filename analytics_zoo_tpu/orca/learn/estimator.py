@@ -183,13 +183,18 @@ class Estimator:
         if trigger is None and self.model_dir:
             trigger = EveryEpoch()
         start_epoch = self._epoch
-        start_step = int(np.asarray(self._engine.state.step))
         target_epoch = self._epoch + epochs
         retries_left = (OrcaContext.failure_retry_times
                         if max_failures is None else max_failures)
+        pending_restore = False
 
         while self._epoch < target_epoch:
             try:
+                if pending_restore:
+                    # inside the try: a still-broken checkpoint/data source
+                    # must consume retry budget, not escape the loop
+                    self._restore_latest(start_epoch, target_epoch)
+                    pending_restore = False
                 self._fit_one_epoch(ds, val_ds, batch_size, trigger,
                                     shuffle, nan_policy)
             except (NaNLossError, KeyboardInterrupt):
@@ -204,8 +209,7 @@ class Estimator:
                     "and retrying (%d retries left)",
                     type(e).__name__, e, retries_left)
                 time.sleep(OrcaContext.failure_retry_interval_s)
-                self._restore_latest(ds, batch_size, start_epoch,
-                                     start_step, target_epoch)
+                pending_restore = True
         return self
 
     def _fit_one_epoch(self, ds, val_ds, batch_size, trigger, shuffle,
@@ -253,13 +257,14 @@ class Estimator:
                 raise NaNLossError(msg)
             logger.warning(msg)
 
-    def _restore_latest(self, ds, batch_size, start_epoch, start_step,
-                        target_epoch):
+    def _restore_latest(self, start_epoch, target_epoch):
         """Rewind to the newest checkpoint under model_dir (or keep the
-        in-memory state if none was written yet) and recompute the epoch
-        cursor from the steps taken SINCE THIS fit CALL began — older
-        checkpoints may have been written under a different batch size or
-        dataset, so their absolute step counts don't map to our epochs."""
+        in-memory state if none was written yet).  The epoch cursor comes
+        from the checkpoint's sidecar metadata — inferring it from step
+        counts is wrong once steps have been re-run after an earlier
+        failure, or when older checkpoints used a different batch size."""
+        import json
+
         from analytics_zoo_tpu.orca.learn.checkpoint import (
             find_latest_checkpoint)
         try:
@@ -267,9 +272,13 @@ class Estimator:
         except (FileNotFoundError, OSError):
             return  # nothing written yet: retry from current state
         self.load(ckpt)
-        step = int(np.asarray(self._engine.state.step))
-        done = max(0, step - start_step) // ds.steps_per_epoch(batch_size)
-        self._epoch = min(start_epoch + done, target_epoch - 1)
+        epoch = start_epoch
+        try:
+            with open(ckpt + ".meta.json") as f:
+                epoch = int(json.load(f)["epoch"])
+        except (FileNotFoundError, OSError, KeyError, ValueError):
+            pass  # pre-metadata checkpoint: re-run from this fit's start
+        self._epoch = min(max(epoch, start_epoch), target_epoch - 1)
 
     def evaluate(self, data, batch_size: int = 32,
                  feature_cols=None, label_cols=None) -> Dict[str, float]:
@@ -348,11 +357,16 @@ class Estimator:
     def save_checkpoint(self) -> str:
         """Write a step-versioned checkpoint under model_dir (reference
         checkpoint_trigger semantics, orca/learn/trigger.py + tf/estimator.py
-        save path)."""
+        save path).  A sidecar records the epoch cursor so failure
+        restores resume the correct epoch."""
+        import json
         self._require_engine()
         step = int(np.asarray(self._engine.state.step))
         path = os.path.join(self.model_dir, f"ckpt-{step}")
-        return self.save(path)
+        self.save(path)
+        with open(path + ".meta.json", "w") as f:
+            json.dump({"epoch": self._epoch, "step": step}, f)
+        return path
 
     def load_orca_checkpoint(self, path: str, version: Optional[int] = None):
         """Resume from the latest (or a specific `version`) checkpoint in a

@@ -228,7 +228,12 @@ class SPMDEngine:
         totals = jax.device_get(totals)
         count = float(totals.pop("_count"))
         nan_steps = float(totals.pop("_nan_steps", 0.0))
-        out = {k: float(v) / max(count, 1.0) for k, v in totals.items()}
+        if count == 0.0 and nan_steps:
+            # EVERY step was skipped: loss/metrics are undefined, not 0.0 —
+            # a 0.0 here would masquerade as perfect convergence
+            out = {k: float("nan") for k in totals}
+        else:
+            out = {k: float(v) / max(count, 1.0) for k, v in totals.items()}
         if nan_steps:
             out["nan_steps"] = nan_steps
         return out

@@ -132,7 +132,9 @@ class ServingServer:
                     uri = req.get("uri") or f"req-{time.monotonic_ns()}"
                     with server._results_lock:
                         # a re-used uri must not inherit a stale tombstone
+                        # or a previous request's still-unfetched result
                         server._expired.pop(uri, None)
+                        server._results.pop(uri, None)
                     threading.Thread(
                         target=server._submit_async, args=(uri, inputs),
                         daemon=True).start()
