@@ -71,11 +71,7 @@ class ImageSet:
                     records.append({"uri": os.path.join(path, f)})
         if not records:
             raise FileNotFoundError(f"no images under {path}")
-
-        n = num_shards or min(len(records), 8)
-        bounds = np.linspace(0, len(records), n + 1).astype(int)
-        shards = XShards([records[bounds[i]:bounds[i + 1]]
-                          for i in range(n)])
+        shards = XShards.from_records(records, num_shards)
 
         def load(shard):
             out = []
@@ -99,10 +95,7 @@ class ImageSet:
         if labels is not None:
             for r, y in zip(records, labels):
                 r["label"] = y
-        n = num_shards or min(len(records), 8)
-        bounds = np.linspace(0, len(records), n + 1).astype(int)
-        return cls(XShards([records[bounds[i]:bounds[i + 1]]
-                            for i in range(n)]))
+        return cls(XShards.from_records(records, num_shards))
 
     # -- api ------------------------------------------------------------
 

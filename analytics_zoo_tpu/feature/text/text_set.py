@@ -48,10 +48,7 @@ class TextSet:
         if labels is not None:
             for r, y in zip(records, labels):
                 r["label"] = int(y)
-        n = num_shards or min(len(records), 8)
-        bounds = np.linspace(0, len(records), n + 1).astype(int)
-        return cls(XShards([records[bounds[i]:bounds[i + 1]]
-                            for i in range(n)]))
+        return cls(XShards.from_records(records, num_shards))
 
     @classmethod
     def read(cls, path: str, num_shards: Optional[int] = None) -> "TextSet":

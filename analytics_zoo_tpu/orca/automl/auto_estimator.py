@@ -9,6 +9,38 @@ from typing import Any, Callable, Dict, Optional
 from analytics_zoo_tpu.orca.automl.search_engine import SearchEngine, Trial
 
 
+class _EstimatorTrainable:
+    """Picklable trainable (the Ray-Tune-trainable analog): module-level
+    class so the process backend can ship it to spawned workers; the
+    model/data creators themselves must be picklable for that path."""
+
+    def __init__(self, model_creator, data, val, metric, batch_size,
+                 feature_cols, label_cols, fit_kwargs):
+        self.model_creator = model_creator
+        self.data = data
+        self.val = val
+        self.metric = metric
+        self.batch_size = batch_size
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self.fit_kwargs = fit_kwargs
+
+    def __call__(self, config, state, add_epochs):
+        est = state if state is not None else self.model_creator(config)
+        bs = int(config.get("batch_size", self.batch_size))
+        est.fit(self.data, epochs=add_epochs, batch_size=bs,
+                feature_cols=self.feature_cols,
+                label_cols=self.label_cols, **self.fit_kwargs)
+        stats = est.evaluate(self.val, batch_size=bs,
+                             feature_cols=self.feature_cols,
+                             label_cols=self.label_cols)
+        if self.metric not in stats:
+            raise KeyError(
+                f"metric '{self.metric}' not in evaluate() stats "
+                f"{sorted(stats)}")
+        return est, stats[self.metric]
+
+
 class AutoEstimator:
     """`model_creator(config) -> Estimator` (an
     analytics_zoo_tpu.orca.learn.Estimator, or anything with
@@ -38,31 +70,36 @@ class AutoEstimator:
     def fit(self, data, *, validation_data=None, search_space: Dict,
             n_sampling: int = 4, epochs: int = 1, batch_size: int = 32,
             grace_epochs: int = 1, feature_cols=None, label_cols=None,
+            parallelism: int = 1, backend: str = "thread",
             **fit_kwargs):
+        """Run the search.  `parallelism`/`backend` control concurrent
+        trials (reference: Ray Tune runs trials as concurrent actors,
+        ray_tune_search_engine.py:29-345); with backend="process" the
+        creators must be picklable."""
         val = validation_data if validation_data is not None else data
-
-        def trainable(config, state, add_epochs):
-            est = state
-            if est is None:
-                est = self.model_creator(config)
-            bs = int(config.get("batch_size", batch_size))
-            est.fit(data, epochs=add_epochs, batch_size=bs,
-                    feature_cols=feature_cols, label_cols=label_cols,
-                    **fit_kwargs)
-            stats = est.evaluate(val, batch_size=bs,
-                                 feature_cols=feature_cols,
-                                 label_cols=label_cols)
-            if self.metric not in stats:
-                raise KeyError(
-                    f"metric '{self.metric}' not in evaluate() stats "
-                    f"{sorted(stats)}")
-            return est, stats[self.metric]
+        trainable = _EstimatorTrainable(
+            self.model_creator, data, val, self.metric, batch_size,
+            feature_cols, label_cols, fit_kwargs)
 
         self._engine = SearchEngine(
             trainable, search_space, metric_mode=self.metric_mode,
             n_sampling=n_sampling, epochs=epochs,
-            grace_epochs=grace_epochs)
+            grace_epochs=grace_epochs, parallelism=parallelism,
+            backend=backend)
         self.best_trial = self._engine.run()
+        if parallelism > 1 and backend == "process":
+            # the engine raises if export failed; estimator-convention
+            # exports rebuild locally with the trained weights staged,
+            # raw picklable states pass through unchanged
+            kind, payload = self.best_trial.state
+            if kind == "estimator":
+                est = self.model_creator(self.best_trial.config)
+                params, model_state = payload
+                est._params = params
+                est._model_state = model_state
+                self.best_trial.state = est
+            else:
+                self.best_trial.state = payload
         return self
 
     def get_best_model(self):

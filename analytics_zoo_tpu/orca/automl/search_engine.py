@@ -2,25 +2,44 @@
 /root/reference/pyzoo/zoo/orca/automl/search/ray_tune/ray_tune_search_engine.py
 — Ray Tune trials over the RayOnSpark cluster).
 
-TPU-native re-design: TPU chips cannot be fractionally shared the way Tune
-oversubscribes CPUs (SURVEY.md §7 hard parts), so trials are scheduled
-*sequentially on the chip* (or the local device set) with successive-halving
-early stopping (ASHA-style rungs): every trial trains to the first rung,
-only the top 1/eta advance to the next, etc.  This preserves Tune's
-sample-efficiency levers (random + grid sampling, early stopping, metric
-modes) without a cluster scheduler.  On a pod, each host can run its own
-engine over a disjoint sample shard (slice-level placement).
+TPU-native re-design: trials run under successive-halving early stopping
+(ASHA-style rungs): every trial trains to the first rung, only the top
+1/eta advance to the next, etc.  This preserves Tune's sample-efficiency
+levers (random + grid sampling, early stopping, metric modes) without a
+cluster scheduler.
+
+Concurrency (`parallelism=N`): a TPU chip cannot be fractionally shared
+the way Tune oversubscribes CPUs (SURVEY.md §7 hard parts), so parallel
+trials target the HOST's cores, not the chip:
+
+* `backend="thread"` — trials share this process; XLA releases the GIL
+  during compute, so CPU-compiled trials genuinely overlap.  Zero
+  serialization requirements on the trainable.
+* `backend="process"` — Ray-actor analog: persistent spawned workers,
+  each owning a fixed subset of trials for the whole search (state never
+  crosses the process boundary until the final export).  Workers force
+  `JAX_PLATFORMS=cpu` so they never fight over the TPU.  The trainable
+  must be picklable (module-level function/class), the same contract Ray
+  Tune puts on trainables.
+
+A trial whose train call raises is marked NaN and culled at the next rung
+(the reference's Tune marks such trials ERROR); if every trial fails the
+search raises.
 """
 
 from __future__ import annotations
 
 import itertools
+import logging
+import math
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from analytics_zoo_tpu.orca.automl import hp as hp_mod
+
+logger = logging.getLogger("analytics_zoo_tpu")
 
 
 @dataclass
@@ -31,10 +50,57 @@ class Trial:
     metric_history: List[float] = field(default_factory=list)
     epochs_trained: int = 0
     stopped: bool = False
+    error: Optional[str] = None
 
     @property
     def best_metric(self):
         return self.metric_history[-1] if self.metric_history else None
+
+
+def _process_worker_main(conn, trainable):
+    """Persistent trial worker (spawned process).  Owns the states of its
+    assigned trials; never ships them back except on explicit export.
+    JAX_PLATFORMS=cpu is exported by the PARENT around spawn — jax
+    captures the env at import (during child bootstrap), so setting it
+    here would be too late."""
+    states: Dict[int, Any] = {}
+    while True:
+        msg = conn.recv()
+        if msg[0] == "train":
+            _, tid, config, add = msg
+            try:
+                state, metric = trainable(config, states.get(tid), add)
+                states[tid] = state
+                conn.send(("ok", tid, float(metric)))
+            except Exception as e:  # report, don't kill the worker
+                conn.send(("err", tid, f"{type(e).__name__}: {e}"))
+        elif msg[0] == "export":
+            tid = msg[1]
+            est = states.get(tid)
+            payload, err = None, None
+            if est is None:
+                err = "trial state missing in worker"
+            elif hasattr(est, "get_model"):
+                # orca Estimator convention: numpy (params, model_state)
+                try:
+                    payload = ("estimator",
+                               (est.get_model(), est.get_model_state()))
+                except Exception as e:
+                    err = f"get_model export failed: {e}"
+            else:
+                payload = ("raw", est)  # picklable-or-bust generic state
+            try:
+                conn.send(("state", tid, payload, err))
+            except Exception as e:  # unpicklable raw state
+                conn.send(("state", tid, None,
+                           f"state not picklable: {e}"))
+        elif msg[0] == "free":
+            # culled trial: drop its model from worker memory (the Ray
+            # Tune analog terminates dead trial actors)
+            states.pop(msg[1], None)
+        elif msg[0] == "stop":
+            conn.close()
+            return
 
 
 class SearchEngine:
@@ -45,18 +111,25 @@ class SearchEngine:
     def __init__(self, trainable: Callable, search_space: Dict[str, Any],
                  metric_mode: str = "min", n_sampling: int = 4,
                  epochs: int = 1, grace_epochs: int = 1, eta: int = 2,
-                 seed: int = 0):
+                 seed: int = 0, parallelism: int = 1,
+                 backend: str = "thread"):
         self.trainable = trainable
         self.search_space = search_space
         self.mode = metric_mode
         if metric_mode not in ("min", "max"):
             raise ValueError("metric_mode must be 'min' or 'max'")
+        if backend not in ("thread", "process"):
+            raise ValueError("backend must be 'thread' or 'process'")
         self.n_sampling = n_sampling
         self.epochs = epochs
         self.grace_epochs = max(1, grace_epochs)
         self.eta = max(2, eta)
         self.rng = random.Random(seed)
+        self.parallelism = max(1, int(parallelism))
+        self.backend = backend
         self.trials: List[Trial] = []
+        # process backend hooks this to evict culled trials from workers
+        self._free_trial: Optional[Callable[[Trial], None]] = None
 
     # ------------------------------------------------------------------
 
@@ -91,37 +164,169 @@ class SearchEngine:
 
     def run(self) -> Trial:
         self.trials = [Trial(i, c) for i, c in enumerate(self._configs())]
+        if self.parallelism > 1 and self.backend == "process":
+            best = self._run_with_process_pool()
+        else:
+            train_batch = (self._train_batch_threaded
+                           if self.parallelism > 1
+                           else self._train_batch_serial)
+            best = self._run_rungs(train_batch)
+        return best
+
+    # -- rung scheduling (shared across backends) -----------------------
+
+    def _run_rungs(self, train_batch: Callable[[List[Tuple[Trial, int]]],
+                                               None]) -> Trial:
         alive = list(self.trials)
         budget = self.grace_epochs
         while alive:
             # a lone survivor always trains to the full epoch budget
             if len(alive) == 1:
                 budget = self.epochs
+            work = []
             for t in alive:
                 add = min(budget, self.epochs) - t.epochs_trained
                 if add > 0:
-                    t.state, metric = self.trainable(t.config, t.state, add)
-                    t.epochs_trained += add
-                    t.metric_history.append(float(metric))
-            if budget >= self.epochs:
+                    work.append((t, add))
+            train_batch(work)
+            # errored trials are dead regardless of rank
+            alive = [t for t in alive if not t.stopped]
+            if budget >= self.epochs or not alive:
                 break
             # successive halving: keep the top 1/eta (NaN trials drop first)
             alive.sort(key=self._sort_key)
             keep = max(1, len(alive) // self.eta)
             for t in alive[keep:]:
                 t.stopped = True
+                if self._free_trial is not None:
+                    self._free_trial(t)
             alive = alive[:keep]
             budget = min(self.epochs, budget * self.eta)
         candidates = [t for t in self.trials if t.best_metric is not None]
+        if not candidates:
+            raise RuntimeError("all trials failed before reporting a metric")
         best = min(candidates, key=self._sort_key)
-        import math
         if best.best_metric is None or math.isnan(best.best_metric):
             raise RuntimeError(
                 "all trials diverged (NaN metrics); widen/lower the "
                 "learning-rate space")
         return best
 
+    def _record(self, t: Trial, add: int, metric: float,
+                error: Optional[str] = None):
+        if error is not None:
+            logger.warning("trial %d failed: %s", t.trial_id, error)
+            t.error = error
+            t.stopped = True
+            t.metric_history.append(float("nan"))
+            if self._free_trial is not None:
+                self._free_trial(t)
+            return
+        t.epochs_trained += add
+        t.metric_history.append(float(metric))
+
+    # -- executors ------------------------------------------------------
+
+    def _train_batch_serial(self, work: List[Tuple[Trial, int]]):
+        for t, add in work:
+            try:
+                t.state, metric = self.trainable(t.config, t.state, add)
+            except Exception as e:
+                self._record(t, add, 0.0, f"{type(e).__name__}: {e}")
+            else:
+                self._record(t, add, metric)
+
+    def _train_batch_threaded(self, work: List[Tuple[Trial, int]]):
+        """Concurrent trials in-process: XLA compute releases the GIL, so
+        CPU-compiled trials overlap on the host's cores."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(item):
+            t, add = item
+            return self.trainable(t.config, t.state, add)
+
+        with ThreadPoolExecutor(self.parallelism) as ex:
+            futures = [(t, add, ex.submit(one, (t, add)))
+                       for t, add in work]
+            for t, add, fut in futures:
+                try:
+                    t.state, metric = fut.result()
+                except Exception as e:
+                    self._record(t, add, 0.0, f"{type(e).__name__}: {e}")
+                else:
+                    self._record(t, add, metric)
+
+    # -- process backend (Ray-actor analog) -----------------------------
+
+    def _run_with_process_pool(self) -> Trial:
+        import multiprocessing as mp
+
+        import os
+
+        ctx = mp.get_context("spawn")  # never fork a live XLA runtime
+        n_workers = min(self.parallelism, len(self.trials))
+        workers, conns = [], []
+        # workers must come up on CPU so they never contend for the TPU;
+        # jax reads this env during the child's import, so export it for
+        # the duration of the spawns
+        prev_platform = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for _ in range(n_workers):
+                parent, child = ctx.Pipe()
+                p = ctx.Process(target=_process_worker_main,
+                                args=(child, self.trainable), daemon=True)
+                p.start()
+                conns.append(parent)
+                workers.append(p)
+        finally:
+            if prev_platform is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = prev_platform
+
+        def owner(t: Trial):
+            return conns[t.trial_id % n_workers]
+
+        def train_batch(work: List[Tuple[Trial, int]]):
+            by_tid = {}
+            for t, add in work:
+                owner(t).send(("train", t.trial_id, t.config, add))
+                by_tid[t.trial_id] = (t, add)
+            for t, add in work:  # one reply per request, per owner, FIFO
+                status, tid, payload = owner(t).recv()
+                tt, aa = by_tid[tid]
+                if status == "ok":
+                    self._record(tt, aa, payload)
+                else:
+                    self._record(tt, aa, 0.0, payload)
+
+        self._free_trial = lambda t: owner(t).send(("free", t.trial_id))
+        try:
+            best = self._run_rungs(train_batch)
+            owner(best).send(("export", best.trial_id))
+            status, _, payload, err = owner(best).recv()
+            if err is not None:
+                raise RuntimeError(
+                    f"best-trial export from worker failed: {err}")
+            # ("estimator", (params, model_state)) or ("raw", state)
+            best.state = payload
+            return best
+        finally:
+            self._free_trial = None
+            for c in conns:
+                try:
+                    c.send(("stop",))
+                    c.close()
+                except (BrokenPipeError, OSError):
+                    pass
+            for p in workers:
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.terminate()
+
     def trial_table(self) -> List[Dict[str, Any]]:
         return [{"trial_id": t.trial_id, "config": t.config,
                  "metric": t.best_metric, "epochs": t.epochs_trained,
-                 "stopped": t.stopped} for t in self.trials]
+                 "stopped": t.stopped, "error": t.error}
+                for t in self.trials]

@@ -64,13 +64,17 @@ class ParquetDataset:
         parquet_dataset.py:38)."""
         import pandas as pd
 
+        if write_mode not in ("overwrite", "errorifexists"):
+            raise ValueError(
+                f"unsupported write_mode {write_mode!r}; use 'overwrite' "
+                "or 'errorifexists' (partial part-file overwrites would "
+                "corrupt an existing dataset)")
         schema = _normalize_schema(schema)
         if os.path.exists(path):
             if write_mode == "errorifexists":
                 raise FileExistsError(path)
-            if write_mode == "overwrite":
-                import shutil
-                shutil.rmtree(path)
+            import shutil
+            shutil.rmtree(path)
         os.makedirs(path, exist_ok=True)
 
         def flush(rows: List[Dict], part: int):
@@ -164,13 +168,16 @@ def write_from_directory(directory: str, label_map: Optional[Dict] = None,
                          seed: int = 0, **kwargs) -> str:
     """Class-folder image tree -> parquet of {image(bytes), label, uri}
     (reference :237)."""
+    from analytics_zoo_tpu.feature.image.imageset import _IMG_EXTS
+
     classes = sorted(d for d in os.listdir(directory)
                      if os.path.isdir(os.path.join(directory, d)))
     label_map = label_map or {c: i for i, c in enumerate(classes)}
     items = []
     for c in classes:
         for f in sorted(os.listdir(os.path.join(directory, c))):
-            items.append((os.path.join(directory, c, f), label_map[c]))
+            if f.lower().endswith(_IMG_EXTS):  # skip READMEs, .DS_Store...
+                items.append((os.path.join(directory, c, f), label_map[c]))
     if shuffle:
         np.random.default_rng(seed).shuffle(items)
 

@@ -187,16 +187,41 @@ class XShards:
     def transform_shard(self, func: Callable, *args) -> "XShards":
         """Apply `func(shard, *args)` to every shard, in parallel.  Under
         the DISK tier, shards stream through with bounded in-flight memory
-        (2x pool size) and results spill to the new store as they finish."""
+        (2x pool size) and results spill to the new store as they finish.
+        On a lazy (`from_sources`) XShards the transform COMPOSES with the
+        loader instead of materializing — the result is itself lazy, so
+        disk datasets larger than RAM survive arbitrary transform chains."""
+        if isinstance(self._store, _LazySourceStore):
+            loader = self._store._loader
+            return XShards.from_sources(
+                self._store._sources,
+                lambda src: func(loader(src), *args))
         mapped = _parallel_map(lambda s: func(s, *args), self._store.iter())
         return XShards(mapped)
 
     def transform_shard_with_index(self, func: Callable) -> "XShards":
         """Apply `func(index, shard)` to every shard — for transforms that
-        need a stable per-shard identity (e.g. independent RNG streams)."""
+        need a stable per-shard identity (e.g. independent RNG streams).
+        Lazy XShards stay lazy (see transform_shard)."""
+        if isinstance(self._store, _LazySourceStore):
+            loader = self._store._loader
+            indexed = list(enumerate(self._store._sources))
+            return XShards.from_sources(
+                indexed, lambda pair: func(pair[0], loader(pair[1])))
         mapped = _parallel_map(lambda t: func(t[0], t[1]),
                                enumerate(self._store.iter()))
         return XShards(mapped)
+
+    @staticmethod
+    def from_records(records: List[Any],
+                     num_shards: Optional[int] = None,
+                     default_shards: int = 8) -> "XShards":
+        """Split a list of records into list-shards (never empty ones)."""
+        n = num_shards or min(len(records), default_shards)
+        n = max(1, min(n, len(records))) if records else 1
+        bounds = np.linspace(0, len(records), n + 1).astype(int)
+        return XShards([records[bounds[i]:bounds[i + 1]]
+                        for i in range(n)])
 
     def get_shard(self, i: int) -> Any:
         """Fetch a single shard (loads from spill under the DISK tier)."""

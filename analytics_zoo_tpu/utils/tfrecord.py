@@ -55,13 +55,19 @@ def read_records(f: BinaryIO, verify: bool = True) -> Iterator[bytes]:
         if len(header) < 8:
             return
         (length,) = struct.unpack("<Q", header)
-        (hcrc,) = struct.unpack("<I", f.read(4))
+        hcrc_raw = f.read(4)
+        if len(hcrc_raw) < 4:
+            raise IOError("corrupt TFRecord: truncated length crc")
+        (hcrc,) = struct.unpack("<I", hcrc_raw)
         if verify and masked_crc32c(header) != hcrc:
             raise IOError("corrupt TFRecord: bad length crc")
         data = f.read(length)
         if len(data) < length:
             raise IOError("corrupt TFRecord: truncated payload")
-        (dcrc,) = struct.unpack("<I", f.read(4))
+        dcrc_raw = f.read(4)
+        if len(dcrc_raw) < 4:
+            raise IOError("corrupt TFRecord: truncated data crc")
+        (dcrc,) = struct.unpack("<I", dcrc_raw)
         if verify and masked_crc32c(data) != dcrc:
             raise IOError("corrupt TFRecord: bad data crc")
         yield data

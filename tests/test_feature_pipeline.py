@@ -295,6 +295,32 @@ def test_parquet_mnist_writer_and_streaming_train(tmp_path):
     assert stats["accuracy"] > 0.9, stats
 
 
+def test_lazy_xshards_transform_stays_lazy(tmp_path):
+    """transform_shard on a from_sources XShards composes with the loader
+    instead of materializing (disk datasets larger than RAM survive
+    transform chains)."""
+    from analytics_zoo_tpu.orca.data.shard import _LazySourceStore
+
+    init_orca_context(cluster_mode="local")
+    loads = []
+
+    def loader(src):
+        loads.append(src)
+        return {"x": np.full((4, 2), src, np.float32),
+                "y": np.zeros(4, np.int32)}
+
+    xs = XShards.from_sources([0, 1, 2], loader)
+    t1 = xs.transform_shard(lambda b: {**b, "x": b["x"] * 2})
+    t2 = t1.transform_shard_with_index(
+        lambda i, b: {**b, "y": b["y"] + i})
+    assert isinstance(t2._store, _LazySourceStore)
+    assert loads == []  # nothing loaded yet
+    s = t2.get_shard(1)
+    assert loads == [1]
+    np.testing.assert_array_equal(s["x"], np.full((4, 2), 2.0))
+    np.testing.assert_array_equal(s["y"], np.ones(4))
+
+
 def test_write_from_directory_and_voc(tmp_path):
     from PIL import Image
 

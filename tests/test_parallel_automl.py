@@ -1,0 +1,137 @@
+"""Parallel AutoML trials (VERDICT r1 weak #8; reference: Ray Tune runs
+concurrent trial actors, ray_tune_search_engine.py:29-345)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.orca.automl import hp
+from analytics_zoo_tpu.orca.automl.search_engine import SearchEngine
+
+
+def _sleepy_trainable(config, state, add_epochs):
+    """Simulates a trial whose work is off-GIL (like XLA compute)."""
+    time.sleep(0.8 * add_epochs)
+    return (state or 0) + add_epochs, config["p"]
+
+
+def test_threaded_trials_wall_clock_speedup():
+    space = {"p": hp.choice([1.0, 2.0, 3.0, 4.0])}
+    t0 = time.perf_counter()
+    eng = SearchEngine(_sleepy_trainable, space, n_sampling=4, epochs=1,
+                       parallelism=1)
+    eng.run()
+    seq = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    eng = SearchEngine(_sleepy_trainable, space, n_sampling=4, epochs=1,
+                       parallelism=4, backend="thread")
+    best = eng.run()
+    par = time.perf_counter() - t0
+    assert best.best_metric is not None
+    # 4 concurrent 0.8s trials must beat 4 sequential ones clearly
+    assert par < seq * 0.6, (seq, par)
+
+
+def test_threaded_trials_match_serial_result():
+    space = {"p": hp.grid_search([5.0, 1.0, 3.0, 4.0])}
+
+    def trainable(config, state, add_epochs):
+        return None, config["p"]
+
+    serial = SearchEngine(trainable, space, epochs=1).run()
+    threaded = SearchEngine(trainable, space, epochs=1,
+                            parallelism=4).run()
+    assert serial.config["p"] == threaded.config["p"] == 1.0
+
+
+def test_trial_error_is_culled_not_fatal():
+    space = {"p": hp.grid_search([1.0, 2.0, 3.0, 4.0])}
+
+    def trainable(config, state, add_epochs):
+        if config["p"] == 1.0:  # the would-be winner dies
+            raise RuntimeError("boom")
+        return None, config["p"]
+
+    eng = SearchEngine(trainable, space, epochs=1, parallelism=2)
+    best = eng.run()
+    assert best.config["p"] == 2.0
+    table = eng.trial_table()
+    errored = [r for r in table if r["config"]["p"] == 1.0]
+    assert errored[0]["stopped"]
+
+
+def test_all_trials_error_raises():
+    def trainable(config, state, add_epochs):
+        raise ValueError("nope")
+
+    eng = SearchEngine(trainable, {"p": hp.choice([1.0])}, n_sampling=2,
+                       epochs=1)
+    with pytest.raises(RuntimeError):
+        eng.run()
+
+
+# -- process backend --------------------------------------------------------
+
+def _proc_trainable(config, state, add_epochs):
+    # runs in a spawned worker: cheap math, no jax import needed
+    count = (state or 0) + add_epochs
+    return count, config["p"] + 0.01 * count
+
+
+def test_process_backend_trials_and_asha():
+    space = {"p": hp.grid_search([4.0, 2.0, 1.0, 3.0])}
+    eng = SearchEngine(_proc_trainable, space, epochs=4, grace_epochs=1,
+                       parallelism=2, backend="process")
+    best = eng.run()
+    assert best.config["p"] == 1.0
+    assert best.epochs_trained == 4
+    # losers stopped early (ASHA culling still happened across processes)
+    stopped = [t for t in eng.trials if t.stopped]
+    assert len(stopped) >= 2
+
+
+class _TinyEst:
+    """Minimal picklable Estimator-contract object for worker export."""
+
+    def __init__(self, lr):
+        self.lr = lr
+        self.loss = 10.0
+
+    def fit(self, data, epochs=1, batch_size=32, feature_cols=None,
+            label_cols=None):
+        for _ in range(epochs):
+            self.loss *= self.lr
+        return self
+
+    def evaluate(self, data, batch_size=32, feature_cols=None,
+                 label_cols=None):
+        return {"loss": self.loss}
+
+    def get_model(self):
+        return {"w": np.float64(self.loss)}
+
+    def get_model_state(self):
+        return {}
+
+
+def _tiny_creator(config):
+    return _TinyEst(config["lr"])
+
+
+def test_auto_estimator_process_backend_exports_best_model():
+    from analytics_zoo_tpu.orca.automl.auto_estimator import AutoEstimator
+
+    init_orca_context(cluster_mode="local")
+    auto = AutoEstimator.from_flax(_tiny_creator, metric="loss",
+                                   metric_mode="min")
+    auto.fit({"x": np.zeros(4), "y": np.zeros(4)},
+             search_space={"lr": hp.grid_search([0.9, 0.5, 0.7])},
+             epochs=3, parallelism=2, backend="process")
+    assert auto.get_best_config()["lr"] == 0.5
+    best = auto.get_best_model()
+    # best model rebuilt locally with exported weights staged
+    assert isinstance(best, _TinyEst)
+    assert np.isclose(best._params["w"], 10.0 * 0.5 ** 3)
