@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.chronos.data.experimental.xshards_tsdataset import (
+    XShardsTSDataset,
+)
+
+__all__ = ["XShardsTSDataset"]

@@ -1,0 +1,174 @@
+"""XShardsTSDataset — distributed TSDataset over XShards.
+
+Reference: `pyzoo/zoo/chronos/data/experimental/xshards_tsdataset.py:28`
+(Spark-RDD-sharded TSDataset whose per-shard ops run as RDD transforms).
+
+TPU-native design: shards are pandas DataFrames hash-partitioned by
+`id_col` (every series lives wholly in one shard), and every operation
+wraps the SINGLE-NODE `TSDataset` per shard — impute/scale/roll run on
+the shard thread pool, exactly the reference's "same code in every
+partition" strategy without the JVM.  `to_xshards()` emits the {"x","y"}
+block convention that streams into `Estimator.fit`/forecasters."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.chronos.data.tsdataset import TSDataset, _as_list
+from analytics_zoo_tpu.orca.data.shard import XShards
+
+
+class XShardsTSDataset:
+    def __init__(self, shards: XShards, dt_col: str,
+                 target_col: List[str], id_col: Optional[str],
+                 feature_col: List[str], lookback=None, horizon=None):
+        self.shards = shards
+        self.dt_col = dt_col
+        self.target_col = list(target_col)
+        self.id_col = id_col
+        self.feature_col = list(feature_col)
+        self.lookback = lookback
+        self.horizon = horizon
+
+    # -- construction ---------------------------------------------------
+
+    @staticmethod
+    def from_xshards(shards: XShards, dt_col: str,
+                     target_col: Union[str, Sequence[str]],
+                     id_col: Optional[str] = None,
+                     extra_feature_col: Union[str, Sequence[str],
+                                              None] = None
+                     ) -> "XShardsTSDataset":
+        """`shards` holds pandas DataFrames.  With an `id_col` the data is
+        re-partitioned so each id's rows are co-resident (the reference
+        relies on the same invariant)."""
+        target = _as_list(target_col)
+        feats = _as_list(extra_feature_col)
+        if id_col is not None:
+            shards = shards.partition_by(id_col,
+                                         shards.num_partitions())
+        return XShardsTSDataset(shards, dt_col, target, id_col, feats)
+
+    @staticmethod
+    def from_pandas(df, dt_col, target_col, id_col=None,
+                    extra_feature_col=None, num_shards: int = 4
+                    ) -> "XShardsTSDataset":
+        import pandas as pd
+
+        from analytics_zoo_tpu.friesian.table import _shard_dataframe
+        shards = _shard_dataframe(df, num_shards)
+        return XShardsTSDataset.from_xshards(
+            shards, dt_col, target_col, id_col, extra_feature_col)
+
+    # -- per-shard TSDataset ops ---------------------------------------
+
+    def _wrap(self, df) -> TSDataset:
+        return TSDataset(df.sort_values(
+            [self.id_col, self.dt_col] if self.id_col else [self.dt_col])
+            .reset_index(drop=True),
+            self.dt_col, self.target_col, self.id_col, self.feature_col)
+
+    def _per_shard(self, fn) -> "XShardsTSDataset":
+        out = XShardsTSDataset(
+            self.shards.transform_shard(
+                # hash partitioning can leave a shard empty; pass through
+                lambda df: df if len(df) == 0 else fn(self._wrap(df)).df),
+            self.dt_col, self.target_col, self.id_col, self.feature_col,
+            self.lookback, self.horizon)
+        return out
+
+    def impute(self, mode: str = "last", const_num: float = 0.0
+               ) -> "XShardsTSDataset":
+        return self._per_shard(lambda ts: ts.impute(mode, const_num))
+
+    def deduplicate(self) -> "XShardsTSDataset":
+        return self._per_shard(lambda ts: ts.deduplicate())
+
+    def gen_dt_feature(self, features=None) -> "XShardsTSDataset":
+        # column names are fully determined by the argument — no need to
+        # probe (and transform) a shard just to learn them
+        names = list(features) if features else [
+            "HOUR", "DAY", "WEEKDAY", "MONTH", "IS_WEEKEND"]
+        out = self._per_shard(lambda ts: ts.gen_dt_feature(names))
+        out.feature_col = self.feature_col + [
+            f for f in names if f not in self.feature_col]
+        return out
+
+    def scale(self, scalers: Optional[Dict] = None,
+              fit: Optional[bool] = None) -> "XShardsTSDataset":
+        """Standard-scale target+features with GLOBAL statistics (mean/std
+        reduced over shard partials — per-shard stats would make the same
+        value scale differently in different shards).  `fit=True`
+        recomputes from this data; `fit=False` requires `scalers` (the
+        reference's val/test `scale(train_scaler, fit=False)` pattern);
+        default: fit iff no scalers were passed."""
+        cols = self.target_col + self.feature_col
+        if fit is None:
+            fit = scalers is None
+        if not fit and scalers is None:
+            raise ValueError("fit=False requires scalers from a prior "
+                             "fit pass")
+        if fit:
+            # NaN-aware: per-column non-NaN counts, not len(df) — scale()
+            # before impute() must not bias the statistics; reindex keeps
+            # empty hash partitions (no columns yet) harmless
+            partials = self.shards.transform_shard(
+                lambda df: (df.reindex(columns=cols).sum(),
+                            (df.reindex(columns=cols) ** 2).sum(),
+                            df.reindex(columns=cols).count())).collect()
+            count = sum(p[2] for p in partials)
+            mean = sum(p[0] for p in partials) / count
+            sq = sum(p[1] for p in partials) / count
+            std = np.sqrt(np.maximum(sq - mean ** 2, 1e-12))
+            scalers = {"mean": mean, "std": std}
+        self._scalers = scalers
+
+        def f(df):
+            if len(df) == 0:
+                return df
+            df = df.copy()
+            df[cols] = (df[cols] - scalers["mean"]) / scalers["std"]
+            return df
+        out = XShardsTSDataset(self.shards.transform_shard(f),
+                               self.dt_col, self.target_col, self.id_col,
+                               self.feature_col, self.lookback,
+                               self.horizon)
+        out._scalers = scalers
+        return out
+
+    def unscale_numpy(self, data: np.ndarray) -> np.ndarray:
+        """Undo target scaling on forecaster output [b, horizon, n_tgt]."""
+        mean = np.asarray(self._scalers["mean"][self.target_col],
+                          np.float32)
+        std = np.asarray(self._scalers["std"][self.target_col],
+                         np.float32)
+        return data * std + mean
+
+    def roll(self, lookback: int, horizon: Union[int, Sequence[int]]
+             ) -> "XShardsTSDataset":
+        self.lookback = lookback
+        self.horizon = horizon
+        return self
+
+    def to_xshards(self) -> XShards:
+        """Roll every shard into {"x": [n, lookback, F], "y": [n, h, T]}
+        blocks — streams straight into forecaster/Estimator fit."""
+        if self.lookback is None:
+            raise ValueError("call roll(lookback, horizon) first")
+        lookback, horizon = self.lookback, self.horizon
+        n_feat = len(self.target_col) + len(self.feature_col)
+        n_tgt = len(self.target_col)
+        h = (len(horizon) if isinstance(horizon, (list, tuple))
+             else horizon)
+
+        def f(df):
+            if len(df) == 0:  # empty hash partition: empty block
+                return {"x": np.zeros((0, lookback, n_feat), np.float32),
+                        "y": np.zeros((0, h, n_tgt), np.float32)}
+            ts = self._wrap(df)
+            ts.roll(lookback, horizon)
+            x, y = ts.to_numpy()
+            return {"x": x, "y": y} if y is not None else {"x": x}
+        return self.shards.transform_shard(f)

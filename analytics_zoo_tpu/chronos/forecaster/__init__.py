@@ -13,3 +13,9 @@ from analytics_zoo_tpu.chronos.forecaster.arima_forecaster import (  # noqa: F40
 from analytics_zoo_tpu.chronos.forecaster.prophet_forecaster import (  # noqa: F401,E501
     ProphetForecaster,
 )
+from analytics_zoo_tpu.chronos.forecaster.mtnet_forecaster import (  # noqa: F401,E501
+    MTNetForecaster,
+)
+from analytics_zoo_tpu.chronos.forecaster.tcmf_forecaster import (  # noqa: F401,E501
+    TCMFForecaster,
+)

@@ -1,0 +1,146 @@
+"""MTNet forecaster — memory time-series network.
+
+Reference: `pyzoo/zoo/chronos/model/MTNet_keras.py` (+
+`forecaster/mtnet_forecaster.py`): the input window is split into
+`long_series_num` long-term memory chunks plus one short-term chunk of
+`series_length` steps each; every chunk is encoded by CNN → attention →
+GRU; attention over the memory encodings conditioned on the short-term
+encoding produces the context; a parallel linear autoregressive head over
+the last `ar_window_size` target steps is added (Lai et al.'s LSTNet-style
+highway).
+
+TPU design notes: chunk encoding is vmapped over the memory axis (one
+fused program instead of a Python loop of layer calls), convs/matmuls run
+in bf16-friendly NHWC-like layouts, and the GRU is a `nn.scan` over time —
+all static shapes, single XLA compilation.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.chronos.forecaster.base import BaseForecaster
+
+
+class _ChunkEncoder(nn.Module):
+    """CNN + time-attention + GRU over one chunk [b, T, D] -> [b, H]."""
+
+    cnn_hid: int
+    rnn_hid: int
+    dropout: float
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        # conv over time (the reference's cnn_height kernel)
+        h = nn.relu(nn.Conv(self.cnn_hid, (3,), padding="SAME",
+                            name="conv")(x))
+        h = nn.Dropout(self.dropout)(h, deterministic=not training)
+        # additive self-attention over time steps
+        score = nn.Dense(1, name="attn")(nn.tanh(
+            nn.Dense(self.cnn_hid, name="attn_proj")(h)))
+        w = jax.nn.softmax(score, axis=1)
+        h = h * w  # re-weighted sequence
+        # GRU over time; final step output is the chunk encoding
+        hs = nn.RNN(nn.GRUCell(self.rnn_hid), name="gru")(h)
+        return hs[:, -1]
+
+
+class _MTNet(nn.Module):
+    long_series_num: int      # n memory chunks
+    series_length: int        # T per chunk
+    ar_window: int
+    cnn_hid: int
+    rnn_hid: int
+    horizon: int
+    target_num: int
+    dropout: float
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        n, t = self.long_series_num, self.series_length
+        b, total, d = x.shape
+        if total != (n + 1) * t:
+            raise ValueError(
+                f"MTNet input needs {(n + 1) * t} steps "
+                f"({n} memory chunks + 1 short chunk of {t}), got {total}")
+        mem = x[:, :n * t].reshape(b, n, t, d)
+        short = x[:, n * t:]
+
+        mem_enc = _ChunkEncoder(self.cnn_hid, self.rnn_hid, self.dropout,
+                                name="mem_encoder")
+        # ONE encoder vmapped over the chunk axis (shared weights, fused)
+        m = nn.vmap(lambda enc, c: enc(c, training),
+                    variable_axes={"params": None},
+                    split_rngs={"params": False, "dropout": False},
+                    in_axes=1, out_axes=1)(mem_enc, mem)  # [b, n, H]
+        u = _ChunkEncoder(self.cnn_hid, self.rnn_hid, self.dropout,
+                          name="short_encoder")(short, training)  # [b, H]
+
+        # memory attention: softmax(m . u) weights the memory readout
+        logits = jnp.einsum("bnh,bh->bn", m, u) / jnp.sqrt(
+            jnp.asarray(self.rnn_hid, jnp.float32))
+        attn = jax.nn.softmax(logits, axis=1)
+        context = jnp.einsum("bn,bnh->bh", attn, m)
+
+        fused = jnp.concatenate([context, u], axis=-1)
+        out = nn.Dense(self.horizon * self.target_num, name="head")(fused)
+        out = out.reshape(b, self.horizon, self.target_num)
+
+        # autoregressive highway over the raw last ar_window target steps
+        if self.ar_window > 0:
+            ar_in = x[:, -self.ar_window:, :self.target_num]
+            ar = nn.DenseGeneral(
+                features=(self.horizon,), axis=1, name="ar")(ar_in)
+            out = out + jnp.moveaxis(ar, -1, 1)
+        return out
+
+
+class MTNetForecaster(BaseForecaster):
+    """Reference ctor parity (mtnet_forecaster.py): `target_dim`,
+    `feature_dim`, `long_series_num`, `series_length`, `ar_window_size`,
+    `cnn_hid_size`, `rnn_hid_size`.  The model consumes windows of
+    `(long_series_num + 1) * series_length` steps."""
+
+    loss = "mse"
+    metrics = ("mse", "mae")
+
+    def __init__(self, target_dim: int = 1, feature_dim: int = 1,
+                 long_series_num: int = 4, series_length: int = 8,
+                 ar_window_size: int = 4, cnn_hid_size: int = 32,
+                 rnn_hid_size: int = 32, horizon: int = 1,
+                 dropout: float = 0.1, optimizer: str = "adam",
+                 lr: float = 1e-3, seed: int = 0):
+        past = (long_series_num + 1) * series_length
+        super().__init__(past_seq_len=past, future_seq_len=horizon,
+                         input_feature_num=feature_dim,
+                         output_feature_num=target_dim,
+                         optimizer=optimizer, lr=lr, seed=seed)
+        self.long_series_num = long_series_num
+        self.series_length = series_length
+        self.ar_window_size = min(ar_window_size, past)
+        self.cnn_hid_size = cnn_hid_size
+        self.rnn_hid_size = rnn_hid_size
+        self.dropout = dropout
+
+    def _build_module(self):
+        return _MTNet(long_series_num=self.long_series_num,
+                      series_length=self.series_length,
+                      ar_window=self.ar_window_size,
+                      cnn_hid=self.cnn_hid_size,
+                      rnn_hid=self.rnn_hid_size,
+                      horizon=self.future_seq_len,
+                      target_num=self.output_feature_num,
+                      dropout=self.dropout)
+
+    def _config(self):
+        return dict(target_dim=self.output_feature_num,
+                    feature_dim=self.input_feature_num,
+                    long_series_num=self.long_series_num,
+                    series_length=self.series_length,
+                    ar_window_size=self.ar_window_size,
+                    cnn_hid_size=self.cnn_hid_size,
+                    rnn_hid_size=self.rnn_hid_size,
+                    horizon=self.future_seq_len, dropout=self.dropout,
+                    optimizer=self._optimizer, lr=self._lr)

@@ -1,0 +1,189 @@
+"""TCMF — Temporal Convolutional Matrix Factorization for forecasting
+many (thousands+) related series jointly.
+
+Reference: `pyzoo/zoo/chronos/model/tcmf/DeepGLO.py` (+
+`forecaster/tcmf_forecaster.py`, 4647 LoC): factorize the series matrix
+Y[n, T] ≈ F[n, k] · X[k, T], model the k temporal basis rows with a TCN,
+forecast the basis forward, and recombine; trained distributed over Ray
+actors.
+
+TPU-native re-design (this is NOT a port of DeepGLO's alternating loop):
+
+1. Factorization runs ON THE ENGINE as an embedding model — F is an
+   `nn.Embed` table over series ids (sharded over "tp" via shard_rules
+   like every other embedding in the framework) and X is a plain [k, T]
+   parameter; batches are series-id slices, so data parallelism over the
+   mesh IS the reference's "distributed over workers" axis, with XLA
+   collectives doing the gradient sync the Ray actors did by hand.
+2. The basis X (k series, length T) is then rolled into windows and fit
+   by the existing TCNForecaster — reusing the framework's TCN rather
+   than a second private TCN implementation.
+3. predict(horizon) autoregressively rolls the TCN over X and returns
+   F · X_future.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Factorization(nn.Module):
+    """ids [b] -> F[ids] · X  == reconstructed rows [b, T]."""
+
+    num_series: int
+    rank: int
+    length: int
+
+    @nn.compact
+    def __call__(self, ids, training: bool = False):
+        f_rows = nn.Embed(self.num_series, self.rank, name="embed_f")(
+            jnp.asarray(ids, jnp.int32))
+        x_basis = self.param(
+            "x_basis", nn.initializers.normal(0.1),
+            (self.rank, self.length))
+        return f_rows @ x_basis
+
+
+class TCMFForecaster:
+    """fit on Y [n_series, T]; predict(horizon) -> [n_series, horizon].
+
+    `vbsize`/`hbsize`/`num_channels_X` keep reference naming
+    (tcmf_forecaster.py ctor)."""
+
+    def __init__(self, vbsize: int = 128, rank: int = 16,
+                 tcn_lookback: int = 16,
+                 num_channels_X: tuple = (32, 32),
+                 lr: float = 5e-3, seed: int = 0):
+        self.vbsize = vbsize          # vertical (series) batch size
+        self.rank = rank
+        self.tcn_lookback = tcn_lookback
+        self.num_channels_X = tuple(num_channels_X)
+        self.lr = lr
+        self.seed = seed
+        self._est = None              # factorization estimator
+        self._tcn = None              # basis forecaster
+        self.n = self.T = None
+
+    # -- stage 1: factorization on the SPMD engine ----------------------
+
+    def fit(self, x, val_len: int = 0, epochs: int = 20,
+            batch_size: Optional[int] = None):
+        """`x` is {"y": [n, T]} (reference input convention) or a bare
+        [n, T] ndarray."""
+        from analytics_zoo_tpu.chronos.forecaster import TCNForecaster
+        from analytics_zoo_tpu.orca.learn.estimator import Estimator
+
+        y = np.asarray(x["y"] if isinstance(x, dict) else x, np.float32)
+        if y.ndim != 2:
+            raise ValueError(f"TCMF expects [n_series, T], got {y.shape}")
+        self.n, self.T = y.shape
+        self._y_mean = y.mean(axis=1, keepdims=True)
+        self._y_std = y.std(axis=1, keepdims=True) + 1e-6
+        yn = (y - self._y_mean) / self._y_std
+
+        self._est = Estimator.from_flax(
+            _Factorization(self.n, self.rank, self.T),
+            loss="mse", optimizer="adam", learning_rate=self.lr,
+            shard_rules={"embed": "tp"}, seed=self.seed)
+        ids = np.arange(self.n, dtype=np.int32)
+        # small n would mean one optimizer step per epoch and pure
+        # host-loop overhead; tile the id set so each epoch carries
+        # several hundred rows of work
+        reps = max(1, min(16, 512 // max(self.n, 1)))
+        ids_t = np.tile(ids, reps)
+        self._est.fit({"x": ids_t, "y": np.tile(yn, (reps, 1))},
+                      epochs=epochs,
+                      batch_size=batch_size or min(self.vbsize, self.n))
+
+        # -- stage 2: TCN over the learned temporal basis --------------
+        params = self._est.get_model()
+        self._X = np.asarray(params["x_basis"])          # [k, T]
+        self._F = np.asarray(params["embed_f"]["embedding"])  # [n, k]
+        lb = min(self.tcn_lookback, self.T - 1)
+        self._tcn = TCNForecaster(
+            past_seq_len=lb, future_seq_len=1, input_feature_num=1,
+            output_feature_num=1, num_channels=self.num_channels_X,
+            lr=self.lr, seed=self.seed)
+        # roll every basis row into (window -> next value) samples
+        xs, ys = [], []
+        for row in self._X:
+            for t0 in range(self.T - lb):
+                xs.append(row[t0:t0 + lb])
+                ys.append(row[t0 + lb])
+        self._tcn.fit({"x": np.asarray(xs, np.float32)[..., None],
+                       "y": np.asarray(ys, np.float32)[:, None, None]},
+                      epochs=max(2, min(20, epochs // 2)),
+                      batch_size=min(256, len(xs)))
+        return self
+
+    def predict(self, horizon: int = 1) -> np.ndarray:
+        """Roll the basis TCN `horizon` steps ahead autoregressively and
+        recombine through F (reference DeepGLO predict path)."""
+        if self._tcn is None:
+            raise RuntimeError("call fit first")
+        lb = min(self.tcn_lookback, self.T - 1)
+        X = self._X.copy()
+        for _ in range(horizon):
+            window = X[:, -lb:][..., None].astype(np.float32)
+            nxt = self._tcn.predict({"x": window})  # [k, 1, 1]
+            X = np.concatenate([X, nxt[:, :, 0]], axis=1)
+        x_future = X[:, self.T:]                     # [k, horizon]
+        out = self._F @ x_future                     # [n, horizon]
+        return out * self._y_std + self._y_mean
+
+    def evaluate(self, target_value, metric=("mse",)) -> dict:
+        y_true = np.asarray(
+            target_value["y"] if isinstance(target_value, dict)
+            else target_value, np.float32)
+        pred = self.predict(horizon=y_true.shape[1])
+        out = {}
+        for m in metric:
+            if m == "mse":
+                out[m] = float(np.mean((pred - y_true) ** 2))
+            elif m == "mae":
+                out[m] = float(np.mean(np.abs(pred - y_true)))
+            else:
+                raise ValueError(f"unknown metric {m}")
+        return out
+
+    # -- persistence ----------------------------------------------------
+
+    def save(self, path: str):
+        with open(path, "wb") as f:
+            pickle.dump({
+                "config": dict(vbsize=self.vbsize, rank=self.rank,
+                               tcn_lookback=self.tcn_lookback,
+                               num_channels_X=self.num_channels_X,
+                               lr=self.lr, seed=self.seed),
+                "n": self.n, "T": self.T,
+                "F": getattr(self, "_F", None),
+                "X": getattr(self, "_X", None),
+                "y_mean": getattr(self, "_y_mean", None),
+                "y_std": getattr(self, "_y_std", None),
+                "tcn_params": (self._tcn._estimator().get_model()
+                               if self._tcn is not None else None),
+            }, f, protocol=pickle.HIGHEST_PROTOCOL)
+        return path
+
+    @classmethod
+    def load(cls, path: str):
+        from analytics_zoo_tpu.chronos.forecaster import TCNForecaster
+        with open(path, "rb") as f:
+            d = pickle.load(f)
+        self = cls(**d["config"])
+        self.n, self.T = d["n"], d["T"]
+        self._F, self._X = d["F"], d["X"]
+        self._y_mean, self._y_std = d["y_mean"], d["y_std"]
+        if d["tcn_params"] is not None:
+            lb = min(self.tcn_lookback, self.T - 1)
+            self._tcn = TCNForecaster(
+                past_seq_len=lb, future_seq_len=1, input_feature_num=1,
+                output_feature_num=1,
+                num_channels=self.num_channels_X, lr=self.lr)
+            self._tcn._estimator()._params = d["tcn_params"]
+        return self

@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.chronos.simulator.doppelganger_simulator import (
+    DPGANSimulator,
+)
+
+__all__ = ["DPGANSimulator"]

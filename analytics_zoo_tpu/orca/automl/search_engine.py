@@ -202,7 +202,13 @@ class SearchEngine:
                     self._free_trial(t)
             alive = alive[:keep]
             budget = min(self.epochs, budget * self.eta)
-        candidates = [t for t in self.trials if t.best_metric is not None]
+        # rank finishers first: a culled trial's early-rung metric is not
+        # comparable to a survivor's full-budget metric (and the process
+        # backend has already freed culled trials' states)
+        finishers = [t for t in self.trials
+                     if not t.stopped and t.best_metric is not None]
+        candidates = finishers or [t for t in self.trials
+                                   if t.best_metric is not None]
         if not candidates:
             raise RuntimeError("all trials failed before reporting a metric")
         best = min(candidates, key=self._sort_key)
@@ -271,19 +277,6 @@ class SearchEngine:
         # the duration of the spawns
         prev_platform = os.environ.get("JAX_PLATFORMS")
         os.environ["JAX_PLATFORMS"] = "cpu"
-        try:
-            for _ in range(n_workers):
-                parent, child = ctx.Pipe()
-                p = ctx.Process(target=_process_worker_main,
-                                args=(child, self.trainable), daemon=True)
-                p.start()
-                conns.append(parent)
-                workers.append(p)
-        finally:
-            if prev_platform is None:
-                os.environ.pop("JAX_PLATFORMS", None)
-            else:
-                os.environ["JAX_PLATFORMS"] = prev_platform
 
         def owner(t: Trial):
             return conns[t.trial_id % n_workers]
@@ -303,6 +296,20 @@ class SearchEngine:
 
         self._free_trial = lambda t: owner(t).send(("free", t.trial_id))
         try:
+            # spawning inside the try: a failed spawn (unpicklable
+            # trainable, fd exhaustion) must still tear down the workers
+            # already started
+            for _ in range(n_workers):
+                parent, child = ctx.Pipe()
+                p = ctx.Process(target=_process_worker_main,
+                                args=(child, self.trainable), daemon=True)
+                p.start()
+                conns.append(parent)
+                workers.append(p)
+            if prev_platform is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = prev_platform
             best = self._run_rungs(train_batch)
             owner(best).send(("export", best.trial_id))
             status, _, payload, err = owner(best).recv()
@@ -313,6 +320,12 @@ class SearchEngine:
             best.state = payload
             return best
         finally:
+            if os.environ.get("JAX_PLATFORMS") == "cpu" and \
+                    prev_platform != "cpu":
+                if prev_platform is None:
+                    os.environ.pop("JAX_PLATFORMS", None)
+                else:
+                    os.environ["JAX_PLATFORMS"] = prev_platform
             self._free_trial = None
             for c in conns:
                 try:

@@ -264,8 +264,11 @@ class XShards:
         num_partitions = num_partitions or len(shards)
         df = pd.concat(shards, ignore_index=True)
         codes = pd.util.hash_array(df[cols].to_numpy()) % num_partitions
-        out = [df[codes == i] for i in range(num_partitions)]
-        return XShards(out)
+        # drop empty partitions: few distinct keys would otherwise leave
+        # column-less empty frames that break downstream per-shard ops
+        out = [part for i in range(num_partitions)
+               if len(part := df[codes == i])]
+        return XShards(out or [df])
 
     def unique(self, col: Optional[str] = None) -> np.ndarray:
         """Distinct values of a DataFrame column (reference shard.py:260)."""

@@ -52,8 +52,10 @@ def write_record(f: BinaryIO, data: bytes):
 def read_records(f: BinaryIO, verify: bool = True) -> Iterator[bytes]:
     while True:
         header = f.read(8)
+        if not header:
+            return  # clean EOF on a record boundary
         if len(header) < 8:
-            return
+            raise IOError("corrupt TFRecord: truncated length header")
         (length,) = struct.unpack("<Q", header)
         hcrc_raw = f.read(4)
         if len(hcrc_raw) < 4:

@@ -1,0 +1,210 @@
+"""Chronos depth (VERDICT r1 next-round #10): MTNet, TCMF,
+XShardsTSDataset, DoppelGANger simulator."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+
+
+def _sine_series(n_samples, lookback, horizon, seed=0):
+    rng = np.random.default_rng(seed)
+    t0 = rng.uniform(0, 100, n_samples)
+    ts = t0[:, None] + np.arange(lookback + horizon)
+    series = np.sin(0.3 * ts) + 0.05 * rng.normal(
+        size=(n_samples, lookback + horizon))
+    x = series[:, :lookback, None].astype(np.float32)
+    y = series[:, lookback:, None].astype(np.float32)
+    return x, y
+
+
+def test_mtnet_learns_sine():
+    from analytics_zoo_tpu.chronos.forecaster import MTNetForecaster
+
+    init_orca_context(cluster_mode="local")
+    fc = MTNetForecaster(target_dim=1, feature_dim=1, long_series_num=3,
+                         series_length=6, ar_window_size=4,
+                         cnn_hid_size=16, rnn_hid_size=16, horizon=2,
+                         dropout=0.0, lr=5e-3)
+    # lookback = (3+1)*6 = 24
+    x, y = _sine_series(400, 24, 2)
+    fc.fit({"x": x, "y": y}, epochs=8, batch_size=64)
+    stats = fc.evaluate({"x": x, "y": y})
+    assert stats["mse"] < 0.1, stats
+    pred = fc.predict({"x": x[:10]})
+    assert pred.shape == (10, 2, 1)
+
+
+def test_mtnet_rejects_bad_window():
+    from analytics_zoo_tpu.chronos.forecaster import MTNetForecaster
+
+    init_orca_context(cluster_mode="local")
+    fc = MTNetForecaster(long_series_num=2, series_length=4, horizon=1)
+    x = np.zeros((8, 10, 1), np.float32)  # needs 12 steps
+    with pytest.raises(Exception, match="12"):
+        fc.fit({"x": x, "y": np.zeros((8, 1, 1), np.float32)}, epochs=1)
+
+
+def test_mtnet_save_load_roundtrip(tmp_path):
+    from analytics_zoo_tpu.chronos.forecaster import MTNetForecaster
+
+    init_orca_context(cluster_mode="local")
+    fc = MTNetForecaster(long_series_num=2, series_length=4,
+                         cnn_hid_size=8, rnn_hid_size=8, horizon=1,
+                         dropout=0.0)
+    x, y = _sine_series(80, 12, 1)
+    fc.fit({"x": x, "y": y}, epochs=2, batch_size=32)
+    before = fc.predict({"x": x[:5]})
+    p = str(tmp_path / "mtnet.pkl")
+    fc.save(p)
+    fc2 = MTNetForecaster.load(p)
+    np.testing.assert_allclose(fc2.predict({"x": x[:5]}), before,
+                               atol=1e-5)
+
+
+def test_tcmf_factorizes_and_forecasts():
+    from analytics_zoo_tpu.chronos.forecaster import TCMFForecaster
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    n, T, horizon = 40, 64, 4
+    # low-rank structure: every series is a mix of 3 smooth basis waves
+    t = np.arange(T + horizon)
+    basis = np.stack([np.sin(0.2 * t), np.cos(0.13 * t),
+                      np.sin(0.07 * t + 1.0)])
+    mix = rng.normal(size=(n, 3))
+    full = (mix @ basis).astype(np.float32)
+    y_hist, y_future = full[:, :T], full[:, T:]
+
+    fc = TCMFForecaster(rank=8, tcn_lookback=16, num_channels_X=(16, 16),
+                        lr=2e-2)
+    fc.fit({"y": y_hist}, epochs=25)
+    # reconstruction of history must be tight (low-rank fits exactly)
+    recon = fc._F @ fc._X * fc._y_std + fc._y_mean
+    assert np.mean((recon - y_hist) ** 2) < 0.05
+    pred = fc.predict(horizon=horizon)
+    assert pred.shape == (n, horizon)
+    stats = fc.evaluate({"y": y_future})
+    # forecast beats predicting the history mean
+    naive = np.mean((y_hist.mean(axis=1, keepdims=True)
+                     - y_future) ** 2)
+    assert stats["mse"] < naive, (stats, naive)
+
+
+def test_tcmf_save_load(tmp_path):
+    from analytics_zoo_tpu.chronos.forecaster import TCMFForecaster
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(1)
+    y = rng.normal(size=(10, 32)).astype(np.float32)
+    fc = TCMFForecaster(rank=4, tcn_lookback=8, num_channels_X=(8,))
+    fc.fit({"y": y}, epochs=5)
+    before = fc.predict(horizon=2)
+    p = str(tmp_path / "tcmf.pkl")
+    fc.save(p)
+    fc2 = TCMFForecaster.load(p)
+    np.testing.assert_allclose(fc2.predict(horizon=2), before, atol=1e-4)
+
+
+def _multi_id_df(n_ids=4, n_steps=60):
+    rows = []
+    for i in range(n_ids):
+        ts = pd.date_range("2024-01-01", periods=n_steps, freq="h")
+        vals = np.sin(0.2 * np.arange(n_steps) + i) + i
+        rows.append(pd.DataFrame({"dt": ts, "value": vals, "id": str(i)}))
+    return pd.concat(rows, ignore_index=True)
+
+
+def test_xshards_tsdataset_roll_and_train():
+    from analytics_zoo_tpu.chronos.data.experimental import (
+        XShardsTSDataset)
+    from analytics_zoo_tpu.chronos.forecaster import LSTMForecaster
+
+    init_orca_context(cluster_mode="local")
+    df = _multi_id_df()
+    ds = XShardsTSDataset.from_pandas(df, dt_col="dt", target_col="value",
+                                      id_col="id", num_shards=3)
+    ds = ds.impute()
+    ds = ds.scale()
+    shards = ds.roll(lookback=12, horizon=1).to_xshards()
+    blocks = shards.collect()
+    total = sum(len(b["x"]) for b in blocks)
+    # each of 4 ids contributes (60 - 12 - 1 + 1) windows
+    assert total == 4 * 48
+    assert blocks[0]["x"].shape[1:] == (12, 1)
+
+    fc = LSTMForecaster(past_seq_len=12, future_seq_len=1,
+                        input_feature_num=1, output_feature_num=1,
+                        lr=5e-3)
+    fc._estimator().fit(shards, epochs=3, batch_size=32)
+    stats = fc._estimator().evaluate(shards, batch_size=32)
+    assert stats["loss"] < 0.3, stats
+
+
+def test_xshards_tsdataset_global_scaling():
+    from analytics_zoo_tpu.chronos.data.experimental import (
+        XShardsTSDataset)
+
+    init_orca_context(cluster_mode="local")
+    df = _multi_id_df(n_ids=2, n_steps=40)
+    ds = XShardsTSDataset.from_pandas(df, dt_col="dt",
+                                      target_col="value", id_col="id",
+                                      num_shards=2)
+    scaled = ds.scale()
+    merged = pd.concat(scaled.shards.collect(), ignore_index=True)
+    assert abs(merged["value"].mean()) < 1e-6
+    assert abs(merged["value"].std(ddof=0) - 1.0) < 1e-3
+    # unscale_numpy round-trips forecaster output
+    arr = np.array([[[0.0]]], np.float32)
+    un = scaled.unscale_numpy(arr)
+    assert np.isclose(un[0, 0, 0], df["value"].mean(), atol=1e-6)
+
+
+def test_doppelganger_simulator_generates_plausible_series():
+    from analytics_zoo_tpu.chronos.simulator import DPGANSimulator
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    n, T = 200, 16
+    phase = rng.uniform(0, 2 * np.pi, n)
+    amp = rng.uniform(0.5, 1.5, n)
+    feats = (amp[:, None] * np.sin(
+        0.5 * np.arange(T)[None, :] + phase[:, None]))[..., None]
+    attrs = amp[:, None]
+
+    sim = DPGANSimulator(seq_len=T, feature_dim=1, attr_dim=1,
+                         noise_dim=4, hidden=32, lr=1e-3, seed=0)
+    sim.fit(feats, attrs, epochs=30, batch_size=50)
+    g_attrs, g_feats = sim.generate(64)
+    assert g_feats.shape == (64, T, 1)
+    assert g_attrs.shape == (64, 1)
+    assert np.isfinite(g_feats).all()
+    # generated values live in the training range (min-max restored)
+    assert g_feats.min() >= feats.min() - 1e-4
+    assert g_feats.max() <= feats.max() + 1e-4
+    # generator actually trained: adversarial losses recorded + finite
+    assert len(sim.loss_history) == 30
+    assert np.isfinite([h["g_loss"] for h in sim.loss_history]).all()
+    # generated sequences are not constant noise: temporal variation
+    # within a sequence comparable to real data
+    real_var = feats.std(axis=1).mean()
+    gen_var = g_feats.std(axis=1).mean()
+    assert gen_var > 0.2 * real_var
+
+
+def test_doppelganger_save_load_roundtrip(tmp_path):
+    from analytics_zoo_tpu.chronos.simulator import DPGANSimulator
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    feats = rng.uniform(0, 1, (50, 8, 2)).astype(np.float32)
+    sim = DPGANSimulator(seq_len=8, feature_dim=2, attr_dim=0,
+                         noise_dim=4, hidden=16, seed=1)
+    sim.fit(feats, epochs=3, batch_size=25)
+    a1, f1 = sim.generate(10, seed=7)
+    p = str(tmp_path / "dpgan.pkl")
+    sim.save(p)
+    sim2 = DPGANSimulator.load(p)
+    a2, f2 = sim2.generate(10, seed=7)
+    np.testing.assert_allclose(f1, f2, atol=1e-5)
