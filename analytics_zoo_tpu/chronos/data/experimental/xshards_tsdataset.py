@@ -65,6 +65,10 @@ class XShardsTSDataset:
     # -- per-shard TSDataset ops ---------------------------------------
 
     def _wrap(self, df) -> TSDataset:
+        import pandas as pd
+        df = df.copy()
+        # string datetimes would sort lexically and break .dt accessors
+        df[self.dt_col] = pd.to_datetime(df[self.dt_col])
         return TSDataset(df.sort_values(
             [self.id_col, self.dt_col] if self.id_col else [self.dt_col])
             .reset_index(drop=True),
@@ -114,10 +118,10 @@ class XShardsTSDataset:
             # NaN-aware: per-column non-NaN counts, not len(df) — scale()
             # before impute() must not bias the statistics; reindex keeps
             # empty hash partitions (no columns yet) harmless
-            partials = self.shards.transform_shard(
-                lambda df: (df.reindex(columns=cols).sum(),
-                            (df.reindex(columns=cols) ** 2).sum(),
-                            df.reindex(columns=cols).count())).collect()
+            def stats(df):
+                sub = df.reindex(columns=cols)
+                return sub.sum(), (sub ** 2).sum(), sub.count()
+            partials = self.shards.transform_shard(stats).collect()
             count = sum(p[2] for p in partials)
             mean = sum(p[0] for p in partials) / count
             sq = sum(p[1] for p in partials) / count
@@ -163,10 +167,24 @@ class XShardsTSDataset:
         h = (len(horizon) if isinstance(horizon, (list, tuple))
              else horizon)
 
+        needed = lookback + (max(horizon)
+                             if isinstance(horizon, (list, tuple))
+                             else horizon)
+
         def f(df):
+            empty = {"x": np.zeros((0, lookback, n_feat), np.float32),
+                     "y": np.zeros((0, h, n_tgt), np.float32)}
             if len(df) == 0:  # empty hash partition: empty block
-                return {"x": np.zeros((0, lookback, n_feat), np.float32),
-                        "y": np.zeros((0, h, n_tgt), np.float32)}
+                return empty
+            if self.id_col is not None:
+                # drop ids too short to yield a single window — one short
+                # series in a shard must not abort the distributed roll
+                df = df.groupby(self.id_col, sort=False).filter(
+                    lambda g: len(g) >= needed)
+            elif len(df) < needed:
+                df = df.iloc[:0]
+            if len(df) == 0:
+                return empty
             ts = self._wrap(df)
             ts.roll(lookback, horizon)
             x, y = ts.to_numpy()

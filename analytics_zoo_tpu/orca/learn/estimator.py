@@ -123,6 +123,20 @@ class Estimator:
             est._model_state = model_state
         return est
 
+    @staticmethod
+    def from_onnx(path_or_bytes, *, loss=None, optimizer=None,
+                  metrics=None, model_dir=None, **kwargs) -> "Estimator":
+        """Import an .onnx model (reference: the ONNX loader feeding the
+        zoo Keras API, pyzoo/zoo/pipeline/api/onnx/onnx_loader.py).  The
+        graph is interpreted with JAX ops; weight initializers become
+        trainable flax params, so the imported model fine-tunes on the
+        mesh like any native module."""
+        from analytics_zoo_tpu.pipeline.onnx import load_onnx
+        module, _ = load_onnx(path_or_bytes)
+        return Estimator.from_flax(module, loss=loss, optimizer=optimizer,
+                                   metrics=metrics, model_dir=model_dir,
+                                   **kwargs)
+
     # ------------------------------------------------------------------
     # engine bring-up
     # ------------------------------------------------------------------

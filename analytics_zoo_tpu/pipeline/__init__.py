@@ -1,0 +1,2 @@
+"""Pipeline APIs: model import (ONNX), inference, net utilities
+(reference: pyzoo/zoo/pipeline/)."""

@@ -1,0 +1,6 @@
+from analytics_zoo_tpu.pipeline.onnx.onnx_loader import (
+    OnnxModule,
+    load_onnx,
+)
+
+__all__ = ["load_onnx", "OnnxModule"]

@@ -1,0 +1,500 @@
+"""ONNX graph → flax module (reference:
+`pyzoo/zoo/pipeline/api/onnx/onnx_loader.py` + `mapper/*`, ~45 op
+mappers lowering ONNX nodes onto the zoo Keras graph).
+
+TPU-native design: like the torch importer (orca/learn/torch_adapter.py),
+the decoded graph is interpreted inside ONE flax module — initializers
+that feed weight slots of compute ops (Gemm/Conv/BatchNorm/PRelu/...)
+become flax params so the imported model TRAINS on the mesh (sharding
+rules, checkpointing, optimizers all apply); other initializers stay
+constants.  ONNX's NCHW conv convention is executed via
+`lax.conv_general_dilated` with explicit dimension numbers — no
+transpose-dance, XLA lays it out for the MXU either way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.onnx.onnx_proto import (
+    Graph,
+    Model,
+    Node,
+    decode_model,
+)
+
+_OPS: Dict[str, Callable] = {}
+
+
+def _op(name):
+    def deco(fn):
+        _OPS[name] = fn
+        return fn
+    return deco
+
+
+def _attr(node: Node, name: str, default=None):
+    a = node.attrs.get(name)
+    return default if a is None else a.value
+
+
+def _pads_to_jax(pads: Sequence[int], n_spatial: int):
+    """ONNX pads [x1b, x2b, ..., x1e, x2e, ...] -> [(b, e), ...]."""
+    if not pads:
+        return [(0, 0)] * n_spatial
+    return [(pads[i], pads[i + n_spatial]) for i in range(n_spatial)]
+
+
+# -- elementwise / activation ------------------------------------------------
+
+for _name, _fn in [
+        ("Relu", jax.nn.relu), ("Sigmoid", jax.nn.sigmoid),
+        ("Tanh", jnp.tanh), ("Exp", jnp.exp), ("Log", jnp.log),
+        ("Sqrt", jnp.sqrt), ("Neg", jnp.negative), ("Abs", jnp.abs),
+        ("Floor", jnp.floor), ("Ceil", jnp.ceil), ("Erf", jax.lax.erf),
+        ("Softplus", jax.nn.softplus), ("Softsign", jax.nn.soft_sign),
+        ("Identity", lambda x: x), ("Sign", jnp.sign)]:
+    _OPS[_name] = (lambda fn: lambda mod, node, x: fn(x))(_fn)
+
+for _name, _fn in [("Add", jnp.add), ("Sub", jnp.subtract),
+                   ("Mul", jnp.multiply), ("Div", jnp.divide),
+                   ("Pow", jnp.power), ("Max", jnp.maximum),
+                   ("Min", jnp.minimum)]:
+    _OPS[_name] = (lambda fn: lambda mod, node, a, b: fn(a, b))(_fn)
+
+
+@_op("LeakyRelu")
+def _leaky(mod, node, x):
+    return jax.nn.leaky_relu(x, _attr(node, "alpha", 0.01))
+
+
+@_op("Elu")
+def _elu(mod, node, x):
+    return jax.nn.elu(x, _attr(node, "alpha", 1.0))
+
+
+@_op("Selu")
+def _selu(mod, node, x):
+    return jax.nn.selu(x)
+
+
+@_op("PRelu")
+def _prelu(mod, node, x, slope):
+    return jnp.where(x >= 0, x, x * slope)
+
+
+@_op("HardSigmoid")
+def _hard_sigmoid(mod, node, x):
+    a = _attr(node, "alpha", 0.2)
+    b = _attr(node, "beta", 0.5)
+    return jnp.clip(a * x + b, 0.0, 1.0)
+
+
+@_op("Clip")
+def _clip(mod, node, x, lo=None, hi=None):
+    lo = _attr(node, "min", lo)
+    hi = _attr(node, "max", hi)
+    return jnp.clip(x, lo, hi)
+
+
+@_op("Softmax")
+def _softmax(mod, node, x):
+    return jax.nn.softmax(x, axis=_attr(node, "axis", -1))
+
+
+@_op("LogSoftmax")
+def _log_softmax(mod, node, x):
+    return jax.nn.log_softmax(x, axis=_attr(node, "axis", -1))
+
+
+# -- linear algebra ----------------------------------------------------------
+
+@_op("MatMul")
+def _matmul(mod, node, a, b):
+    return jnp.matmul(a, b)
+
+
+@_op("Gemm")
+def _gemm(mod, node, a, b, c=None):
+    alpha = _attr(node, "alpha", 1.0)
+    beta = _attr(node, "beta", 1.0)
+    if _attr(node, "transA", 0):
+        a = a.T
+    if _attr(node, "transB", 0):
+        b = b.T
+    out = alpha * (a @ b)
+    if c is not None:
+        out = out + beta * c
+    return out
+
+
+# -- conv / pooling ----------------------------------------------------------
+
+@_op("Conv")
+def _conv(mod, node, x, w, b=None):
+    n_sp = x.ndim - 2
+    strides = tuple(_attr(node, "strides", [1] * n_sp))
+    dilations = tuple(_attr(node, "dilations", [1] * n_sp))
+    groups = _attr(node, "group", 1)
+    auto_pad = (_attr(node, "auto_pad", b"NOTSET") or b"NOTSET").decode()
+    if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+        # XLA's "SAME" is SAME_UPPER; SAME_LOWER puts the odd pad pixel
+        # at the BEGINNING, so build explicit pads from the static shape
+        padding = []
+        for d in range(n_sp):
+            size = x.shape[2 + d]
+            eff_k = (w.shape[2 + d] - 1) * dilations[d] + 1
+            out_size = -(-size // strides[d])
+            total = max((out_size - 1) * strides[d] + eff_k - size, 0)
+            small, big = total // 2, total - total // 2
+            padding.append((big, small) if auto_pad == "SAME_LOWER"
+                           else (small, big))
+    else:
+        padding = _pads_to_jax(_attr(node, "pads", []), n_sp)
+    spatial = "".join("DHW"[3 - n_sp:])
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
+    out = jax.lax.conv_general_dilated(
+        x, w, strides, padding, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * n_sp)
+    return out
+
+
+def _pool(x, node, reducer, init, is_avg):
+    n_sp = x.ndim - 2
+    ks = tuple(_attr(node, "kernel_shape"))
+    strides = tuple(_attr(node, "strides", list(ks)))
+    pads = _pads_to_jax(_attr(node, "pads", []), n_sp)
+    window = (1, 1) + ks
+    stride = (1, 1) + strides
+    padding = [(0, 0), (0, 0)] + pads
+    out = jax.lax.reduce_window(x, init, reducer, window, stride, padding)
+    if is_avg:
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(
+            ones, 0.0, jax.lax.add, window, stride, padding)
+        if _attr(node, "count_include_pad", 0):
+            counts = jnp.full_like(counts, float(np.prod(ks)))
+        out = out / counts
+    return out
+
+
+@_op("MaxPool")
+def _maxpool(mod, node, x):
+    return _pool(x, node, jax.lax.max, -jnp.inf, False)
+
+
+@_op("AveragePool")
+def _avgpool(mod, node, x):
+    return _pool(x, node, jax.lax.add, 0.0, True)
+
+
+@_op("GlobalAveragePool")
+def _gap(mod, node, x):
+    return x.mean(axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@_op("GlobalMaxPool")
+def _gmp(mod, node, x):
+    return x.max(axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@_op("BatchNormalization")
+def _batchnorm(mod, node, x, scale, bias, mean, var):
+    eps = _attr(node, "epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean.reshape(shape)) / jnp.sqrt(
+        var.reshape(shape) + eps) * scale.reshape(shape) \
+        + bias.reshape(shape)
+
+
+@_op("InstanceNormalization")
+def _instancenorm(mod, node, x, scale, bias):
+    eps = _attr(node, "epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mu = x.mean(axis=axes, keepdims=True)
+    var = x.var(axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mu) / jnp.sqrt(var + eps) * scale.reshape(shape) \
+        + bias.reshape(shape)
+
+
+@_op("LRN")
+def _lrn(mod, node, x):
+    size = _attr(node, "size")
+    alpha = _attr(node, "alpha", 1e-4)
+    beta = _attr(node, "beta", 0.75)
+    k = _attr(node, "bias", 1.0)
+    sq = x * x
+    half = size // 2
+    pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    summed = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add, (1, size) + (1,) * (x.ndim - 2),
+        (1,) * x.ndim, pads)
+    return x / jnp.power(k + alpha / size * summed, beta)
+
+
+@_op("Dropout")
+def _dropout(mod, node, x, *unused):
+    return x  # inference semantics; training dropout is the engine's job
+
+
+# -- shape ops ---------------------------------------------------------------
+
+@_op("Reshape")
+def _reshape(mod, node, x, shape=None):
+    if shape is None:
+        shape = _attr(node, "shape")
+    target = [int(s) for s in np.asarray(shape).tolist()]
+    # ONNX: 0 means "copy input dim"
+    target = [x.shape[i] if s == 0 else s for i, s in enumerate(target)]
+    return x.reshape(target)
+
+
+@_op("Flatten")
+def _flatten(mod, node, x):
+    axis = _attr(node, "axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return x.reshape(lead, -1)
+
+
+@_op("Transpose")
+def _transpose(mod, node, x):
+    perm = _attr(node, "perm")
+    return jnp.transpose(x, perm) if perm else jnp.transpose(x)
+
+
+@_op("Squeeze")
+def _squeeze(mod, node, x, axes=None):
+    if axes is None:
+        axes = _attr(node, "axes")
+    if axes is None:
+        return jnp.squeeze(x)
+    return jnp.squeeze(x, tuple(int(a) for a in np.asarray(axes)))
+
+
+@_op("Unsqueeze")
+def _unsqueeze(mod, node, x, axes=None):
+    if axes is None:
+        axes = _attr(node, "axes")
+    for a in sorted(int(v) for v in np.asarray(axes)):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+@_op("Concat")
+def _concat(mod, node, *xs):
+    return jnp.concatenate(xs, axis=_attr(node, "axis", 0))
+
+
+@_op("Split")
+def _split(mod, node, x, split=None):
+    axis = _attr(node, "axis", 0)
+    if split is None:
+        split = _attr(node, "split")
+    if split is None:
+        n = len(node.outputs)
+        return tuple(jnp.split(x, n, axis=axis))
+    sizes = np.cumsum(np.asarray(split))[:-1]
+    return tuple(jnp.split(x, sizes.tolist(), axis=axis))
+
+
+@_op("Slice")
+def _slice(mod, node, x, starts=None, ends=None, axes=None, steps=None):
+    if starts is None:  # opset<10 keeps these as attributes
+        starts = _attr(node, "starts")
+        ends = _attr(node, "ends")
+        axes = _attr(node, "axes")
+    starts = np.asarray(starts).tolist()
+    ends = np.asarray(ends).tolist()
+    axes = (np.asarray(axes).tolist() if axes is not None
+            else list(range(len(starts))))
+    steps = (np.asarray(steps).tolist() if steps is not None
+             else [1] * len(starts))
+    idx = [slice(None)] * x.ndim
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        idx[a] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+@_op("Gather")
+def _gather(mod, node, x, indices):
+    return jnp.take(x, indices.astype(jnp.int32),
+                    axis=_attr(node, "axis", 0))
+
+
+@_op("Pad")
+def _pad(mod, node, x, pads=None, value=None):
+    if pads is None:
+        pads = _attr(node, "pads")
+    pads = np.asarray(pads).tolist()
+    n = x.ndim
+    width = [(pads[i], pads[i + n]) for i in range(n)]
+    mode = (_attr(node, "mode", b"constant") or b"constant").decode()
+    if mode == "constant":
+        cv = float(np.asarray(value)) if value is not None else 0.0
+        return jnp.pad(x, width, constant_values=cv)
+    return jnp.pad(x, width, mode={"reflect": "reflect",
+                                   "edge": "edge"}[mode])
+
+
+@_op("Expand")
+def _expand(mod, node, x, shape):
+    return jnp.broadcast_to(
+        x, np.broadcast_shapes(x.shape,
+                               tuple(np.asarray(shape).tolist())))
+
+
+@_op("Shape")
+def _shape(mod, node, x):
+    return jnp.asarray(x.shape, jnp.int64)
+
+
+@_op("Cast")
+def _cast(mod, node, x):
+    from analytics_zoo_tpu.pipeline.onnx.onnx_proto import DTYPE
+    return x.astype(DTYPE[_attr(node, "to")])
+
+
+# -- reductions --------------------------------------------------------------
+
+def _reduce(fn):
+    def impl(mod, node, x, axes=None):
+        if axes is None:
+            axes = _attr(node, "axes")
+        keep = bool(_attr(node, "keepdims", 1))
+        ax = (tuple(int(a) for a in np.asarray(axes))
+              if axes is not None else None)
+        return fn(x, axis=ax, keepdims=keep)
+    return impl
+
+
+for _name, _fn in [("ReduceMean", jnp.mean), ("ReduceSum", jnp.sum),
+                   ("ReduceMax", jnp.max), ("ReduceMin", jnp.min),
+                   ("ReduceProd", jnp.prod)]:
+    _OPS[_name] = _reduce(_fn)
+
+
+@_op("ArgMax")
+def _argmax(mod, node, x):
+    axis = _attr(node, "axis", 0)
+    keep = bool(_attr(node, "keepdims", 1))
+    out = jnp.argmax(x, axis=axis)
+    return jnp.expand_dims(out, axis) if keep else out
+
+
+@_op("Constant")
+def _constant(mod, node):
+    return jnp.asarray(_attr(node, "value"))
+
+
+# ---------------------------------------------------------------------------
+# interpreter module
+# ---------------------------------------------------------------------------
+
+#: ops whose tensor inputs (beyond the data input) are trainable weights
+_WEIGHT_SLOTS = {
+    "Conv": (1, 2), "ConvTranspose": (1, 2), "Gemm": (1, 2),
+    "MatMul": (1,), "BatchNormalization": (1, 2),
+    "InstanceNormalization": (1, 2), "PRelu": (1,),
+}
+#: BatchNorm running stats: mutable, not trained by SGD
+_STAT_SLOTS = {"BatchNormalization": (3, 4)}
+
+
+class OnnxModule(nn.Module):
+    """Interprets a decoded ONNX graph with JAX ops; weight-slot
+    initializers are flax params, BatchNorm running stats live in the
+    `batch_stats` collection (frozen at import — ONNX graphs are
+    inference graphs; fine-tuning updates them through the optimizer-free
+    model_state path like the torch importer)."""
+
+    model: Model
+
+    @nn.compact
+    def __call__(self, *args, training: bool = False):
+        g = self.model.graph
+        param_names, stat_names = set(), set()
+        for node in g.nodes:
+            for slot in _WEIGHT_SLOTS.get(node.op_type, ()):
+                if slot < len(node.inputs) \
+                        and node.inputs[slot] in g.initializers:
+                    param_names.add(node.inputs[slot])
+            for slot in _STAT_SLOTS.get(node.op_type, ()):
+                if slot < len(node.inputs) \
+                        and node.inputs[slot] in g.initializers:
+                    stat_names.add(node.inputs[slot])
+        stat_names -= param_names
+
+        env: Dict[str, Any] = {}
+        feed_inputs = [name for name, _ in g.inputs
+                       if name not in g.initializers]
+        if len(args) != len(feed_inputs):
+            raise ValueError(
+                f"graph expects {len(feed_inputs)} inputs "
+                f"{feed_inputs}, got {len(args)}")
+        env.update(zip(feed_inputs, args))
+        for name, arr in g.initializers.items():
+            safe = name.replace(".", "_").replace("/", "_")
+            if name in param_names:
+                env[name] = self.param(
+                    safe, lambda _k, a=arr: jnp.asarray(a))
+            elif name in stat_names:
+                env[name] = self.variable(
+                    "batch_stats", safe,
+                    lambda a=arr: jnp.asarray(a)).value
+            else:
+                env[name] = jnp.asarray(arr)
+
+        out_vals = None
+        for node in g.nodes:
+            fn = _OPS.get(node.op_type)
+            if fn is None:
+                raise NotImplementedError(
+                    f"ONNX op '{node.op_type}' is not supported "
+                    f"(supported: {sorted(_OPS)})")
+            ins = []
+            for i in node.inputs:
+                if not i:
+                    ins.append(None)
+                elif i in env:
+                    ins.append(env[i])
+                else:
+                    raise ValueError(
+                        f"tensor '{i}' consumed by {node.op_type} was "
+                        "never produced (optional secondary op outputs "
+                        "are not supported)")
+            result = fn(self, node, *ins)
+            if isinstance(result, (tuple, list)):
+                for oname, val in zip(node.outputs, result):
+                    env[oname] = val
+            else:
+                # single-array result: bind the primary output only —
+                # iterating the array would scatter batch rows across
+                # declared optional outputs (e.g. MaxPool Indices)
+                env[node.outputs[0]] = result
+        outs = [env[o] for o in g.outputs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def load_onnx(path_or_bytes) -> Tuple[OnnxModule, Model]:
+    """Decode an .onnx file (path or bytes) into an interpretable flax
+    module.  Use with the estimator:
+    `Estimator.from_onnx(path, loss=..., optimizer=...)`."""
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    model = decode_model(data)
+    if not model.graph.nodes:
+        raise ValueError("decoded ONNX model has no graph nodes")
+    return OnnxModule(model), model
