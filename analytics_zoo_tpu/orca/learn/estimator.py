@@ -73,6 +73,9 @@ class Estimator:
         self._epoch = 0
         #: failure-retry count across fit calls (observability)
         self.retries = 0
+        self._tb_writers = None
+        #: per-step wall times from fit(..., profile=True)
+        self.profile_stats: List[Dict[str, Any]] = []
 
     # ------------------------------------------------------------------
     # factories
@@ -178,7 +181,8 @@ class Estimator:
             checkpoint_trigger: Optional[Trigger] = None,
             shuffle: bool = True,
             nan_policy: str = "warn",
-            max_failures: Optional[int] = None) -> "Estimator":
+            max_failures: Optional[int] = None,
+            profile: bool = False) -> "Estimator":
         """Train for `epochs`.  On a training failure the latest checkpoint
         under `model_dir` is restored and training resumes, up to
         `max_failures` times (default `OrcaContext.failure_retry_times`) —
@@ -210,7 +214,7 @@ class Estimator:
                     self._restore_latest(start_epoch, target_epoch)
                     pending_restore = False
                 self._fit_one_epoch(ds, val_ds, batch_size, trigger,
-                                    shuffle, nan_policy)
+                                    shuffle, nan_policy, profile)
             except (NaNLossError, KeyboardInterrupt):
                 raise
             except Exception as e:
@@ -227,7 +231,7 @@ class Estimator:
         return self
 
     def _fit_one_epoch(self, ds, val_ds, batch_size, trigger, shuffle,
-                       nan_policy):
+                       nan_policy, profile=False):
         eng = self._engine
         mult = eng.pad_multiple()
 
@@ -241,7 +245,9 @@ class Estimator:
         stats = eng.run_epoch(
             ds.batches(batch_size, shuffle=shuffle, seed=self._seed,
                        pad_to_multiple_of=mult, epoch=self._epoch),
-            train=True, on_step=on_step)
+            train=True, on_step=on_step, profile=profile)
+        if profile:
+            self.profile_stats.extend(eng.last_profile)
         self._epoch += 1
         if trigger is not None and hasattr(trigger, "last_loss"):
             trigger.last_loss = stats.get("loss")
@@ -250,6 +256,7 @@ class Estimator:
                      wall_s=time.time() - t0,
                      samples_per_s=ds.n / max(time.time() - t0, 1e-9))
         self.train_summary.append(stats)
+        self._tb_log("train", stats, step)
         if val_ds is not None:
             vstats = eng.run_epoch(
                 val_ds.batches(batch_size,
@@ -257,6 +264,7 @@ class Estimator:
                 train=False)
             vstats.update(epoch=self._epoch, step=step)
             self.val_summary.append(vstats)
+            self._tb_log("validation", vstats, step)
         if trigger and self.model_dir and trigger(
                 epoch=self._epoch, step=step, epoch_end=True):
             self.save_checkpoint()
@@ -394,6 +402,27 @@ class Estimator:
     # ------------------------------------------------------------------
     # summaries
     # ------------------------------------------------------------------
+
+    def set_tensorboard(self, log_dir: str, app_name: str):
+        """Write real TensorBoard event files under
+        `log_dir/app_name/{train,validation}` (reference:
+        tf/estimator.py set_tensorboard + the JVM tensorboard writers)."""
+        from analytics_zoo_tpu.utils.summary import SummaryWriter
+        base = os.path.join(log_dir, app_name)
+        self._tb_writers = {
+            "train": SummaryWriter(os.path.join(base, "train")),
+            "validation": SummaryWriter(
+                os.path.join(base, "validation")),
+        }
+        return self
+
+    def _tb_log(self, split: str, stats: Dict[str, Any], step: int):
+        if not self._tb_writers:
+            return
+        scalars = {k: float(v) for k, v in stats.items()
+                   if isinstance(v, (int, float)) and k not in
+                   ("epoch", "step")}
+        self._tb_writers[split].add_scalars(scalars, step)
 
     def get_train_summary(self, tag: str):
         """(step, value) rows for a stat, like the reference's TensorBoard

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Optional, Union
 
+import jax
+import jax.numpy as jnp
 import optax
 
 
@@ -149,8 +151,16 @@ def resolve(optimizer, learning_rate: Optional[float] = None,
     clips = []
     if clip_norm:
         clips.append(optax.clip_by_global_norm(clip_norm))
-    if clip_value:
-        clips.append(optax.clip(clip_value))
+    if clip_value is not None:
+        if isinstance(clip_value, (tuple, list)):
+            # asymmetric constant clipping, the reference's
+            # setConstantGradientClipping(min, max) contract
+            lo, hi = float(clip_value[0]), float(clip_value[1])
+            clips.append(optax.stateless(
+                lambda updates, params=None: jax.tree_util.tree_map(
+                    lambda g: jnp.clip(g, lo, hi), updates)))
+        elif clip_value:
+            clips.append(optax.clip(clip_value))
     if clips:
         tx = optax.chain(*clips, tx)
     return tx

@@ -195,8 +195,8 @@ class SPMDEngine:
             yield staged.popleft()
 
     def run_epoch(self, batch_iter, train: bool = True,
-                  on_step: Optional[Callable[[int], None]] = None
-                  ) -> Dict[str, float]:
+                  on_step: Optional[Callable[[int], None]] = None,
+                  profile: bool = False) -> Dict[str, float]:
         """Drive one pass; returns weighted-average stats over real rows.
         `on_step(global_step)` is called after each training step (for
         step-granular triggers).
@@ -212,12 +212,22 @@ class SPMDEngine:
         # host-side step mirror: avoids a device sync per step just to
         # know the step number
         step = int(np.asarray(self.state.step)) if train else 0
+        self.last_profile = []
         for batch in self._prefetch(batch_iter):
+            t0 = time.perf_counter() if profile else 0.0
             if train:
                 self.state, stats = self._train_step(self.state, batch)
                 step += 1
             else:
                 stats = self._eval_step(self.state, batch)
+            if profile:
+                # opt-in: blocking per step defeats async dispatch, but
+                # gives true per-step wall time (reference torch_runner
+                # profile=True semantics)
+                jax.block_until_ready(stats["_count"])
+                self.last_profile.append(
+                    {"step": step,
+                     "step_time_s": time.perf_counter() - t0})
             if totals is None:
                 totals = jax.tree_util.tree_map(jnp.zeros_like, stats)
             totals = self._accum(totals, stats)

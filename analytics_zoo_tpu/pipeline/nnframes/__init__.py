@@ -1,0 +1,11 @@
+from analytics_zoo_tpu.pipeline.nnframes.nn_classifier import (
+    NNClassifier,
+    NNClassifierModel,
+    NNEstimator,
+    NNModel,
+    XGBClassifier,
+    XGBRegressor,
+)
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "XGBClassifier", "XGBRegressor"]

@@ -1,0 +1,305 @@
+"""NNFrames — ML-pipeline-style Estimator/Transformer stages.
+
+Reference: `pyzoo/zoo/pipeline/nnframes/nn_classifier.py:139`
+(NNEstimator/NNModel as `org.apache.spark.ml` stages over DataFrames with
+Preprocessing-typed feature/label columns), `:613` (NNClassifier),
+`:685-780` (XGBClassifier/XGBRegressor wrappers).
+
+TPU-native design: the same fluent stage API (`setBatchSize`,
+`setMaxEpoch`, `setFeaturesCol`, ... then `fit(df) -> NNModel`,
+`model.transform(df) -> df + prediction column`) over pandas DataFrames
+and XShards-of-DataFrames, lowering onto the unified orca Estimator —
+one engine underneath instead of the reference's DP-1.  Feature/label
+columns pass through `feature.common.Preprocessing` chains exactly like
+the reference's `FeatureLabelPreprocessing`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.feature.common import Preprocessing, SeqToTensor
+from analytics_zoo_tpu.orca.data.shard import XShards
+
+
+def _col_to_array(df: pd.DataFrame, col: str,
+                  pre: Optional[Preprocessing]) -> np.ndarray:
+    vals = df[col].to_numpy()
+    if vals.dtype == object:
+        vals = np.stack([np.asarray(v, np.float32) for v in vals])
+    if pre is not None:
+        vals = np.stack([np.asarray(pre.apply(v)) for v in vals])
+    return vals
+
+
+class NNEstimator:
+    """fit(df) -> NNModel.  `module` is a flax module (or anything
+    `Estimator.from_flax` accepts); feature/label preprocessing are
+    `Preprocessing` chains applied per row (reference NNEstimator's
+    FeatureLabelPreprocessing contract)."""
+
+    def __init__(self, module, loss,
+                 feature_preprocessing: Optional[Preprocessing] = None,
+                 label_preprocessing: Optional[Preprocessing] = None):
+        self.module = module
+        self.loss = loss
+        self.feature_preprocessing = feature_preprocessing
+        self.label_preprocessing = label_preprocessing
+        self.batch_size = 32
+        self.max_epoch = 1
+        self.learning_rate = 1e-3
+        self.optimizer = "adam"
+        self.features_col = "features"
+        self.label_col = "label"
+        self.prediction_col = "prediction"
+        self.caching_sample = True
+        self.clip_norm = None
+        self.clip_value = None
+        self.checkpoint_path = None
+        self.checkpoint_trigger = None
+        self.validation = None
+
+    # -- fluent setters (reference :236-513) -----------------------------
+
+    def setBatchSize(self, v):
+        self.batch_size = int(v)
+        return self
+
+    def setMaxEpoch(self, v):
+        self.max_epoch = int(v)
+        return self
+
+    def setLearningRate(self, v):
+        self.learning_rate = float(v)
+        return self
+
+    def setOptimMethod(self, v):
+        self.optimizer = v
+        return self
+
+    def setFeaturesCol(self, v):
+        self.features_col = v
+        return self
+
+    def setLabelCol(self, v):
+        self.label_col = v
+        return self
+
+    def setPredictionCol(self, v):
+        self.prediction_col = v
+        return self
+
+    def setConstantGradientClipping(self, min_v, max_v):
+        # asymmetric range preserved end to end (optimizers.resolve
+        # accepts a (min, max) tuple)
+        self.clip_value = (float(min_v), float(max_v))
+        return self
+
+    def setGradientClippingByL2Norm(self, norm):
+        self.clip_norm = float(norm)
+        return self
+
+    def setCheckpoint(self, path, trigger=None):
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger
+        return self
+
+    def setValidation(self, val_df, batch_size: Optional[int] = None):
+        self.validation = (val_df, batch_size or self.batch_size)
+        return self
+
+    # -- stage contract ---------------------------------------------------
+
+    def _build_orca(self):
+        from analytics_zoo_tpu.orca.learn.estimator import Estimator
+        return Estimator.from_flax(
+            self.module, loss=self.loss, optimizer=self.optimizer,
+            learning_rate=self.learning_rate, clip_norm=self.clip_norm,
+            clip_value=self.clip_value, model_dir=self.checkpoint_path)
+
+    def _xy(self, df: pd.DataFrame):
+        x = _col_to_array(df, self.features_col,
+                          self.feature_preprocessing)
+        y = None
+        if self.label_col in df.columns:
+            y = _col_to_array(df, self.label_col,
+                              self.label_preprocessing)
+        return x, y
+
+    def _prepare(self, data):
+        if isinstance(data, XShards):
+            est = self
+
+            def conv(df):
+                x, y = est._xy(df)
+                return {"x": x, "y": y} if y is not None else {"x": x}
+            return data.transform_shard(conv)
+        x, y = self._xy(data)
+        return {"x": x, "y": y} if y is not None else {"x": x}
+
+    def fit(self, df) -> "NNModel":
+        orca = self._build_orca()
+        kwargs = {}
+        if self.validation is not None:
+            kwargs["validation_data"] = self._prepare(self.validation[0])
+        if self.checkpoint_trigger is not None:
+            kwargs["checkpoint_trigger"] = self.checkpoint_trigger
+        orca.fit(self._prepare(df), epochs=self.max_epoch,
+                 batch_size=self.batch_size, **kwargs)
+        return self._model(orca)
+
+    def _model(self, orca) -> "NNModel":
+        m = NNModel(orca, self.feature_preprocessing)
+        m.features_col = self.features_col
+        m.prediction_col = self.prediction_col
+        m.batch_size = self.batch_size
+        return m
+
+
+class NNModel:
+    """Transformer: transform(df) appends the prediction column
+    (reference :517)."""
+
+    def __init__(self, orca_estimator,
+                 feature_preprocessing: Optional[Preprocessing] = None):
+        self.orca = orca_estimator
+        self.feature_preprocessing = feature_preprocessing
+        self.features_col = "features"
+        self.prediction_col = "prediction"
+        self.batch_size = 32
+
+    def setFeaturesCol(self, v):
+        self.features_col = v
+        return self
+
+    def setPredictionCol(self, v):
+        self.prediction_col = v
+        return self
+
+    def _predict_df(self, df: pd.DataFrame) -> pd.DataFrame:
+        x = _col_to_array(df, self.features_col,
+                          self.feature_preprocessing)
+        preds = self.orca.predict({"x": x}, batch_size=self.batch_size)
+        preds = np.asarray(preds)
+        out = df.copy()
+        out[self.prediction_col] = (list(preds) if preds.ndim > 1
+                                    else preds)
+        return out
+
+    def transform(self, df):
+        if isinstance(df, XShards):
+            return df.transform_shard(self._predict_df)
+        return self._predict_df(df)
+
+    def save(self, path: str):
+        self.orca.save(path)
+        return path
+
+
+class NNClassifier(NNEstimator):
+    """Classification sugar: default sparse-CE loss, predictions are
+    argmax class ids (reference :613; labels are 0-based ints here —
+    the reference's 1-based Spark-ML convention is a JVM artifact)."""
+
+    def __init__(self, module,
+                 loss="sparse_categorical_crossentropy",
+                 feature_preprocessing: Optional[Preprocessing] = None):
+        super().__init__(module, loss, feature_preprocessing)
+
+    def _model(self, orca) -> "NNClassifierModel":
+        m = NNClassifierModel(orca, self.feature_preprocessing)
+        m.features_col = self.features_col
+        m.prediction_col = self.prediction_col
+        m.batch_size = self.batch_size
+        return m
+
+
+class NNClassifierModel(NNModel):
+    def _predict_df(self, df: pd.DataFrame) -> pd.DataFrame:
+        x = _col_to_array(df, self.features_col,
+                          self.feature_preprocessing)
+        logits = np.asarray(
+            self.orca.predict({"x": x}, batch_size=self.batch_size))
+        out = df.copy()
+        out[self.prediction_col] = logits.argmax(axis=-1)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# XGBoost wrappers (reference :685-780) — dep-gated like ARIMA/Prophet
+# ---------------------------------------------------------------------------
+
+def _require_xgboost():
+    from analytics_zoo_tpu.utils.deps import require
+    return require("xgboost", "XGBClassifier/XGBRegressor")
+
+
+class _XGBBase:
+    _cls_attr = None
+
+    def __init__(self, params: Optional[dict] = None):
+        self.params = dict(params or {})
+        self.features_col = "features"
+        self.label_col = "label"
+        self.prediction_col = "prediction"
+        self._model = None
+
+    def setNthread(self, v):
+        self.params["n_jobs"] = int(v)
+        return self
+
+    def setNumRound(self, v):
+        self.params["n_estimators"] = int(v)
+        return self
+
+    def setMaxDepth(self, v):
+        self.params["max_depth"] = int(v)
+        return self
+
+    def setMissing(self, v):
+        self.params["missing"] = v
+        return self
+
+    def setFeaturesCol(self, v):
+        self.features_col = v
+        return self
+
+    def setLabelCol(self, v):
+        self.label_col = v
+        return self
+
+    def setPredictionCol(self, v):
+        self.prediction_col = v
+        return self
+
+    def _xy(self, df):
+        x = _col_to_array(df, self.features_col, None)
+        y = (df[self.label_col].to_numpy()
+             if self.label_col in df.columns else None)
+        return x, y
+
+    def fit(self, df):
+        xgb = _require_xgboost()
+        cls = getattr(xgb, self._cls_attr)
+        x, y = self._xy(df)
+        self._model = cls(**self.params).fit(x, y)
+        return self
+
+    def transform(self, df):
+        if self._model is None:
+            raise RuntimeError("call fit first")
+        x, _ = self._xy(df)
+        out = df.copy()
+        out[self.prediction_col] = self._model.predict(x)
+        return out
+
+
+class XGBClassifier(_XGBBase):
+    _cls_attr = "XGBClassifier"
+
+
+class XGBRegressor(_XGBBase):
+    _cls_attr = "XGBRegressor"

@@ -43,6 +43,18 @@ def _attr(node: Node, name: str, default=None):
     return default if a is None else a.value
 
 
+def _static_ints(x, what: str) -> List[int]:
+    """Shape-like inputs must be trace-time constants; under jit a
+    data-dependent value is a tracer and np.asarray would raise a cryptic
+    TracerArrayConversionError deep inside the step function."""
+    try:
+        return [int(v) for v in np.asarray(x).ravel()]
+    except Exception as e:
+        raise NotImplementedError(
+            f"data-dependent {what} is not supported (XLA needs static "
+            "shapes; the value is a traced tensor)") from e
+
+
 def _pads_to_jax(pads: Sequence[int], n_spatial: int):
     """ONNX pads [x1b, x2b, ..., x1e, x2e, ...] -> [(b, e), ...]."""
     if not pads:
@@ -253,7 +265,7 @@ def _dropout(mod, node, x, *unused):
 def _reshape(mod, node, x, shape=None):
     if shape is None:
         shape = _attr(node, "shape")
-    target = [int(s) for s in np.asarray(shape).tolist()]
+    target = _static_ints(shape, "Reshape target shape")
     # ONNX: 0 means "copy input dim"
     target = [x.shape[i] if s == 0 else s for i, s in enumerate(target)]
     return x.reshape(target)
@@ -278,14 +290,14 @@ def _squeeze(mod, node, x, axes=None):
         axes = _attr(node, "axes")
     if axes is None:
         return jnp.squeeze(x)
-    return jnp.squeeze(x, tuple(int(a) for a in np.asarray(axes)))
+    return jnp.squeeze(x, tuple(_static_ints(axes, "Squeeze axes")))
 
 
 @_op("Unsqueeze")
 def _unsqueeze(mod, node, x, axes=None):
     if axes is None:
         axes = _attr(node, "axes")
-    for a in sorted(int(v) for v in np.asarray(axes)):
+    for a in sorted(_static_ints(axes, "Unsqueeze axes")):
         x = jnp.expand_dims(x, a)
     return x
 
@@ -303,7 +315,7 @@ def _split(mod, node, x, split=None):
     if split is None:
         n = len(node.outputs)
         return tuple(jnp.split(x, n, axis=axis))
-    sizes = np.cumsum(np.asarray(split))[:-1]
+    sizes = np.cumsum(_static_ints(split, "Split sizes"))[:-1]
     return tuple(jnp.split(x, sizes.tolist(), axis=axis))
 
 
@@ -313,11 +325,11 @@ def _slice(mod, node, x, starts=None, ends=None, axes=None, steps=None):
         starts = _attr(node, "starts")
         ends = _attr(node, "ends")
         axes = _attr(node, "axes")
-    starts = np.asarray(starts).tolist()
-    ends = np.asarray(ends).tolist()
-    axes = (np.asarray(axes).tolist() if axes is not None
+    starts = _static_ints(starts, "Slice starts")
+    ends = _static_ints(ends, "Slice ends")
+    axes = (_static_ints(axes, "Slice axes") if axes is not None
             else list(range(len(starts))))
-    steps = (np.asarray(steps).tolist() if steps is not None
+    steps = (_static_ints(steps, "Slice steps") if steps is not None
              else [1] * len(starts))
     idx = [slice(None)] * x.ndim
     for s, e, a, st in zip(starts, ends, axes, steps):
@@ -335,7 +347,7 @@ def _gather(mod, node, x, indices):
 def _pad(mod, node, x, pads=None, value=None):
     if pads is None:
         pads = _attr(node, "pads")
-    pads = np.asarray(pads).tolist()
+    pads = _static_ints(pads, "Pad widths")
     n = x.ndim
     width = [(pads[i], pads[i + n]) for i in range(n)]
     mode = (_attr(node, "mode", b"constant") or b"constant").decode()
@@ -349,8 +361,8 @@ def _pad(mod, node, x, pads=None, value=None):
 @_op("Expand")
 def _expand(mod, node, x, shape):
     return jnp.broadcast_to(
-        x, np.broadcast_shapes(x.shape,
-                               tuple(np.asarray(shape).tolist())))
+        x, np.broadcast_shapes(
+            x.shape, tuple(_static_ints(shape, "Expand shape"))))
 
 
 @_op("Shape")

@@ -22,6 +22,8 @@ from analytics_zoo_tpu.utils.tf_example import (
     _read_varint,
     _tag,
     _varint,
+    to_signed as _signed,
+    walk_fields as _walk,
 )
 
 # TensorProto.DataType -> numpy
@@ -29,34 +31,6 @@ DTYPE = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
          5: np.int16, 6: np.int32, 7: np.int64, 9: np.bool_,
          10: np.float16, 11: np.float64, 12: np.uint32, 13: np.uint64}
 DTYPE_REV = {np.dtype(v): k for k, v in DTYPE.items()}
-
-
-def _walk(buf: bytes):
-    """Yield (field_number, wire_type, value) over a message payload."""
-    pos = 0
-    n = len(buf)
-    while pos < n:
-        tag, pos = _read_varint(buf, pos)
-        fnum, wire = tag >> 3, tag & 7
-        if wire == 0:
-            v, pos = _read_varint(buf, pos)
-        elif wire == 1:
-            v = buf[pos:pos + 8]
-            pos += 8
-        elif wire == 2:
-            ln, pos = _read_varint(buf, pos)
-            v = buf[pos:pos + ln]
-            pos += ln
-        elif wire == 5:
-            v = buf[pos:pos + 4]
-            pos += 4
-        else:
-            raise ValueError(f"unsupported wire type {wire}")
-        yield fnum, wire, v
-
-
-def _signed(v: int) -> int:
-    return v - (1 << 64) if v >= 1 << 63 else v
 
 
 def _packed_varints(buf: bytes) -> List[int]:
@@ -86,9 +60,16 @@ class Attribute:
     @property
     def value(self):
         # AttributeProto.AttributeType: 1 FLOAT 2 INT 3 STRING 4 TENSOR
-        # 6 FLOATS 7 INTS 8 STRINGS
-        return {1: self.f, 2: self.i, 3: self.s, 4: self.t,
-                6: self.floats, 7: self.ints,
+        # 6 FLOATS 7 INTS 8 STRINGS.  proto3 serializers OMIT zero-valued
+        # scalars on the wire (type says INT but no i field), so a typed
+        # attribute with a missing scalar means 0, not "absent".
+        if self.type == 1:
+            return self.f if self.f is not None else 0.0
+        if self.type == 2:
+            return self.i if self.i is not None else 0
+        if self.type == 3:
+            return self.s if self.s is not None else b""
+        return {4: self.t, 6: self.floats, 7: self.ints,
                 8: self.strings}.get(self.type)
 
 

@@ -1,0 +1,13 @@
+"""Optional-dependency gates (xgboost, statsmodels, ... are not baked
+into the TPU image; wrappers raise a uniform, actionable ImportError)."""
+
+from __future__ import annotations
+
+
+def require(package: str, needed_by: str):
+    try:
+        return __import__(package)
+    except ImportError as e:
+        raise ImportError(
+            f"{package} is not installed in this image; {needed_by} "
+            f"needs the {package} package") from e

@@ -1,0 +1,132 @@
+"""NNFrames pipeline API (VERDICT r1 missing #5; reference
+pyzoo/zoo/pipeline/nnframes/nn_classifier.py:139,613,685)."""
+
+import flax.linen as nn
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.feature.common import Lambda, SeqToTensor
+from analytics_zoo_tpu.orca.data import XShards
+from analytics_zoo_tpu.pipeline.nnframes import (
+    NNClassifier,
+    NNEstimator,
+    XGBClassifier,
+)
+
+
+class _MLP(nn.Module):
+    out: int = 2
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        h = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(self.out)(h)
+
+
+class _Reg(nn.Module):
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        return nn.Dense(1)(x)[:, 0]
+
+
+def _clf_df(n=240, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.int64)
+    return pd.DataFrame({"features": list(x), "label": y})
+
+
+def test_nnclassifier_fit_transform_dataframe():
+    init_orca_context(cluster_mode="local")
+    df = _clf_df()
+    clf = (NNClassifier(_MLP(out=2))
+           .setBatchSize(32).setMaxEpoch(8).setLearningRate(5e-3))
+    model = clf.fit(df)
+    out = model.transform(df)
+    assert "prediction" in out.columns
+    acc = (out["prediction"].to_numpy() == df["label"].to_numpy()).mean()
+    assert acc > 0.9, acc
+
+
+def test_nnestimator_regression_custom_cols_and_preprocessing():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(200, 3)).astype(np.float32)
+    y = x.sum(axis=1).astype(np.float32)
+    df = pd.DataFrame({"feats": list(x), "target": y})
+    est = (NNEstimator(_Reg(), loss="mse",
+                       feature_preprocessing=SeqToTensor())
+           .setFeaturesCol("feats").setLabelCol("target")
+           .setPredictionCol("pred")
+           .setBatchSize(32).setMaxEpoch(10).setLearningRate(5e-2))
+    model = est.fit(df)
+    out = model.transform(df)
+    mse = float(np.mean((out["pred"].to_numpy() - y) ** 2))
+    assert mse < 0.1, mse
+
+
+def test_nnframes_over_xshards():
+    init_orca_context(cluster_mode="local")
+    df = _clf_df(200)
+    shards = XShards([df.iloc[:100], df.iloc[100:]])
+    clf = (NNClassifier(_MLP(out=2))
+           .setBatchSize(32).setMaxEpoch(6).setLearningRate(5e-3))
+    model = clf.fit(shards)
+    out = model.transform(shards)
+    merged = pd.concat(out.collect(), ignore_index=True)
+    acc = (merged["prediction"].to_numpy()
+           == df["label"].to_numpy()).mean()
+    assert acc > 0.85, acc
+
+
+def test_feature_preprocessing_chain_applied():
+    """Feature preprocessing scales inputs; without it the raw range
+    differs — verify the chain actually runs per row."""
+    init_orca_context(cluster_mode="local")
+    seen = []
+    pre = SeqToTensor() >> Lambda(lambda a: seen.append(1) or a * 0.1)
+    df = _clf_df(40)
+    est = NNEstimator(_MLP(out=2), "sparse_categorical_crossentropy",
+                      feature_preprocessing=pre).setMaxEpoch(1)
+    est.fit(df)
+    assert len(seen) >= 40
+
+
+def test_validation_and_checkpoint(tmp_path):
+    init_orca_context(cluster_mode="local")
+    df = _clf_df(120)
+    clf = (NNClassifier(_MLP(out=2)).setBatchSize(32).setMaxEpoch(3)
+           .setCheckpoint(str(tmp_path)).setValidation(df))
+    model = clf.fit(df)
+    import os
+    assert any(n.startswith("ckpt-") for n in os.listdir(tmp_path))
+
+
+def test_asymmetric_gradient_clipping():
+    import jax.numpy as jnp
+    import optax
+
+    from analytics_zoo_tpu.orca.learn.optimizers import resolve
+
+    tx = resolve("sgd", 1.0, clip_value=(-1.0, 5.0))
+    grads = {"w": jnp.asarray([-3.0, 4.0, 7.0])}
+    params = {"w": jnp.zeros(3)}
+    updates, _ = tx.update(grads, tx.init(params), params)
+    # sgd(lr=1) update = -clipped_grad: [-1, 4, 5] -> [1, -4, -5]
+    np.testing.assert_allclose(np.asarray(updates["w"]), [1.0, -4.0, -5.0])
+
+
+def test_xgboost_gated():
+    clf = XGBClassifier().setNumRound(5)
+    with pytest.raises(ImportError, match="xgboost"):
+        clf.fit(_clf_df(10))
+
+
+def test_auto_xgboost_gated():
+    from analytics_zoo_tpu.orca.automl import hp
+    with pytest.raises(ImportError, match="xgboost"):
+        from analytics_zoo_tpu.orca.automl.xgboost import (
+            AutoXGBClassifier)
+        AutoXGBClassifier()

@@ -1,0 +1,64 @@
+"""TensorBoard event files + per-step profiling (VERDICT r1 partial #33,
+#64; reference: JVM tensorboard writers + torch_runner profile=True)."""
+
+import os
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.utils.summary import SummaryWriter, load_scalars
+
+
+def test_event_file_roundtrip(tmp_path):
+    w = SummaryWriter(str(tmp_path))
+    w.add_scalar("loss", 1.5, step=1)
+    w.add_scalars({"loss": 1.2, "acc": 0.7}, step=2)
+    w.close()
+    scalars = load_scalars(str(tmp_path))
+    assert [s for s, _, _ in scalars["loss"]] == [1, 2]
+    np.testing.assert_allclose([v for _, _, v in scalars["loss"]],
+                               [1.5, 1.2], rtol=1e-6)
+    assert np.isclose(scalars["acc"][0][2], 0.7)
+
+
+def test_event_file_readable_by_real_tfrecord_reader(tmp_path):
+    """The framing must be byte-correct TFRecord (CRC-validated)."""
+    from analytics_zoo_tpu.utils.tfrecord import read_tfrecord_file
+    w = SummaryWriter(str(tmp_path))
+    w.add_scalar("x", 3.0, step=5)
+    w.close()
+    recs = list(read_tfrecord_file(w.path, verify=True))
+    assert len(recs) == 2  # file_version event + the scalar event
+
+
+def test_estimator_tensorboard_and_profile(tmp_path):
+    import flax.linen as nn
+
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    class R(nn.Module):
+        @nn.compact
+        def __call__(self, x, training: bool = False):
+            return nn.Dense(1)(x[:, None])[:, 0]
+
+    init_orca_context(cluster_mode="local")
+    x = np.linspace(-1, 1, 96).astype(np.float32)
+    y = 2 * x
+    est = Estimator.from_flax(R(), loss="mse", optimizer="sgd",
+                              learning_rate=0.1)
+    est.set_tensorboard(str(tmp_path), "run1")
+    est.fit({"x": x, "y": y}, epochs=3, batch_size=32,
+            validation_data={"x": x, "y": y}, profile=True)
+
+    train_scalars = load_scalars(
+        os.path.join(tmp_path, "run1", "train"))
+    val_scalars = load_scalars(
+        os.path.join(tmp_path, "run1", "validation"))
+    assert len(train_scalars["loss"]) == 3
+    assert len(val_scalars["loss"]) == 3
+    # losses decrease across epochs in the event file
+    losses = [v for _, _, v in train_scalars["loss"]]
+    assert losses[-1] < losses[0]
+    # per-step profile captured: 3 epochs x 3 steps
+    assert len(est.profile_stats) == 9
+    assert all(p["step_time_s"] > 0 for p in est.profile_stats)
