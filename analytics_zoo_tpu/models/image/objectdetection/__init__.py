@@ -1,0 +1,13 @@
+from analytics_zoo_tpu.models.image.objectdetection.ssd import (
+    SSDDetector,
+)
+from analytics_zoo_tpu.models.image.objectdetection.box_utils import (
+    decode_boxes,
+    encode_boxes,
+    generate_anchors,
+    iou_matrix,
+    nms,
+)
+
+__all__ = ["SSDDetector", "generate_anchors", "iou_matrix",
+           "encode_boxes", "decode_boxes", "nms"]

@@ -1,0 +1,104 @@
+"""Anchor/box utilities for detection (reference: the scala SSD
+pipeline's priorbox + postprocessing under
+`zoo/src/main/scala/.../models/image/objectdetection/` and BigDL's
+MultiBox components).
+
+All training-path math (IoU, matching, encode) is jnp with static
+shapes so the whole multibox loss jits into the train step; NMS runs
+host-side numpy at predict time (data-dependent suppression order has
+no good XLA shape story, and predict postprocessing is not the hot
+loop)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def generate_anchors(image_size: int,
+                     feature_sizes: Sequence[int],
+                     scales: Sequence[float],
+                     ratios: Sequence[float] = (1.0, 2.0, 0.5)
+                     ) -> np.ndarray:
+    """Multi-scale anchor grid in normalized xyxy, [N, 4].  One scale per
+    feature map; `ratios` anchors per cell (the SSD priorbox layout)."""
+    assert len(feature_sizes) == len(scales)
+    out = []
+    for fs, scale in zip(feature_sizes, scales):
+        cy, cx = np.meshgrid(
+            (np.arange(fs) + 0.5) / fs, (np.arange(fs) + 0.5) / fs,
+            indexing="ij")
+        # CELL-major with ratios innermost — must match the conv head's
+        # reshape(b, H*W*k, ·) layout, or every prediction slot pairs
+        # with a spatially wrong anchor
+        per_ratio = []
+        for r in ratios:
+            w = scale * np.sqrt(r)
+            h = scale / np.sqrt(r)
+            per_ratio.append(np.stack([cx - w / 2, cy - h / 2,
+                                       cx + w / 2, cy + h / 2],
+                                      axis=-1).reshape(-1, 4))
+        cells = np.stack(per_ratio, axis=1)        # [fs*fs, k, 4]
+        out.append(cells.reshape(-1, 4))
+    return np.clip(np.concatenate(out, axis=0), 0.0, 1.0) \
+        .astype(np.float32)
+
+
+def iou_matrix(a, b):
+    """IoU between [N, 4] and [M, 4] xyxy boxes -> [N, M] (jnp)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = ((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]))[:, None]
+    area_b = ((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))[None, :]
+    return inter / jnp.clip(area_a + area_b - inter, 1e-8)
+
+
+_VAR = (0.1, 0.2)  # SSD center/size variances
+
+
+def encode_boxes(gt_xyxy, anchors_xyxy):
+    """GT boxes -> regression targets relative to anchors ([..., 4])."""
+    a_wh = anchors_xyxy[..., 2:] - anchors_xyxy[..., :2]
+    a_c = anchors_xyxy[..., :2] + a_wh / 2
+    g_wh = jnp.clip(gt_xyxy[..., 2:] - gt_xyxy[..., :2], 1e-6)
+    g_c = gt_xyxy[..., :2] + g_wh / 2
+    d_c = (g_c - a_c) / (a_wh * _VAR[0])
+    d_wh = jnp.log(g_wh / a_wh) / _VAR[1]
+    return jnp.concatenate([d_c, d_wh], axis=-1)
+
+
+def decode_boxes(deltas, anchors_xyxy):
+    """Regression deltas -> xyxy boxes."""
+    a_wh = anchors_xyxy[..., 2:] - anchors_xyxy[..., :2]
+    a_c = anchors_xyxy[..., :2] + a_wh / 2
+    c = deltas[..., :2] * _VAR[0] * a_wh + a_c
+    wh = jnp.exp(deltas[..., 2:] * _VAR[1]) * a_wh
+    return jnp.concatenate([c - wh / 2, c + wh / 2], axis=-1)
+
+
+def nms(boxes: np.ndarray, scores: np.ndarray,
+        iou_threshold: float = 0.45, max_det: int = 100
+        ) -> List[int]:
+    """Greedy non-maximum suppression (host numpy; predict-time only)."""
+    order = np.argsort(-scores)
+    keep: List[int] = []
+    while order.size and len(keep) < max_det:
+        i = order[0]
+        keep.append(int(i))
+        if order.size == 1:
+            break
+        rest = order[1:]
+        lt = np.maximum(boxes[i, :2], boxes[rest, :2])
+        rb = np.minimum(boxes[i, 2:], boxes[rest, 2:])
+        wh = np.clip(rb - lt, 0, None)
+        inter = wh[:, 0] * wh[:, 1]
+        area_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        area_r = ((boxes[rest, 2] - boxes[rest, 0])
+                  * (boxes[rest, 3] - boxes[rest, 1]))
+        iou = inter / np.clip(area_i + area_r - inter, 1e-8, None)
+        order = rest[iou <= iou_threshold]
+    return keep

@@ -1,0 +1,8 @@
+"""PPML: federated-learning parameter server + private set intersection
+(reference: ppml/ — gRPC FL protocol; SGX enclaves are out of scope on
+TPU hosts, the portable FL/PSI protocol is what carries over)."""
+
+from analytics_zoo_tpu.ppml.fl_server import FLServer
+from analytics_zoo_tpu.ppml.fl_client import FLClient, PSIClient
+
+__all__ = ["FLServer", "FLClient", "PSIClient"]

@@ -1,0 +1,135 @@
+"""FL / PSI clients (reference: pyzoo FL client helpers over the
+FLProto gRPC services)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.ppml import fl_proto as P
+from analytics_zoo_tpu.ppml.fl_server import salt_hash
+
+
+class _Channel:
+    def __init__(self, target: str):
+        import grpc
+        self._chan = grpc.insecure_channel(target)
+
+    def call(self, service: str, method: str, payload: bytes) -> bytes:
+        fn = self._chan.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        return fn(payload)
+
+    def close(self):
+        self._chan.close()
+
+
+class PSIClient:
+    """Salted-hash private set intersection (reference
+    PSIServiceImpl.java semantics): every client hashes its ids with the
+    server-issued salt; the server intersects the uploads; clients map
+    the intersection hashes back to their local ids."""
+
+    def __init__(self, target: str, client_id: str,
+                 task_id: str = "default"):
+        self._ch = _Channel(target)
+        self.client_id = client_id
+        self.task_id = task_id
+        self.salt: Optional[str] = None
+
+    def get_salt(self, client_num: int = 1) -> str:
+        reply = self._ch.call(
+            "PSIService", "getSalt",
+            P.enc_salt_request(self.task_id, client_num))
+        self.salt = P.dec_salt_reply(reply)
+        return self.salt
+
+    def upload_set(self, ids: List[str]):
+        if self.salt is None:
+            # client_num=0: fetch the salt WITHOUT overriding the task's
+            # configured client count (a 1 here would let the server
+            # release a single client's set as the "intersection")
+            self.get_salt(client_num=0)
+        self._hash_to_id = dict(zip(salt_hash(ids, self.salt), ids))
+        self._ch.call(
+            "PSIService", "uploadSet",
+            P.enc_upload_set_request(self.task_id, self.client_id,
+                                     list(self._hash_to_id)))
+
+    def download_intersection(self, timeout_s: float = 10.0,
+                              poll_s: float = 0.05) -> List[str]:
+        """Poll until every client uploaded; returns LOCAL ids in the
+        intersection."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            reply = self._ch.call(
+                "PSIService", "downloadIntersection",
+                P.enc_download_intersection_request(self.task_id))
+            status, hashes = P.dec_intersection_response(reply)
+            if status == P.SUCCESS:
+                return [self._hash_to_id[h] for h in hashes
+                        if h in self._hash_to_id]
+            if time.monotonic() > deadline:
+                raise TimeoutError("PSI intersection not ready")
+            time.sleep(poll_s)
+
+    def close(self):
+        self._ch.close()
+
+
+class FLClient:
+    """Federated-averaging client: upload local tensors for a version,
+    poll for the aggregated next version (reference FLProto
+    ParameterServerService usage)."""
+
+    def __init__(self, target: str, client_uuid: str,
+                 model_name: str = "model"):
+        self._ch = _Channel(target)
+        self.uuid = client_uuid
+        self.model_name = model_name
+
+    def register(self):
+        reply = self._ch.call("ParameterServerService", "Register",
+                              P.enc_register_request(self.uuid))
+        _, code = P.dec_code_response(reply)
+        if code != P.SUCCESS:
+            raise RuntimeError("FL register failed")
+        return self
+
+    def upload(self, tensors: Dict[str, np.ndarray], version: int):
+        reply = self._ch.call(
+            "ParameterServerService", "UploadTrain",
+            P.enc_upload_request(self.uuid, self.model_name, version,
+                                 tensors))
+        msg, code = P.dec_code_response(reply)
+        if code != P.SUCCESS:
+            raise RuntimeError(f"FL upload failed: {msg}")
+
+    def download(self, version: int, timeout_s: float = 10.0,
+                 poll_s: float = 0.05) -> Dict[str, np.ndarray]:
+        """Block until the aggregated table for `version` exists."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            reply = self._ch.call(
+                "ParameterServerService", "DownloadTrain",
+                P.enc_download_request(self.model_name, version))
+            table, _, code = P.dec_download_response(reply)
+            if code == P.SUCCESS and table is not None:
+                return table[2]
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"aggregated version {version} "
+                                   "not available")
+            time.sleep(poll_s)
+
+    def fed_round(self, tensors: Dict[str, np.ndarray], version: int
+                  ) -> Dict[str, np.ndarray]:
+        """One FedAvg round: upload local state, return the average."""
+        self.upload(tensors, version)
+        return self.download(version + 1)
+
+    def close(self):
+        self._ch.close()

@@ -1,0 +1,279 @@
+"""FLProto message codecs (reference:
+`zoo/src/main/proto/FLProto.proto` — PSIService + ParameterServerService
+messages).  Hand-rolled wire format over the shared protobuf helpers (no
+codegen: grpcio is in the image but grpcio-tools is not); messages are
+byte-compatible with the reference's generated stubs."""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.utils.tf_example import (
+    _len_delim,
+    _tag,
+    _varint,
+    to_signed,
+    walk_fields,
+)
+
+# SIGNAL enum (FLProto.proto)
+SUCCESS, WAIT, TIMEOUT, EMPTY_INPUT, ERROR = range(5)
+
+
+def _enc_str(fnum: int, s: str) -> bytes:
+    return _len_delim(fnum, s.encode())
+
+
+def _enc_i32(fnum: int, v: int) -> bytes:
+    return _tag(fnum, 0) + _varint(int(v) & (2**64 - 1))
+
+
+# -- FloatTensor / Table -----------------------------------------------------
+
+def enc_float_tensor(arr: np.ndarray) -> bytes:
+    # bulk tobytes, not per-element struct varargs: FedAvg ships full
+    # model tables every round
+    arr = np.ascontiguousarray(arr, "<f4")
+    out = b""
+    shape_payload = b"".join(_varint(d) for d in arr.shape)
+    out += _len_delim(1, shape_payload)            # packed shape
+    out += _len_delim(2, arr.tobytes())
+    return out
+
+
+def dec_float_tensor(buf: bytes) -> np.ndarray:
+    from analytics_zoo_tpu.utils.tf_example import _read_varint
+
+    shape: List[int] = []
+    chunks: List[bytes] = []
+    for fnum, wire, v in walk_fields(buf):
+        if fnum == 1:
+            if wire == 2:
+                pos = 0
+                while pos < len(v):
+                    d, pos = _read_varint(v, pos)
+                    shape.append(to_signed(d))
+            else:
+                shape.append(to_signed(v))
+        elif fnum == 2:
+            chunks.append(v)
+    arr = np.frombuffer(b"".join(chunks), "<f4")
+    return arr.reshape(shape) if shape else arr
+
+
+def enc_table(name: str, version: int,
+              tensors: Dict[str, np.ndarray]) -> bytes:
+    meta = _enc_str(1, name) + _enc_i32(2, version)
+    out = _len_delim(1, meta)
+    for key, arr in tensors.items():
+        entry = _len_delim(1, key.encode()) \
+            + _len_delim(2, enc_float_tensor(arr))
+        out += _len_delim(2, entry)
+    return out
+
+
+def dec_table(buf: bytes) -> Tuple[str, int, Dict[str, np.ndarray]]:
+    name, version = "", 0
+    tensors: Dict[str, np.ndarray] = {}
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            for f2, _, v2 in walk_fields(v):
+                if f2 == 1:
+                    name = v2.decode()
+                elif f2 == 2:
+                    version = to_signed(v2)
+        elif fnum == 2:
+            key, tensor = "", None
+            for f2, _, v2 in walk_fields(v):
+                if f2 == 1:
+                    key = v2.decode()
+                elif f2 == 2:
+                    tensor = dec_float_tensor(v2)
+            if tensor is not None:
+                tensors[key] = tensor
+    return name, version, tensors
+
+
+# -- PSI messages ------------------------------------------------------------
+
+def enc_salt_request(task_id: str, client_num: int,
+                     secure_code: str = "") -> bytes:
+    return (_enc_str(1, task_id) + _enc_i32(2, client_num)
+            + _enc_str(3, secure_code))
+
+
+def dec_salt_request(buf: bytes):
+    task_id, client_num, code = "", 0, ""
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            task_id = v.decode()
+        elif fnum == 2:
+            client_num = to_signed(v)
+        elif fnum == 3:
+            code = v.decode()
+    return task_id, client_num, code
+
+
+def enc_salt_reply(salt: str) -> bytes:
+    return _enc_str(1, salt)
+
+
+def dec_salt_reply(buf: bytes) -> str:
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            return v.decode()
+    return ""
+
+
+def enc_upload_set_request(task_id: str, client_id: str,
+                           hashed_ids: List[str]) -> bytes:
+    out = _enc_str(1, task_id) + _enc_str(2, client_id)
+    out += _enc_i32(5, len(hashed_ids)) + _enc_i32(6, len(hashed_ids))
+    for h in hashed_ids:
+        out += _enc_str(7, h)
+    return out
+
+
+def dec_upload_set_request(buf: bytes):
+    task_id, client_id, ids = "", "", []
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            task_id = v.decode()
+        elif fnum == 2:
+            client_id = v.decode()
+        elif fnum == 7:
+            ids.append(v.decode())
+    return task_id, client_id, ids
+
+
+def enc_status_response(task_id: str, status: int) -> bytes:
+    return _enc_str(1, task_id) + _enc_i32(2, status)
+
+
+def dec_status_response(buf: bytes):
+    task_id, status = "", SUCCESS
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            task_id = v.decode()
+        elif fnum == 2:
+            status = to_signed(v)
+    return task_id, status
+
+
+def enc_download_intersection_request(task_id: str) -> bytes:
+    return _enc_str(1, task_id)
+
+
+def dec_download_intersection_request(buf: bytes) -> str:
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            return v.decode()
+    return ""
+
+
+def enc_intersection_response(task_id: str, status: int,
+                              intersection: List[str]) -> bytes:
+    out = _enc_str(1, task_id) + _enc_i32(2, status)
+    out += _enc_i32(5, len(intersection)) + _enc_i32(6, len(intersection))
+    for h in intersection:
+        out += _enc_str(7, h)
+    return out
+
+
+def dec_intersection_response(buf: bytes):
+    status, items = SUCCESS, []
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 2:
+            status = to_signed(v)
+        elif fnum == 7:
+            items.append(v.decode())
+    return status, items
+
+
+# -- PS messages -------------------------------------------------------------
+
+def enc_register_request(clientuuid: str, token: str = "") -> bytes:
+    return _enc_str(1, clientuuid) + _enc_str(2, token)
+
+
+def dec_register_request(buf: bytes):
+    uuid, token = "", ""
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            uuid = v.decode()
+        elif fnum == 2:
+            token = v.decode()
+    return uuid, token
+
+
+def enc_code_response(response: str, code: int) -> bytes:
+    return _enc_str(1, response) + _enc_i32(2, code)
+
+
+def dec_code_response(buf: bytes):
+    response, code = "", 0
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            response = v.decode()
+        elif fnum == 2:
+            code = to_signed(v)
+    return response, code
+
+
+def enc_upload_request(clientuuid: str, name: str, version: int,
+                       tensors: Dict[str, np.ndarray]) -> bytes:
+    return _enc_str(1, clientuuid) \
+        + _len_delim(2, enc_table(name, version, tensors))
+
+
+def dec_upload_request(buf: bytes):
+    uuid, table = "", ("", 0, {})
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            uuid = v.decode()
+        elif fnum == 2:
+            table = dec_table(v)
+    return uuid, table
+
+
+def enc_download_request(name: str, version: int) -> bytes:
+    meta = _enc_str(1, name) + _enc_i32(2, version)
+    return _len_delim(1, meta)
+
+
+def dec_download_request(buf: bytes):
+    name, version = "", 0
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            for f2, _, v2 in walk_fields(v):
+                if f2 == 1:
+                    name = v2.decode()
+                elif f2 == 2:
+                    version = to_signed(v2)
+    return name, version
+
+
+def enc_download_response(name: str, version: int,
+                          tensors: Dict[str, np.ndarray],
+                          response: str, code: int) -> bytes:
+    out = b""
+    if tensors is not None:
+        out += _len_delim(1, enc_table(name, version, tensors))
+    out += _enc_str(2, response) + _enc_i32(3, code)
+    return out
+
+
+def dec_download_response(buf: bytes):
+    table, response, code = None, "", 0
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            table = dec_table(v)
+        elif fnum == 2:
+            response = v.decode()
+        elif fnum == 3:
+            code = to_signed(v)
+    return table, response, code

@@ -1,0 +1,194 @@
+"""FL parameter server + PSI service over gRPC generic handlers.
+
+Reference: `ppml/src/main/java/com/intel/analytics/zoo/ppml/psi/
+PSIServiceImpl.java` (salted-hash intersection across clients) and the
+scala ParameterServerServiceImpl behind `FLProto.proto` (FedAvg-style
+aggregation: each registered client uploads its local Table per version;
+when all have uploaded, the server averages into version+1; downloads of
+a newer version WAIT until aggregation completes).
+
+grpcio ships in the image but grpcio-tools does not, so services are
+registered via `grpc.method_handlers_generic_handler` with identity
+(bytes) serializers and the hand-rolled FLProto codecs — same wire
+messages, no codegen step."""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+import threading
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from analytics_zoo_tpu.ppml import fl_proto as P
+
+
+class _PSIState:
+    def __init__(self):
+        self.salt = secrets.token_hex(16)
+        self.client_num = 1
+        self.sets: Dict[str, Set[str]] = {}
+        self.lock = threading.Lock()
+
+    def intersection(self) -> Optional[List[str]]:
+        with self.lock:
+            if len(self.sets) < self.client_num:
+                return None
+            out = None
+            for s in self.sets.values():
+                out = set(s) if out is None else (out & s)
+            return sorted(out or [])
+
+
+class _PSStates:
+    """Per-model aggregation state."""
+
+    def __init__(self, min_clients: int):
+        self.min_clients = min_clients
+        self.registered: Set[str] = set()
+        self.global_tables: Dict[int, Dict[str, np.ndarray]] = {}
+        self.pending: Dict[int, Dict[str, Dict[str, np.ndarray]]] = {}
+        self.version = 0
+        self.lock = threading.Lock()
+
+
+class FLServer:
+    """start() binds a gRPC server; stop() shuts it down.
+
+    `client_num` gates both PSI intersection availability and FedAvg
+    aggregation (all registered clients must upload a version before it
+    aggregates)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 client_num: int = 1):
+        import grpc
+        from concurrent import futures
+
+        self.client_num = client_num
+        self._psi: Dict[str, _PSIState] = {}
+        self._ps = _PSStates(client_num)
+        self._lock = threading.Lock()
+
+        ident = lambda b: b  # bytes in/bytes out; codecs do the rest
+
+        def unary(fn):
+            import grpc as _g
+            return _g.unary_unary_rpc_method_handler(
+                fn, request_deserializer=ident, response_serializer=ident)
+
+        psi_handlers = {
+            "getSalt": unary(self._get_salt),
+            "uploadSet": unary(self._upload_set),
+            "downloadIntersection": unary(self._download_intersection),
+        }
+        ps_handlers = {
+            "Register": unary(self._register),
+            "UploadTrain": unary(self._upload_train),
+            "DownloadTrain": unary(self._download_train),
+            "UploadEvaluate": unary(self._upload_evaluate),
+        }
+        self._server = grpc.server(futures.ThreadPoolExecutor(8))
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler("PSIService",
+                                                 psi_handlers),
+            grpc.method_handlers_generic_handler("ParameterServerService",
+                                                 ps_handlers),
+        ))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+
+    # -- PSI ------------------------------------------------------------
+
+    def _task(self, task_id: str) -> _PSIState:
+        with self._lock:
+            if task_id not in self._psi:
+                self._psi[task_id] = _PSIState()
+            return self._psi[task_id]
+
+    def _get_salt(self, request: bytes, context) -> bytes:
+        task_id, client_num, _ = P.dec_salt_request(request)
+        st = self._task(task_id or "default")
+        if client_num:
+            st.client_num = client_num
+        return P.enc_salt_reply(st.salt)
+
+    def _upload_set(self, request: bytes, context) -> bytes:
+        task_id, client_id, ids = P.dec_upload_set_request(request)
+        st = self._task(task_id or "default")
+        with st.lock:
+            st.sets[client_id] = set(ids)
+        return P.enc_status_response(task_id, P.SUCCESS)
+
+    def _download_intersection(self, request: bytes, context) -> bytes:
+        task_id = P.dec_download_intersection_request(request)
+        st = self._task(task_id or "default")
+        inter = st.intersection()
+        if inter is None:
+            return P.enc_intersection_response(task_id, P.WAIT, [])
+        return P.enc_intersection_response(task_id, P.SUCCESS, inter)
+
+    # -- parameter server ----------------------------------------------
+
+    def _register(self, request: bytes, context) -> bytes:
+        uuid, _token = P.dec_register_request(request)
+        with self._ps.lock:
+            self._ps.registered.add(uuid)
+        return P.enc_code_response("registered", P.SUCCESS)
+
+    def _upload_train(self, request: bytes, context) -> bytes:
+        uuid, (name, version, tensors) = P.dec_upload_request(request)
+        ps = self._ps
+        with ps.lock:
+            if uuid not in ps.registered:
+                return P.enc_code_response("not registered", P.ERROR)
+            ps.pending.setdefault(version, {})[uuid] = tensors
+            # gate on the CONFIGURED client count, not the registered set:
+            # a client that registers+uploads before its peers register
+            # must not trigger a partial aggregation (reference clientNum
+            # semantics)
+            if len(ps.pending[version]) >= ps.min_clients:
+                # FedAvg: average every tensor across clients
+                uploads = list(ps.pending.pop(version).values())
+                agg = {
+                    k: np.mean([u[k] for u in uploads], axis=0)
+                    for k in uploads[0]
+                }
+                ps.global_tables[version + 1] = agg
+                ps.version = version + 1
+                # clients only ever fetch the newest version; keep a
+                # small window so long trainings don't grow unbounded
+                for old in [v for v in ps.global_tables
+                            if v < ps.version - 1]:
+                    del ps.global_tables[old]
+        return P.enc_code_response("uploaded", P.SUCCESS)
+
+    def _download_train(self, request: bytes, context) -> bytes:
+        name, version = P.dec_download_request(request)
+        ps = self._ps
+        with ps.lock:
+            if version in ps.global_tables:
+                return P.enc_download_response(
+                    name, version, ps.global_tables[version],
+                    "ok", P.SUCCESS)
+        return P.enc_download_response(name, version, None, "wait",
+                                       P.WAIT)
+
+    def _upload_evaluate(self, request: bytes, context) -> bytes:
+        # evaluation metrics are aggregated the same way; echo success
+        return P.enc_code_response("ok", P.SUCCESS)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "FLServer":
+        self._server.start()
+        return self
+
+    def stop(self, grace: float = 0.5):
+        self._server.stop(grace)
+
+
+def salt_hash(ids: List[str], salt: str) -> List[str]:
+    """The PSI client-side hashing (SHA256(salt || id), reference
+    PSIServiceImpl/Utils.java hashing scheme)."""
+    return [hashlib.sha256((salt + x).encode()).hexdigest() for x in ids]
