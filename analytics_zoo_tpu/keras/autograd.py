@@ -1,0 +1,94 @@
+"""autograd sugar + CustomLoss (reference:
+`pyzoo/zoo/pipeline/api/autograd.py:256,369,393,510` — `Variable`
+symbolic math, `CustomLoss` built from variable expressions, `Lambda`).
+
+TPU-native design: there is no Py4J graph to assemble — jax IS the
+autograd engine — so a "variable expression" is simply a traced python
+function over jnp arrays.  `CustomLoss(fn)` wraps `fn(y_true, y_pred)`
+(reference argument order) into the engine's per-example loss contract;
+the function-style helpers below (mean/abs/clip/...) mirror the
+reference's autograd vocabulary so loss expressions port one to one.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+# the reference autograd function vocabulary (autograd.py:28-250),
+# jnp-backed one-liners here
+abs = jnp.abs                # noqa: A001 - reference naming
+clip = jnp.clip
+exp = jnp.exp
+log = jnp.log
+maximum = jnp.maximum
+minimum = jnp.minimum
+pow = jnp.power              # noqa: A001
+sqrt = jnp.sqrt
+square = jnp.square
+
+
+def mean(x, axis=0):
+    return jnp.mean(x, axis=axis)
+
+
+def sum(x, axis=0):          # noqa: A001
+    return jnp.sum(x, axis=axis)
+
+
+def epsilon() -> float:
+    return 1e-7
+
+
+def mm(a, b):
+    return jnp.matmul(a, b)
+
+
+def dot(a, b, axes=None):
+    if axes is None:
+        return jnp.tensordot(a, b, axes=1)
+    return jnp.tensordot(a, b, axes=axes)
+
+
+def softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+def softplus(x):
+    return jnp.logaddexp(x, 0.0)
+
+
+def stack(xs, axis=1):
+    return jnp.stack(xs, axis=axis)
+
+
+def expand_dims(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def l2_normalize(x, axis=-1):
+    return x / jnp.sqrt(jnp.clip(jnp.sum(x * x, axis=axis,
+                                         keepdims=True), epsilon()))
+
+
+class CustomLoss:
+    """Wrap `fn(y_true, y_pred) -> per-example loss [batch, ...]` as an
+    engine loss (reference CustomLoss from a variable expression,
+    autograd.py:510).  Trailing dims beyond the batch are averaged by the
+    engine's masked mean; returning a scalar is rejected because padded
+    rows could then not be masked out."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def __call__(self, preds, labels):
+        y_pred = preds[0] if isinstance(preds, (tuple, list)) else preds
+        y_true = labels[0] if isinstance(labels, (tuple, list)) else labels
+        out = self.fn(y_true, y_pred)
+        if jnp.ndim(out) == 0:
+            raise ValueError(
+                "CustomLoss expression must return a PER-EXAMPLE loss "
+                "(leading batch dim); got a scalar — drop the outer "
+                "mean(), the engine applies the masked batch mean")
+        return out

@@ -63,3 +63,60 @@ from analytics_zoo_tpu.keras.layers.self_attention import (  # noqa: F401
     BERT,
     TransformerLayer,
 )
+from analytics_zoo_tpu.keras.layers.advanced_activations import (  # noqa: F401,E501
+    ELU,
+    LeakyReLU,
+    PReLU,
+    SReLU,
+    ThresholdedReLU,
+)
+from analytics_zoo_tpu.keras.layers.elementwise import (  # noqa: F401
+    AddConstant,
+    CAdd,
+    CMul,
+    Exp,
+    ExpandDim,
+    GaussianSampler,
+    HardShrink,
+    HardTanh,
+    Identity,
+    Log,
+    Masking,
+    MaxoutDense,
+    MulConstant,
+    Narrow,
+    Negative,
+    Power,
+    ResizeBilinear,
+    Scale,
+    Select,
+    SoftShrink,
+    Sqrt,
+    Square,
+    Squeeze,
+    Threshold,
+)
+from analytics_zoo_tpu.keras.layers.local import (  # noqa: F401
+    LocallyConnected1D,
+    LocallyConnected2D,
+)
+from analytics_zoo_tpu.keras.layers.convolutional_recurrent import (  # noqa: F401,E501
+    ConvLSTM2D,
+)
+from analytics_zoo_tpu.keras.layers.noise import (  # noqa: F401
+    GaussianDropout,
+    SpatialDropout1D,
+    SpatialDropout2D,
+    SpatialDropout3D,
+)
+from analytics_zoo_tpu.keras.layers.conv import (  # noqa: F401
+    Cropping1D,
+    Cropping3D,
+    UpSampling3D,
+    ZeroPadding3D,
+)
+from analytics_zoo_tpu.keras.layers.pooling import (  # noqa: F401
+    GlobalAveragePooling3D,
+    GlobalMaxPooling3D,
+)
+from analytics_zoo_tpu.keras.layers.embeddings import WordEmbedding  # noqa: F401,E501

@@ -1,0 +1,85 @@
+"""Advanced activation layers (reference:
+`pyzoo/zoo/pipeline/api/keras/layers/advanced_activations.py` —
+LeakyReLU, ELU, PReLU, ThresholdedReLU, SReLU)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.engine import Layer
+
+
+class LeakyReLU(Layer):
+    def __init__(self, alpha: float = 0.3, name: Optional[str] = None):
+        super().__init__(name)
+        self.alpha = alpha
+
+    def call(self, x, training=False):
+        return jax.nn.leaky_relu(x, self.alpha)
+
+
+class ELU(Layer):
+    def __init__(self, alpha: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.alpha = alpha
+
+    def call(self, x, training=False):
+        return jax.nn.elu(x, self.alpha)
+
+
+class ThresholdedReLU(Layer):
+    """x if x > theta else 0."""
+
+    def __init__(self, theta: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.theta = theta
+
+    def call(self, x, training=False):
+        return jnp.where(x > self.theta, x, 0.0)
+
+
+class _PReLUModule(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        alpha = self.param("alpha", nn.initializers.constant(0.25),
+                           (x.shape[-1],))
+        return jnp.where(x >= 0, x, alpha * x)
+
+
+class PReLU(Layer):
+    """Per-channel learned negative slope."""
+
+    def build_flax(self):
+        return _PReLUModule(name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x)
+
+
+class _SReLUModule(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        tl = self.param("t_left", nn.initializers.zeros, (c,))
+        al = self.param("a_left", nn.initializers.constant(0.2), (c,))
+        tr = self.param("t_right", nn.initializers.ones, (c,))
+        ar = self.param("a_right", nn.initializers.ones, (c,))
+        below = tl + al * (x - tl)
+        above = tr + ar * (x - tr)
+        mid = x
+        return jnp.where(x < tl, below, jnp.where(x > tr, above, mid))
+
+
+class SReLU(Layer):
+    """S-shaped rectifier with four learned per-channel parameters
+    (reference SReLU)."""
+
+    def build_flax(self):
+        return _SReLUModule(name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x)

@@ -192,3 +192,46 @@ class Cropping2D(Layer):
     def call(self, x, training=False):
         (t, b), (l, r) = self.cropping
         return x[:, t:x.shape[1] - b or None, l:x.shape[2] - r or None, :]
+
+
+class Cropping1D(Layer):
+    def __init__(self, cropping=(1, 1), name: Optional[str] = None):
+        super().__init__(name)
+        self.cropping = _tup(cropping, 2)
+
+    def call(self, x, training=False):
+        a, b = self.cropping
+        return x[:, a:x.shape[1] - b or None, :]
+
+
+class Cropping3D(Layer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)),
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.cropping = cropping
+
+    def call(self, x, training=False):
+        (f, bk), (t, b), (l, r) = self.cropping
+        return x[:, f:x.shape[1] - bk or None, t:x.shape[2] - b or None,
+                 l:x.shape[3] - r or None, :]
+
+
+class ZeroPadding3D(Layer):
+    def __init__(self, padding=(1, 1, 1), name: Optional[str] = None):
+        super().__init__(name)
+        p = _tup(padding, 3)
+        self.padding = tuple((v, v) for v in p)
+
+    def call(self, x, training=False):
+        return jnp.pad(x, ((0, 0),) + self.padding + ((0, 0),))
+
+
+class UpSampling3D(Layer):
+    def __init__(self, size=(2, 2, 2), name: Optional[str] = None):
+        super().__init__(name)
+        self.size = _tup(size, 3)
+
+    def call(self, x, training=False):
+        for axis, k in zip((1, 2, 3), self.size):
+            x = jnp.repeat(x, k, axis=axis)
+        return x

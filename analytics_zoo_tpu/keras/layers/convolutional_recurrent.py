@@ -1,0 +1,45 @@
+"""ConvLSTM2D (reference:
+`pyzoo/zoo/pipeline/api/keras/layers/convolutional_recurrent.py` /
+scala ConvLSTM2D, ConvLSTM3D).
+
+TPU note: flax's ConvLSTMCell under nn.RNN lowers to one lax.scan of
+fused convs — XLA pipelines the timestep convs instead of the
+reference's per-step BigDL kernel launches.  Layout is NHWC throughout
+(channels-last feeds the MXU; the reference is NCHW)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+
+from analytics_zoo_tpu.keras.engine import Layer
+from analytics_zoo_tpu.keras.layers.local import _pair
+
+
+class ConvLSTM2D(Layer):
+    """Input [b, t, h, w, c] -> [b, t, h, w, filters] (or final state
+    [b, h, w, filters] with return_sequences=False)."""
+
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 return_sequences: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel_size = _pair(kernel_size)
+        if _pair(strides) != (1, 1):
+            raise ValueError(
+                "ConvLSTM2D supports stride 1 only (matching flax "
+                "ConvLSTMCell; the reference's strided variant subsamples "
+                "inputs before the recurrence)")
+        self.return_sequences = return_sequences
+
+    def build_flax(self):
+        return nn.RNN(
+            nn.ConvLSTMCell(self.filters, self.kernel_size,
+                            name=f"{self.name}_cell"),
+            name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        out = m(x)
+        return out if self.return_sequences else out[:, -1]

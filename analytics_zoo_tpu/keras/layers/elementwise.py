@@ -1,0 +1,289 @@
+"""Elementwise / tensor-utility layers (reference: the zoo Keras "torch
+utility" vocabulary — Exp, Log, Sqrt, Square, Power, Negative,
+AddConstant, MulConstant, Scale, CAdd, CMul, Masking, Squeeze,
+ExpandDim, Narrow, Select, HardTanh, HardShrink, SoftShrink, Threshold,
+MaxoutDense, ResizeBilinear, GaussianSampler — scala
+`pipeline/api/keras/layers/` torch.py/core equivalents)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.engine import Layer
+
+
+class _Unary(Layer):
+    _fn = staticmethod(lambda x: x)
+
+    def call(self, x, training=False):
+        return type(self)._fn(x)
+
+
+class Exp(_Unary):
+    _fn = staticmethod(jnp.exp)
+
+
+class Log(_Unary):
+    _fn = staticmethod(jnp.log)
+
+
+class Sqrt(_Unary):
+    _fn = staticmethod(jnp.sqrt)
+
+
+class Square(_Unary):
+    _fn = staticmethod(jnp.square)
+
+
+class Negative(_Unary):
+    _fn = staticmethod(jnp.negative)
+
+
+class Identity(_Unary):
+    pass
+
+
+class Power(Layer):
+    def __init__(self, power: float, scale: float = 1.0,
+                 shift: float = 0.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def call(self, x, training=False):
+        return jnp.power(self.scale * x + self.shift, self.power)
+
+
+class AddConstant(Layer):
+    def __init__(self, constant: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.constant = constant
+
+    def call(self, x, training=False):
+        return x + self.constant
+
+
+class MulConstant(Layer):
+    def __init__(self, constant: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.constant = constant
+
+    def call(self, x, training=False):
+        return x * self.constant
+
+
+class _ScaleModule(nn.Module):
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        out = x * w
+        if self.use_bias:
+            out = out + self.param("bias", nn.initializers.zeros,
+                                   (x.shape[-1],))
+        return out
+
+
+class Scale(Layer):
+    """Learned per-channel scale + bias (reference Scale)."""
+
+    def build_flax(self):
+        return _ScaleModule(name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x)
+
+
+class CMul(Layer):
+    """Learned per-channel multiplier (reference CMul)."""
+
+    def build_flax(self):
+        return _ScaleModule(use_bias=False, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x)
+
+
+class _CAddModule(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return x + self.param("bias", nn.initializers.zeros,
+                              (x.shape[-1],))
+
+
+class CAdd(Layer):
+    """Learned per-channel bias (reference CAdd)."""
+
+    def build_flax(self):
+        return _CAddModule(name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x)
+
+
+class Masking(Layer):
+    """Zero out timesteps that equal mask_value in every feature
+    (reference Masking; downstream layers see zeros — the engine has no
+    implicit mask propagation, matching the reference's BigDL
+    behavior)."""
+
+    def __init__(self, mask_value: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.mask_value = mask_value
+
+    def call(self, x, training=False):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return x * keep
+
+
+class Squeeze(Layer):
+    def __init__(self, dim: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def call(self, x, training=False):
+        return jnp.squeeze(x, self.dim)
+
+
+class ExpandDim(Layer):
+    def __init__(self, dim: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def call(self, x, training=False):
+        return jnp.expand_dims(x, self.dim)
+
+
+class Narrow(Layer):
+    """Slice `length` elements from `offset` along `dim` (reference
+    Narrow; dims count the batch axis like the reference)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def call(self, x, training=False):
+        idx = [slice(None)] * x.ndim
+        idx[self.dim] = slice(self.offset, self.offset + self.length)
+        return x[tuple(idx)]
+
+
+class Select(Layer):
+    """Pick index `index` along `dim`, dropping the axis (reference
+    Select)."""
+
+    def __init__(self, dim: int, index: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dim, self.index = dim, index
+
+    def call(self, x, training=False):
+        return jnp.take(x, self.index, axis=self.dim)
+
+
+class HardTanh(Layer):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def call(self, x, training=False):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class HardShrink(Layer):
+    def __init__(self, value: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.value = value
+
+    def call(self, x, training=False):
+        return jnp.where(jnp.abs(x) > self.value, x, 0.0)
+
+
+class SoftShrink(Layer):
+    def __init__(self, value: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.value = value
+
+    def call(self, x, training=False):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.value, 0.0)
+
+
+class Threshold(Layer):
+    """x if x > th else value (reference Threshold)."""
+
+    def __init__(self, th: float = 1e-6, value: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.th, self.value = th, value
+
+    def call(self, x, training=False):
+        return jnp.where(x > self.th, x, self.value)
+
+
+class _MaxoutModule(nn.Module):
+    output_dim: int
+    nb_feature: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.output_dim * self.nb_feature)(x)
+        h = h.reshape(x.shape[:-1] + (self.nb_feature, self.output_dim))
+        return h.max(axis=-2)
+
+
+class MaxoutDense(Layer):
+    """Max over `nb_feature` linear pieces (reference MaxoutDense)."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.output_dim, self.nb_feature = output_dim, nb_feature
+
+    def build_flax(self):
+        return _MaxoutModule(self.output_dim, self.nb_feature,
+                             name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x)
+
+
+class ResizeBilinear(Layer):
+    """Bilinear resize of NHWC images (reference ResizeBilinear; lowers
+    to jax.image.resize — XLA fuses the gather/lerp)."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.oh, self.ow = output_height, output_width
+
+    def call(self, x, training=False):
+        return jax.image.resize(
+            x, (x.shape[0], self.oh, self.ow, x.shape[3]), "bilinear")
+
+
+class _GaussianSamplerModule(nn.Module):
+    @nn.compact
+    def __call__(self, mean, log_var, training: bool = False):
+        if not training:  # deterministic at inference like the reference
+            return mean
+        eps = jax.random.normal(self.make_rng("dropout"), mean.shape,
+                                mean.dtype)
+        return mean + jnp.exp(0.5 * log_var) * eps
+
+
+class GaussianSampler(Layer):
+    """VAE reparameterization: sample N(mean, exp(log_var)) (reference
+    GaussianSampler; takes [mean, log_var])."""
+
+    def build_flax(self):
+        return _GaussianSamplerModule(name=self.name)
+
+    def apply_flax(self, m, mean, log_var, training=False):
+        return m(mean, log_var, training=training)

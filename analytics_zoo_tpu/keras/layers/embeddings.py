@@ -3,7 +3,7 @@
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -23,3 +23,52 @@ class Embedding(Layer):
 
     def apply_flax(self, m, x, training=False):
         return m(x.astype(jnp.int32))
+
+
+class _WordEmbeddingModule(nn.Module):
+    weights: Any
+    trainable: bool
+
+    @nn.compact
+    def __call__(self, ids):
+        import jax.numpy as _jnp
+        if self.trainable:
+            table = self.param("embedding",
+                               lambda _k: _jnp.asarray(self.weights))
+        else:
+            table = _jnp.asarray(self.weights)
+        return jnp.take(table, ids.astype(jnp.int32), axis=0)
+
+
+class WordEmbedding(Layer):
+    """Embedding initialized from pretrained vectors (reference
+    WordEmbedding: GloVe tables loaded frozen by default)."""
+
+    def __init__(self, weights, trainable: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        import numpy as _np
+        self.weights = _np.asarray(weights, _np.float32)
+        self.trainable = trainable
+
+    @staticmethod
+    def from_word_index(word_index: dict, vectors: dict, dim: int,
+                        trainable: bool = False,
+                        name: Optional[str] = None) -> "WordEmbedding":
+        """Build the table from {word: idx} + {word: vector} (ids start
+        at 1; row 0 is the pad vector)."""
+        import numpy as _np
+        n = max(word_index.values()) + 1
+        table = _np.zeros((n, dim), _np.float32)
+        for w, i in word_index.items():
+            v = vectors.get(w)
+            if v is not None:
+                table[i] = _np.asarray(v, _np.float32)
+        return WordEmbedding(table, trainable, name)
+
+    def build_flax(self):
+        return _WordEmbeddingModule(self.weights, self.trainable,
+                                    name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x)

@@ -1,0 +1,100 @@
+"""Locally-connected layers — convolution with UNSHARED weights per
+output position (reference:
+`pyzoo/zoo/pipeline/api/keras/layers/local.py`).
+
+TPU note: patches are extracted with `conv_general_dilated_patches`
+(one XLA op) and contracted against the per-position kernel bank with a
+single einsum — a big batched matmul on the MXU, where the reference
+runs a per-position loop in BigDL's SpatialConvolutionMap kernels."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.engine import Layer
+from analytics_zoo_tpu.keras.layers.conv import _tup
+from analytics_zoo_tpu.keras.layers.core import get_activation
+
+
+def _pair(v):
+    return _tup(v, 2) if not isinstance(v, int) else (v, v)
+
+
+class _LocallyConnected2DModule(nn.Module):
+    filters: int
+    kernel_size: Tuple[int, int]
+    strides: Tuple[int, int]
+
+    @nn.compact
+    def __call__(self, x):
+        # x: NHWC
+        kh, kw = self.kernel_size
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), self.strides, "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        b, oh, ow, pk = patches.shape           # pk = kh*kw*C
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (oh * ow, pk, self.filters))
+        bias = self.param("bias", nn.initializers.zeros,
+                          (oh * ow, self.filters))
+        flat = patches.reshape(b, oh * ow, pk)
+        out = jnp.einsum("bpk,pkf->bpf", flat, w) + bias
+        return out.reshape(b, oh, ow, self.filters)
+
+
+class LocallyConnected2D(Layer):
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 activation=None, name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.activation = get_activation(activation)
+
+    def build_flax(self):
+        return _LocallyConnected2DModule(
+            self.filters, self.kernel_size, self.strides, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return self.activation(m(x))
+
+
+class _LocallyConnected1DModule(nn.Module):
+    filters: int
+    kernel_size: int
+    strides: int
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [b, t, c] -> patches via the 2D helper on a height-1 image
+        patches = jax.lax.conv_general_dilated_patches(
+            x[:, :, None, :], (self.kernel_size, 1), (self.strides, 1),
+            "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        b, ot, _, pk = patches.shape
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (ot, pk, self.filters))
+        bias = self.param("bias", nn.initializers.zeros,
+                          (ot, self.filters))
+        flat = patches.reshape(b, ot, pk)
+        return jnp.einsum("bpk,pkf->bpf", flat, w) + bias
+
+
+class LocallyConnected1D(Layer):
+    def __init__(self, filters: int, kernel_size: int, strides: int = 1,
+                 activation=None, name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel_size = kernel_size
+        self.strides = strides
+        self.activation = get_activation(activation)
+
+    def build_flax(self):
+        return _LocallyConnected1DModule(
+            self.filters, self.kernel_size, self.strides, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return self.activation(m(x))

@@ -1,0 +1,99 @@
+"""Noise / structured-dropout layers (reference:
+`pyzoo/zoo/pipeline/api/keras/layers/noise.py` — GaussianDropout,
+SpatialDropout1D/2D/3D; GaussianNoise lives in core.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.engine import Layer
+
+
+class _GaussianDropoutModule(nn.Module):
+    p: float
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        if not training or self.p <= 0:
+            return x
+        stddev = (self.p / (1.0 - self.p)) ** 0.5
+        noise = jax.random.normal(self.make_rng("dropout"), x.shape,
+                                  x.dtype)
+        return x * (1.0 + stddev * noise)
+
+
+class GaussianDropout(Layer):
+    """Multiplicative 1-centered gaussian noise (reference
+    GaussianDropout)."""
+
+    def __init__(self, p: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.p = p
+
+    def build_flax(self):
+        return _GaussianDropoutModule(self.p, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x, training=training)
+
+
+class _SpatialDropoutModule(nn.Module):
+    p: float
+    broadcast_axes: tuple  # axes whose mask is shared
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        if not training or self.p <= 0:
+            return x
+        shape = list(x.shape)
+        for a in self.broadcast_axes:
+            shape[a] = 1
+        keep = jax.random.bernoulli(self.make_rng("dropout"),
+                                    1.0 - self.p, tuple(shape))
+        return x * keep / (1.0 - self.p)
+
+
+class SpatialDropout1D(Layer):
+    """Drops whole channels of [b, t, c] (mask shared over time)."""
+
+    def __init__(self, p: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.p = p
+
+    def build_flax(self):
+        return _SpatialDropoutModule(self.p, (1,), name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x, training=training)
+
+
+class SpatialDropout2D(Layer):
+    """Drops whole channels of NHWC images (mask shared over H, W)."""
+
+    def __init__(self, p: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.p = p
+
+    def build_flax(self):
+        return _SpatialDropoutModule(self.p, (1, 2), name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x, training=training)
+
+
+class SpatialDropout3D(Layer):
+    """Drops whole channels of NDHWC volumes."""
+
+    def __init__(self, p: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.p = p
+
+    def build_flax(self):
+        return _SpatialDropoutModule(self.p, (1, 2, 3), name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x, training=training)

@@ -80,3 +80,11 @@ class GlobalMaxPooling2D(_GlobalPool):
 
 class GlobalAveragePooling2D(_GlobalPool):
     axes, mode = (1, 2), "avg"
+
+
+class GlobalMaxPooling3D(_GlobalPool):
+    axes, mode = (1, 2, 3), "max"
+
+
+class GlobalAveragePooling3D(_GlobalPool):
+    axes, mode = (1, 2, 3), "avg"

@@ -158,7 +158,10 @@ class SSDDetector(ZooModel):
         n_maps = len(scales)
         strides = [2 ** (len(channels) - n_maps + 1 + i)
                    for i in range(n_maps)]
-        feature_sizes = [image_size // s for s in strides]
+        # SAME-padded stride-2 convs produce ceil-sized maps; iterated
+        # ceil-div by 2 equals ceil-div by the stride product, so this
+        # matches the head shapes for ANY image_size
+        feature_sizes = [-(-image_size // s) for s in strides]
         self.anchors = generate_anchors(image_size, feature_sizes,
                                         scales, ratios)
         self._module = _SSDNet(num_classes=num_classes,

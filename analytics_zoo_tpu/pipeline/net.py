@@ -1,0 +1,141 @@
+"""Net loaders + graph surgery (reference:
+`pyzoo/zoo/pipeline/api/net/{graph_net,net_load}.py` —
+`Net.load_bigdl/load_caffe/load_tf/load_torch` and GraphNet's
+`new_graph`/`freeze`).
+
+TPU-native: the live import paths are ONNX (wire decoder + flax
+interpreter) and torch (fx tracing); JVM-serialized formats (BigDL,
+Caffe, TF1 frozen graphs) have no portable runtime here and raise with
+the ONNX/torch escape hatch spelled out.  Graph surgery operates on the
+decoded ONNX graph: `new_graph` backward-slices to new output tensors,
+`freeze` turns trainable initializers into constants."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Net:
+    @staticmethod
+    def load_onnx(path_or_bytes):
+        """-> (flax module, decoded Model)."""
+        from analytics_zoo_tpu.pipeline.onnx import load_onnx
+        return load_onnx(path_or_bytes)
+
+    @staticmethod
+    def load_torch(module_or_path):
+        """torch.nn.Module (or a torch.save'd module file) ->
+        (flax module, params, model_state) via the fx importer."""
+        from analytics_zoo_tpu.orca.learn.torch_adapter import (
+            torch_to_flax)
+        if isinstance(module_or_path, str):
+            import torch
+            module_or_path = torch.load(module_or_path,
+                                        weights_only=False)
+        return torch_to_flax(module_or_path)
+
+    @staticmethod
+    def load_bigdl(path: str):
+        raise NotImplementedError(
+            "BigDL JVM serialization has no portable runtime on TPU "
+            "hosts; export the model to ONNX and use Net.load_onnx")
+
+    @staticmethod
+    def load_caffe(def_path: str, model_path: str):
+        raise NotImplementedError(
+            "Caffe import is not supported; convert to ONNX and use "
+            "Net.load_onnx")
+
+    @staticmethod
+    def load_tf(path: str):
+        raise NotImplementedError(
+            "TF graph import is not supported in this image (no "
+            "tensorflow); export to ONNX and use Net.load_onnx")
+
+
+class GraphNet:
+    """Surgery over a decoded ONNX model (reference GraphNet.new_graph /
+    freeze semantics on BigDL graphs)."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def new_graph(self, output_names: Sequence[str]) -> "GraphNet":
+        """Re-root the graph at intermediate tensors: keeps only the
+        backward slice that produces `output_names` (reference
+        GraphNet.new_graph)."""
+        import copy
+
+        g = self.model.graph
+        produced = {o: n for n in g.nodes for o in n.outputs}
+        for name in output_names:
+            if name not in produced and name not in g.initializers \
+                    and name not in [i for i, _ in g.inputs]:
+                raise ValueError(f"unknown tensor '{name}'")
+        needed: List = []
+        seen = set()
+        stack = list(output_names)
+        while stack:
+            t = stack.pop()
+            node = produced.get(t)
+            if node is None or id(node) in seen:
+                continue
+            seen.add(id(node))
+            needed.append(node)
+            stack.extend(node.inputs)
+        order = {id(n): i for i, n in enumerate(g.nodes)}
+        needed.sort(key=lambda n: order[id(n)])
+
+        new_model = copy.copy(self.model)
+        new_graph = copy.copy(g)
+        new_graph.nodes = needed
+        new_graph.outputs = list(output_names)
+        # drop initializers the slice no longer touches
+        used = {i for n in needed for i in n.inputs}
+        new_graph.initializers = {k: v for k, v in g.initializers.items()
+                                  if k in used}
+        new_model.graph = new_graph
+        return GraphNet(new_model)
+
+    def freeze(self) -> "GraphNet":
+        """Make every initializer a constant (no trainable params) —
+        the imported net becomes a fixed feature extractor (reference
+        GraphNet.freeze)."""
+        new = GraphNet(self.model)
+        new._frozen = True
+        return new
+
+    def to_module(self):
+        if getattr(self, "_frozen", False):
+            return _FrozenOnnx(self.model)
+        from analytics_zoo_tpu.pipeline.onnx.onnx_loader import OnnxModule
+        return OnnxModule(self.model)
+
+
+class _FrozenOnnx:
+    """Callable wrapper executing the graph with ALL initializers as
+    constants (a fixed feature extractor; nothing to train)."""
+
+    def __init__(self, model):
+        from analytics_zoo_tpu.pipeline.onnx.onnx_loader import OnnxModule
+        self._module = OnnxModule(model)
+        import jax
+        self._vars = self._module.init(
+            jax.random.PRNGKey(0),
+            *self._zero_inputs(model))
+
+    def _zero_inputs(self, model):
+        import numpy as _np
+        feeds = []
+        for name, shape in model.graph.inputs:
+            if name in model.graph.initializers:
+                continue
+            shape = [1 if (s is None or s < 0) else s
+                     for s in (shape or [1])]
+            feeds.append(_np.zeros(shape, _np.float32))
+        return feeds
+
+    def __call__(self, *args):
+        return self._module.apply(self._vars, *args)

@@ -25,9 +25,9 @@ from analytics_zoo_tpu.ppml import fl_proto as P
 
 
 class _PSIState:
-    def __init__(self):
+    def __init__(self, client_num: int = 1):
         self.salt = secrets.token_hex(16)
-        self.client_num = 1
+        self.client_num = client_num
         self.sets: Dict[str, Set[str]] = {}
         self.lock = threading.Lock()
 
@@ -103,7 +103,10 @@ class FLServer:
     def _task(self, task_id: str) -> _PSIState:
         with self._lock:
             if task_id not in self._psi:
-                self._psi[task_id] = _PSIState()
+                # the server-configured client count is the default gate;
+                # getSalt may raise it per task but a lone client must
+                # never see its own upload echoed as the "intersection"
+                self._psi[task_id] = _PSIState(self.client_num)
             return self._psi[task_id]
 
     def _get_salt(self, request: bytes, context) -> bytes:

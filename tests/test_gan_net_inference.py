@@ -1,0 +1,153 @@
+"""GANEstimator, inference-only estimator, Net loaders + graph surgery
+(VERDICT r1 components #31, #19, #29)."""
+
+import flax.linen as nn
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+
+
+class _G(nn.Module):
+    out: int = 2
+
+    @nn.compact
+    def __call__(self, z):
+        h = nn.relu(nn.Dense(32)(z))
+        return nn.Dense(self.out)(h)
+
+
+class _D(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        h = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(1)(h)
+
+
+def test_gan_estimator_learns_gaussian_ring():
+    from analytics_zoo_tpu.orca.learn.gan import GANEstimator
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    # real data: 2-d gaussian centered at (3, -2)
+    real = rng.normal([3.0, -2.0], 0.3, (512, 2)).astype(np.float32)
+    gan = GANEstimator(_G(out=2), _D(), noise_dim=4, seed=0)
+    gan.fit({"x": real}, epochs=60, batch_size=64)
+    fake = gan.generate(256)
+    assert fake.shape == (256, 2)
+    # generator found the mode: mean within ~4 sigma of real center
+    center = fake.mean(axis=0)
+    assert abs(center[0] - 3.0) < 1.0 and abs(center[1] + 2.0) < 1.0, \
+        center
+    assert len(gan.train_summary) == 60
+    # save/load round-trips the generator
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        p = gan.save(d + "/gan.pkl")
+        gan2 = GANEstimator(_G(out=2), _D(), noise_dim=4, seed=0)
+        gan2.load(p)
+        np.testing.assert_allclose(gan2.generate(8, seed=3),
+                                   gan.generate(8, seed=3), atol=1e-5)
+
+
+def test_gan_estimator_gsteps_dsteps():
+    from analytics_zoo_tpu.orca.learn.gan import GANEstimator
+
+    init_orca_context(cluster_mode="local")
+    real = np.random.default_rng(1).normal(
+        size=(64, 2)).astype(np.float32)
+    gan = GANEstimator(_G(out=2), _D(), noise_dim=4, g_steps=2,
+                       d_steps=3)
+    gan.fit({"x": real}, epochs=2, batch_size=32)
+    assert np.isfinite(gan.generate(4)).all()
+
+
+def test_inference_estimator_from_saved_zoo_model(tmp_path):
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.orca.learn import Estimator
+    from analytics_zoo_tpu.orca.learn.inference_estimator import (
+        InferenceEstimator)
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    u, i = rng.integers(1, 101, 200), rng.integers(1, 51, 200)
+    y = ((u + i) % 2).astype(np.int32)
+    model = NeuralCF(user_count=100, item_count=50, class_num=2,
+                     compute_dtype=np.float32)
+    est = Estimator.from_flax(model,
+                              loss="sparse_categorical_crossentropy",
+                              optimizer="adam", learning_rate=5e-3,
+                              metrics=["accuracy"])
+    est.fit({"x": [u, i], "y": y}, epochs=4, batch_size=64)
+    trained_acc = est.evaluate({"x": [u, i], "y": y},
+                               batch_size=64)["accuracy"]
+
+    # persist via the ZooModel path, reload inference-only
+    model._estimator = est
+    path = model.save_model(str(tmp_path / "ncf"))
+    inf = InferenceEstimator.from_saved_model(path)
+    preds = inf.predict({"x": [u, i]}, batch_size=64)
+    assert preds.shape == (200, 2)
+    stats = inf.evaluate({"x": [u, i], "y": y}, batch_size=64)
+    assert abs(stats["accuracy"] - trained_acc) < 1e-6
+    with pytest.raises(NotImplementedError):
+        inf.fit({"x": [u, i], "y": y})
+
+
+def test_net_loaders_and_graph_surgery():
+    import jax
+
+    from analytics_zoo_tpu.pipeline.net import GraphNet, Net
+    from analytics_zoo_tpu.pipeline.onnx.onnx_proto import encode_model
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    w1 = rng.normal(size=(8, 4)).astype(np.float32)
+    w2 = rng.normal(size=(2, 8)).astype(np.float32)
+    data = encode_model(
+        nodes=[("Gemm", ["x", "w1"], ["h"], {"transB": 1}),
+               ("Relu", ["h"], ["hr"]),
+               ("Gemm", ["hr", "w2"], ["y"], {"transB": 1})],
+        initializers={"w1": w1, "w2": w2},
+        inputs=[("x", [1, 4])], outputs=["y"])
+    module, model = Net.load_onnx(data)
+
+    # surgery: re-root at the hidden activation
+    feat_net = GraphNet(model).new_graph(["hr"])
+    assert len(feat_net.model.graph.nodes) == 2
+    assert "w2" not in feat_net.model.graph.initializers
+    sub = feat_net.to_module()
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    variables = sub.init(jax.random.PRNGKey(0), x)
+    out = sub.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.maximum(x @ w1.T, 0), atol=1e-5)
+
+    # frozen: no trainable params, runs as a pure function
+    frozen = GraphNet(model).new_graph(["hr"]).freeze().to_module()
+    np.testing.assert_allclose(np.asarray(frozen(x)),
+                               np.maximum(x @ w1.T, 0), atol=1e-5)
+
+    # JVM formats raise with the escape hatch named
+    with pytest.raises(NotImplementedError, match="ONNX"):
+        Net.load_bigdl("x.bigdl")
+    with pytest.raises(NotImplementedError, match="ONNX"):
+        Net.load_tf("frozen.pb")
+
+
+def test_net_load_torch():
+    import torch.nn as tnn
+
+    from analytics_zoo_tpu.pipeline.net import Net
+
+    init_orca_context(cluster_mode="local")
+    m = tnn.Sequential(tnn.Linear(4, 8), tnn.ReLU(), tnn.Linear(8, 2))
+    module, params, state = Net.load_torch(m)
+    import jax
+    x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+    # params materialize on init with the torch weights copied in
+    variables = module.init(jax.random.PRNGKey(0), x)
+    out = module.apply(variables, x)
+    import torch
+    expect = m(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(np.asarray(out), expect, atol=1e-5)

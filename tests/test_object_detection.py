@@ -96,6 +96,23 @@ def test_ssd_trains_and_detects_squares():
     assert hits >= 9, hits  # most squares localized
 
 
+def test_ssd_non_divisible_image_size():
+    """Anchor count matches head output for image sizes that don't
+    divide the stride (SAME convs produce ceil-sized maps)."""
+    import jax.numpy as jnp
+    init_orca_context(cluster_mode="local")
+    det = SSDDetector(num_classes=2, image_size=100,
+                      channels=(8, 16, 32), scales=(0.3, 0.6),
+                      compute_dtype=jnp.float32)
+    imgs = np.zeros((2, 100, 100, 3), np.float32)
+    gt_b, gt_l = SSDDetector.pad_ground_truth(
+        [np.array([[0.1, 0.1, 0.5, 0.5]], np.float32)] * 2,
+        [np.array([1])] * 2, max_boxes=2)
+    det.fit({"x": imgs, "y": [gt_b, gt_l]}, epochs=1, batch_size=2)
+    out = det.detect(imgs, score_threshold=0.0)
+    assert len(out) == 2
+
+
 def test_multibox_loss_static_shapes_jit():
     """The loss jits with padded GT and no dynamic shapes."""
     import jax

@@ -43,6 +43,18 @@ def test_psi_waits_until_all_upload(server):
     a.close()
 
 
+def test_psi_server_client_num_gates_without_explicit_salt(server):
+    """A lone client that never calls get_salt(client_num=...) must NOT
+    receive its own set back: the server's configured client_num is the
+    default gate."""
+    target = f"127.0.0.1:{server.port}"
+    a = PSIClient(target, "solo", task_id="t3")
+    a.upload_set(["u1", "u2"])  # implicit salt fetch, no count override
+    with pytest.raises(TimeoutError):
+        a.download_intersection(timeout_s=0.3)
+    a.close()
+
+
 def test_fl_fedavg_two_clients(server):
     target = f"127.0.0.1:{server.port}"
     c1 = FLClient(target, "u1").register()
