@@ -29,11 +29,33 @@ for _i in range(256):
     _TABLE.append(_c)
 
 
-def crc32c(data: bytes, crc: int = 0) -> int:
+def _py_crc32c(data: bytes, crc: int = 0) -> int:
     crc ^= 0xFFFFFFFF
     for b in data:
         crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
     return crc ^ 0xFFFFFFFF
+
+
+_native_crc = None
+_native_checked = False
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """CRC32C; dispatches to the native slicing-by-8 kernel when the
+    C++ library is available (analytics_zoo_tpu.native), else the
+    table-per-byte python implementation."""
+    global _native_crc, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        try:
+            from analytics_zoo_tpu import native as _n
+            if _n.available():
+                _native_crc = _n.crc32c
+        except Exception:  # toolchain-less host: stay on python
+            _native_crc = None
+    if _native_crc is not None:
+        return _native_crc(bytes(data), crc)
+    return _py_crc32c(data, crc)
 
 
 def masked_crc32c(data: bytes) -> int:
