@@ -30,6 +30,21 @@ _DT_FEATURES = {
     "IS_WEEKEND": lambda s: (s.dt.weekday >= 5).astype(np.int64),
 }
 
+#: fixed one-hot ranges so indicator columns are stable across splits;
+#: features absent here (YEAR, DAYOFYEAR, WEEKOFYEAR) have no bounded
+#: calendar range and cannot be one-hotted consistently across splits
+_DT_ONE_HOT_RANGES = {
+    "MINUTE": (0, 59), "HOUR": (0, 23), "DAY": (1, 31),
+    "WEEKDAY": (0, 6), "MONTH": (1, 12), "IS_AWAKE": (0, 1),
+    "IS_BUSY_HOURS": (0, 1), "IS_WEEKEND": (0, 1),
+}
+
+_ROLLING_SETTINGS = {
+    "minimal": ["mean", "std", "min", "max"],
+    "comprehensive": ["mean", "std", "min", "max", "median", "sum",
+                      "skew", "kurt"],
+}
+
 
 def _as_list(x) -> List[str]:
     if x is None:
@@ -143,18 +158,76 @@ class TSDataset:
             return agg
         return self._apply_per_group(_one)
 
-    def gen_dt_feature(self, features: Optional[Sequence[str]] = None):
-        """Append datetime-derived feature columns (reference tsfresh-based
-        gen_dt_feature)."""
+    def gen_dt_feature(self, features: Optional[Sequence[str]] = None,
+                       one_hot_features: Optional[Sequence[str]] = None):
+        """Append datetime-derived feature columns (reference
+        gen_dt_feature).  Features named in `one_hot_features` expand to
+        0/1 indicator columns `<F>_<value>` instead of ordinal ints
+        (reference one_hot_features parameter)."""
         features = list(features) if features else [
             "HOUR", "DAY", "WEEKDAY", "MONTH", "IS_WEEKEND"]
+        one_hot = set(one_hot_features or [])
+        unknown = one_hot - set(features)
+        features += sorted(unknown)  # one-hot-only features still apply
         for f in features:
             if f not in _DT_FEATURES:
                 raise ValueError(f"unknown dt feature '{f}'; "
                                  f"known: {sorted(_DT_FEATURES)}")
-            self.df[f] = _DT_FEATURES[f](self.df[self.dt_col])
-            if f not in self.feature_col:
-                self.feature_col.append(f)
+            vals = _DT_FEATURES[f](self.df[self.dt_col])
+            if f in one_hot:
+                if f not in _DT_ONE_HOT_RANGES:
+                    raise ValueError(
+                        f"'{f}' has no bounded calendar range; one-hot "
+                        "columns derived from the data would differ "
+                        "between train/test splits")
+                lo, hi = _DT_ONE_HOT_RANGES[f]
+                for v in range(lo, hi + 1):
+                    col = f"{f}_{v}"
+                    self.df[col] = (vals == v).astype(np.int64)
+                    if col not in self.feature_col:
+                        self.feature_col.append(col)
+            else:
+                self.df[f] = vals
+                if f not in self.feature_col:
+                    self.feature_col.append(f)
+        return self
+
+    def gen_rolling_feature(self, window_size: int,
+                            settings: Union[str, Sequence[str]]
+                            = "minimal"):
+        """Append rolling statistics of every target column over a
+        trailing window (the reference's tsfresh-backed
+        gen_rolling_feature; tsfresh isn't in the image, so the standard
+        aggregate set is built in).  `settings`: "minimal"
+        (mean/std/min/max) | "comprehensive" (+median/sum/skew/kurt) |
+        an explicit list of pandas rolling aggregates.  The first
+        window_size-1 rows per series hold NaN — impute() or drop before
+        roll()."""
+        if isinstance(settings, str):
+            try:
+                aggs = _ROLLING_SETTINGS[settings]
+            except KeyError:
+                raise ValueError(
+                    f"unknown settings '{settings}'; known: "
+                    f"{sorted(_ROLLING_SETTINGS)} or a list of pandas "
+                    "rolling aggregates")
+        else:
+            aggs = list(settings)
+
+        def _one(g):
+            for c in self.target_col:
+                roll = g[c].rolling(window_size)
+                for agg in aggs:
+                    g[f"{c}_rolling_{agg}_{window_size}"] = \
+                        getattr(roll, agg)()
+            return g
+
+        self._apply_per_group(_one)
+        for c in self.target_col:
+            for agg in aggs:
+                col = f"{c}_rolling_{agg}_{window_size}"
+                if col not in self.feature_col:
+                    self.feature_col.append(col)
         return self
 
     # ------------------------------------------------------------------

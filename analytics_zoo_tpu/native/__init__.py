@@ -143,9 +143,15 @@ def csv_to_f32(text: bytes, cols: int, sep: bytes = b",",
         max_rows = text.count(b"\n") + 1
     if lib is None:
         rows = [r for r in text.decode().splitlines() if r.strip()]
-        return np.asarray(
-            [[float(v) for v in r.split(sep.decode())] for r in rows],
-            np.float32)[:max_rows]
+        parsed = []
+        for i, r in enumerate(rows[:max_rows]):
+            fields = r.split(sep.decode())
+            if len(fields) != cols:  # same contract as the native kernel
+                raise ValueError(
+                    f"malformed CSV row {i}: expected {cols} fields, "
+                    f"got {len(fields)}")
+            parsed.append([float(v) for v in fields])
+        return np.asarray(parsed, np.float32).reshape(-1, cols)
     out = np.empty((max_rows, cols), np.float32)
     err = ctypes.c_uint64(0)
     n = lib.zoo_csv_to_f32(

@@ -43,8 +43,12 @@ _native_checked = False
 def crc32c(data: bytes, crc: int = 0) -> int:
     """CRC32C; dispatches to the native slicing-by-8 kernel when the
     C++ library is available (analytics_zoo_tpu.native), else the
-    table-per-byte python implementation."""
+    table-per-byte python implementation.  Tiny inputs stay on the
+    python path unconditionally — the first native call may trigger a
+    g++ build, which must never sit in the small-record hot path."""
     global _native_crc, _native_checked
+    if len(data) < 4096:
+        return _py_crc32c(data, crc)
     if not _native_checked:
         _native_checked = True
         try:

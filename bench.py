@@ -26,6 +26,41 @@ import numpy as np
 #: TPU v5e (v5 lite) peak bf16 throughput per chip
 V5E_PEAK_FLOPS = 197e12
 
+# Persistent XLA compilation cache: BERT-base's train step takes ~6-7
+# minutes to compile through the TPU tunnel; cached, repeat runs start
+# in seconds.  The cache lives beside the repo so every bench run on
+# this host reuses it.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".jax_cache"))
+
+
+def _bert_stage_subprocess(seconds: int):
+    """Run the BERT stage in a child process killed hard at the
+    deadline.  A SIGALRM in-process cannot bound this stage: the
+    minutes-long XLA compile blocks inside C++ and Python signal
+    handlers only run between bytecodes.  The child runs BEFORE the
+    parent initializes the TPU, so the chip has one owner at a time;
+    the persistent compile cache makes warm runs finish in seconds."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--bert-stage"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    try:
+        out, _ = proc.communicate(timeout=max(5, seconds))
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise TimeoutError(f"BERT stage exceeded {seconds}s "
+                           "(cold compile; warm cache runs finish fast)")
+    if proc.returncode != 0:
+        raise RuntimeError("BERT stage subprocess failed")
+    line = out.decode().strip().splitlines()[-1]
+    return json.loads(line)
+
 
 def _ncf_model():
     from analytics_zoo_tpu.models.recommendation import NeuralCF
@@ -142,13 +177,29 @@ def bert_finetune_metrics(batch: int = 32, seq: int = 128,
 
 
 def main():
+    t_start = time.monotonic()
+    budget = float(os.environ.get("BENCH_TIME_BUDGET_S", 540))
+    batch = int(os.environ.get("BENCH_BATCH", 65536))
+    steps = int(os.environ.get("BENCH_STEPS", 30))
+
+    # BERT stage FIRST, in a killable subprocess, before this process
+    # initializes the TPU (NCF stages take a known ~150s; leave them
+    # room).  Its failure/timeout must never cost the primary metric.
+    ncf_reserve = 190
+    bert_extra = {}
+    if os.environ.get("BENCH_BERT", "1") == "0":
+        bert_extra = {"bert_error": "disabled via BENCH_BERT=0"}
+    else:
+        try:
+            bert_extra = _bert_stage_subprocess(
+                int(budget - ncf_reserve - 20))
+        except Exception as e:  # timeout / crash: keep the primary metric
+            bert_extra = {"bert_error": f"{type(e).__name__}: {e}"[:200]}
+
     import jax
 
     from analytics_zoo_tpu import init_orca_context
     init_orca_context(cluster_mode="local")
-
-    batch = int(os.environ.get("BENCH_BATCH", 16384))
-    steps = int(os.environ.get("BENCH_STEPS", 30))
 
     est_tput = ncf_estimator_throughput(batch, steps)
     raw_tput = ncf_raw_throughput(jax.devices()[0].platform, batch,
@@ -164,14 +215,6 @@ def main():
     # 0.0 = CPU baseline unavailable (never fabricate a met target)
     vs = est_tput / (10.0 * cpu) if cpu else 0.0
 
-    try:
-        bert_tps, bert_mfu, bert_params = bert_finetune_metrics()
-        bert_extra = {"bert_finetune_tokens_per_sec": round(bert_tps, 1),
-                      "bert_mfu": round(bert_mfu, 4),
-                      "bert_params": bert_params}
-    except Exception as e:  # never lose the primary metric to the secondary
-        bert_extra = {"bert_error": f"{type(e).__name__}: {e}"[:200]}
-
     print(json.dumps({
         "metric": "ncf_estimator_fit_samples_per_sec",
         "value": round(est_tput, 1),
@@ -179,6 +222,10 @@ def main():
         "vs_baseline": round(vs, 3),
         "extra": {
             "ncf_raw_jit_samples_per_sec": round(raw_tput, 1),
+            # the estimator path re-uploads every batch (real input
+            # pipeline); the raw loop reuses ONE device-resident batch.
+            # Via the tunneled dev chip the upload runs at a few MB/s,
+            # so this ratio is transfer-bound here, not framework-bound.
             "estimator_vs_raw": round(est_tput / raw_tput, 3),
             "cpu_raw_samples_per_sec": round(cpu, 1) if cpu else None,
             **bert_extra,
@@ -187,4 +234,14 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    if "--bert-stage" in sys.argv:
+        from analytics_zoo_tpu import init_orca_context
+        init_orca_context(cluster_mode="local")
+        tps, mfu, n_params = bert_finetune_metrics()
+        print(json.dumps({
+            "bert_finetune_tokens_per_sec": round(tps, 1),
+            "bert_mfu": round(mfu, 4),
+            "bert_params": n_params}))
+    else:
+        main()

@@ -108,7 +108,10 @@ def test_bert_tp_shard_rules_applied():
     seg = np.zeros((16, 8), np.int32)
     y = rng.integers(0, 2, 16).astype(np.int32)
     est.fit({"x": [ids, seg], "y": y}, epochs=1, batch_size=8)
-    qkv = est._engine.state.params["bert"]["block_0"]["attn"]["qkv"]["kernel"]
+    bert = est._engine.state.params["bert"]
+    # scan_layers stacks blocks under "blocks"; unrolled uses "block_0"
+    qkv = (bert["blocks"] if "blocks" in bert else bert["block_0"])[
+        "attn"]["qkv"]["kernel"]
     assert "tp" in str(qkv.sharding.spec)
     stop_orca_context()
 

@@ -161,6 +161,32 @@ def test_xshards_tsdataset_global_scaling():
     assert np.isclose(un[0, 0, 0], df["value"].mean(), atol=1e-6)
 
 
+def test_tsdataset_one_hot_and_rolling_features():
+    from analytics_zoo_tpu.chronos.data.tsdataset import TSDataset
+
+    init_orca_context(cluster_mode="local")
+    df = _multi_id_df(n_ids=2, n_steps=30)
+    ts = TSDataset.from_pandas(df, dt_col="dt", target_col="value",
+                               id_col="id")
+    ts.gen_dt_feature(features=["HOUR"], one_hot_features=["IS_WEEKEND"])
+    assert "HOUR" in ts.feature_col
+    assert {"IS_WEEKEND_0", "IS_WEEKEND_1"} <= set(ts.feature_col)
+    oh = ts.df[["IS_WEEKEND_0", "IS_WEEKEND_1"]].to_numpy()
+    assert ((oh.sum(axis=1)) == 1).all()
+
+    ts.gen_rolling_feature(window_size=4, settings="minimal")
+    col = "value_rolling_mean_4"
+    assert col in ts.feature_col
+    # per-series rolling: first 3 rows of EACH id are NaN
+    for _, g in ts.df.groupby("id"):
+        assert g[col].isna().sum() == 3
+        got = g[col].iloc[4]
+        np.testing.assert_allclose(got, g["value"].iloc[1:5].mean())
+
+    with pytest.raises(ValueError, match="settings"):
+        ts.gen_rolling_feature(4, settings="everything")
+
+
 def test_doppelganger_simulator_generates_plausible_series():
     from analytics_zoo_tpu.chronos.simulator import DPGANSimulator
 

@@ -107,7 +107,7 @@ def test_bert_ner_trains_token_tagging():
 
     model = BERTNER(num_entities=2, **_bert_kwargs())
     est = model.estimator(learning_rate=2e-3)
-    est.fit({"x": [ids, seg, msk], "y": tags}, epochs=8, batch_size=32)
+    est.fit({"x": [ids, seg, msk], "y": tags}, epochs=14, batch_size=32)
     stats = est.evaluate({"x": [ids, seg, msk], "y": tags},
                          batch_size=32)
     assert stats["accuracy"] > 0.9, stats
