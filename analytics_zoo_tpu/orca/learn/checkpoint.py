@@ -28,12 +28,78 @@ def save_checkpoint(path: str, state) -> str:
 
 
 def load_checkpoint(path: str, target_state):
-    """Restore into the sharding/structure of `target_state`."""
+    """Restore into the sharding/structure of `target_state`.
+
+    Transformer checkpoints written before scan-over-layers store one
+    `block_i` subtree per layer; current modules stack them under a
+    single `blocks` subtree with a leading layer axis.  On a structure
+    mismatch the raw checkpoint is re-read and old-layout subtrees are
+    stacked before mapping onto the target."""
     path = os.path.abspath(path)
     ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(path, target_state)
+    try:
+        restored = ckptr.restore(path, target_state)
+    except Exception:
+        raw = ckptr.restore(path)
+        converted = _stack_block_subtrees(raw)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(target_state)
+        leaves = []
+        for key_path, target_leaf in flat:
+            v = _lookup_path(converted, key_path)
+            arr = np.asarray(v)
+            if hasattr(target_leaf, "sharding"):
+                arr = jax.device_put(arr, target_leaf.sharding)
+            leaves.append(arr)
+        restored = jax.tree_util.tree_unflatten(treedef, leaves)
     ckptr.close()
     return restored
+
+
+def _lookup_path(tree, key_path):
+    """Walk a raw-restored (nested dict/list) checkpoint by a pytree key
+    path from the target state (GetAttrKey for dataclass fields, DictKey,
+    SequenceKey; orbax may store sequences as int-keyed dicts)."""
+    node = tree
+    for k in key_path:
+        if hasattr(k, "name"):        # GetAttrKey
+            node = node[k.name]
+        elif hasattr(k, "key"):       # DictKey
+            node = node[k.key]
+        elif hasattr(k, "idx"):       # SequenceKey
+            if isinstance(node, dict):
+                node = node.get(k.idx, node.get(str(k.idx)))
+            else:
+                node = node[k.idx]
+        else:
+            raise KeyError(f"unsupported key entry {k!r}")
+    return node
+
+
+def _stack_block_subtrees(tree):
+    """Recursively replace {"block_0": ..., "block_1": ...} families
+    with {"blocks": stacked} (leading layer axis), matching nn.scan's
+    parameter layout."""
+    if isinstance(tree, (list, tuple)):
+        # optimizer-state containers restore as sequences; the per-block
+        # subtrees they mirror live beneath them
+        return type(tree)(_stack_block_subtrees(v) for v in tree)
+    if not isinstance(tree, dict):
+        return tree
+    out = {k: _stack_block_subtrees(v) for k, v in tree.items()}
+    block_keys = sorted(
+        (k for k in out if k.startswith("block_")
+         and k.split("_", 1)[1].isdigit()),
+        key=lambda k: int(k.split("_", 1)[1]))
+    if block_keys and "blocks" not in out:
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: np.stack([np.asarray(x) for x in leaves]),
+            *[out[k] for k in block_keys])
+        for k in block_keys:
+            del out[k]
+        out["blocks"] = stacked
+    return out
+
+
 
 
 def find_latest_checkpoint(model_dir: str,

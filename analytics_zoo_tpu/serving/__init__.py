@@ -3,8 +3,13 @@ Cluster Serving stack (Flink+Redis streaming, akka-http/gRPC frontends,
 InferenceModel pool; /root/reference/zoo/src/main/scala/.../serving/,
 pipeline/inference/InferenceModel.scala, pyzoo/zoo/serving/client.py)."""
 
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.grpc_frontend import (
+    GrpcInputQueue,
+    GrpcServingFrontend,
+)
 from analytics_zoo_tpu.serving.inference_model import InferenceModel
 from analytics_zoo_tpu.serving.server import ServingServer
-from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
 
-__all__ = ["InferenceModel", "ServingServer", "InputQueue", "OutputQueue"]
+__all__ = ["InferenceModel", "ServingServer", "InputQueue", "OutputQueue",
+           "GrpcInputQueue", "GrpcServingFrontend"]

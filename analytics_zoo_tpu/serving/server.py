@@ -142,7 +142,13 @@ class ServingServer:
                     return
                 self._json(404, {"error": "not found"})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class _Server(ThreadingHTTPServer):
+            # default backlog is 5: a burst of concurrent clients (the
+            # whole point of a batching server) would get conn-refused
+            request_queue_size = 128
+            daemon_threads = True
+
+        self._httpd = _Server((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._threads: List[threading.Thread] = []
 

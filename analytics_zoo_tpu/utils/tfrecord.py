@@ -47,7 +47,8 @@ def crc32c(data: bytes, crc: int = 0) -> int:
     python path unconditionally — the first native call may trigger a
     g++ build, which must never sit in the small-record hot path."""
     global _native_crc, _native_checked
-    if len(data) < 4096:
+    if _native_crc is None and len(data) < 4096:
+        # small input and library not yet loaded: don't trigger a build
         return _py_crc32c(data, crc)
     if not _native_checked:
         _native_checked = True

@@ -178,21 +178,24 @@ def bert_finetune_metrics(batch: int = 32, seq: int = 128,
 
 def main():
     t_start = time.monotonic()
-    budget = float(os.environ.get("BENCH_TIME_BUDGET_S", 540))
+    # default budget leaves the BERT stage ~425s: enough for ONE cold
+    # compile (~400s measured) so a fresh host still warms the
+    # persistent cache on its first run instead of timing out forever
+    budget = float(os.environ.get("BENCH_TIME_BUDGET_S", 600))
     batch = int(os.environ.get("BENCH_BATCH", 65536))
     steps = int(os.environ.get("BENCH_STEPS", 30))
 
     # BERT stage FIRST, in a killable subprocess, before this process
     # initializes the TPU (NCF stages take a known ~150s; leave them
     # room).  Its failure/timeout must never cost the primary metric.
-    ncf_reserve = 190
+    ncf_reserve = 160
     bert_extra = {}
     if os.environ.get("BENCH_BERT", "1") == "0":
         bert_extra = {"bert_error": "disabled via BENCH_BERT=0"}
     else:
         try:
             bert_extra = _bert_stage_subprocess(
-                int(budget - ncf_reserve - 20))
+                int(budget - ncf_reserve - 15))
         except Exception as e:  # timeout / crash: keep the primary metric
             bert_extra = {"bert_error": f"{type(e).__name__}: {e}"[:200]}
 

@@ -114,3 +114,44 @@ def test_binary_accuracy_logits_convention():
     labels = jnp.array([1, 0, 1])
     vals = m((preds,), (labels,))
     assert float(vals.mean()) == 1.0
+
+
+def test_pre_scan_checkpoint_loads_into_scanned_transformer(tmp_path):
+    """Checkpoints written with the unrolled block_i layout restore into
+    scan-over-layers modules (load_checkpoint stacks the subtrees)."""
+    import flax.linen as nn
+
+    from analytics_zoo_tpu.keras.layers.self_attention import (
+        TransformerEncoder)
+
+    init_orca_context(cluster_mode="local")
+    kw = dict(vocab=64, hidden_size=16, n_head=2, n_block=2,
+              intermediate_size=32, max_position_len=8,
+              embedding_dropout=0.0, attn_dropout=0.0,
+              residual_dropout=0.0)
+
+    class Clf(nn.Module):
+        scan: bool
+
+        @nn.compact
+        def __call__(self, ids, training=False):
+            seq = TransformerEncoder(scan_layers=self.scan, **kw)(
+                ids, None, None, None, training)
+            return nn.Dense(2)(seq[:, 0])
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (16, 8)).astype(np.int32)
+    y = rng.integers(0, 2, 16).astype(np.int32)
+    old = Estimator.from_flax(Clf(scan=False),
+                              loss="sparse_categorical_crossentropy",
+                              optimizer="adam", learning_rate=1e-3)
+    old.fit({"x": ids, "y": y}, epochs=1, batch_size=8)
+    old.save(str(tmp_path / "ckpt-old"))
+
+    new = Estimator.from_flax(Clf(scan=True),
+                              loss="sparse_categorical_crossentropy",
+                              optimizer="adam", learning_rate=1e-3)
+    new.load(str(tmp_path / "ckpt-old"))
+    np.testing.assert_allclose(new.predict({"x": ids}, batch_size=8),
+                               old.predict({"x": ids}, batch_size=8),
+                               atol=1e-5)

@@ -123,34 +123,49 @@ def test_serving_async_enqueue_dequeue(server):
     assert out.shape == (3,)
 
 
-def test_serving_dynamic_batching_and_throughput(server):
+def test_serving_dynamic_batching_and_throughput():
     """Concurrent single-record clients get batched into fewer device
-    calls; everyone gets the right answer."""
-    client = InputQueue(server.host, server.port)
-    rng = np.random.default_rng(7)
-    xs = [rng.standard_normal(8).astype(np.float32) for _ in range(32)]
-    outs = [None] * len(xs)
+    calls; everyone gets the right answer.  Own server with a generous
+    batching window + a start barrier: on a loaded 1-core host the
+    shared fixture's 3 ms window can degrade to one-request batches and
+    flake the coalescing assertion."""
+    init_orca_context(cluster_mode="local")
+    module, params = _make_model()
+    im = InferenceModel(supported_concurrent_num=4).load_flax(module,
+                                                              params)
+    server = ServingServer(im, port=0, max_batch_size=16,
+                           batch_timeout_ms=150).start()
+    try:
+        client = InputQueue(server.host, server.port)
+        rng = np.random.default_rng(7)
+        xs = [rng.standard_normal(8).astype(np.float32)
+              for _ in range(32)]
+        outs = [None] * len(xs)
+        barrier = threading.Barrier(len(xs))
 
-    def call(j):
-        outs[j] = client.predict(xs[j])
+        def call(j):
+            barrier.wait()
+            outs[j] = client.predict(xs[j])
 
-    before = server._batches_run
-    t0 = time.perf_counter()
-    threads = [threading.Thread(target=call, args=(j,))
-               for j in range(len(xs))]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    latency = time.perf_counter() - t0
-    assert all(o is not None and o.shape == (3,) for o in outs)
-    # the batcher must have coalesced at least some requests
-    assert server._batches_run - before < len(xs)
-    assert latency < 30.0
-    # spot-check correctness against a bigger batch round trip
-    stacked = client.predict(np.stack(xs), batched=True)
-    for j in (0, 7, 31):
-        np.testing.assert_allclose(outs[j], stacked[j], atol=1e-6)
+        before = server._batches_run
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=call, args=(j,))
+                   for j in range(len(xs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        latency = time.perf_counter() - t0
+        assert all(o is not None and o.shape == (3,) for o in outs)
+        # the batcher must have coalesced at least some requests
+        assert server._batches_run - before < len(xs)
+        assert latency < 60.0
+        # spot-check correctness against a bigger batch round trip
+        stacked = client.predict(np.stack(xs), batched=True)
+        for j in (0, 7, 31):
+            np.testing.assert_allclose(outs[j], stacked[j], atol=1e-6)
+    finally:
+        server.stop()
 
 
 def test_serving_error_reporting(server):
@@ -175,3 +190,32 @@ def test_inference_model_load_saved_zoo_model(tmp_path):
     assert out.shape == (64, 2)
     direct = model.predict({"x": [u, i]})
     np.testing.assert_allclose(out, direct, atol=1e-5)
+
+
+def test_grpc_frontend_predict_and_errors():
+    """gRPC ingress shares the HTTP server's batcher + InferenceModel
+    (reference: Cluster Serving's gRPC frontend)."""
+    from analytics_zoo_tpu.serving import (GrpcInputQueue,
+                                           GrpcServingFrontend)
+
+    init_orca_context(cluster_mode="local")
+    m, params = _make_model()
+    im = InferenceModel()
+    im.load_flax(m, params)
+    srv = ServingServer(im, port=0).start()
+    grpc_srv = GrpcServingFrontend(srv, port=0).start()
+    try:
+        q = GrpcInputQueue(port=grpc_srv.port)
+        x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+        out = q.predict(x)
+        assert out.shape == (4, 3)
+        # matches the direct model output
+        direct = np.asarray(im.predict(x))
+        np.testing.assert_allclose(out, direct, atol=1e-5)
+        # wrong input rank surfaces as a serving error, not a hang
+        with pytest.raises(RuntimeError, match="serving error"):
+            q.predict(np.zeros((2, 5), np.float32))
+        q.close()
+    finally:
+        grpc_srv.stop()
+        srv.stop()
