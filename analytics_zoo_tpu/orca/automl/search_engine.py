@@ -112,7 +112,8 @@ class SearchEngine:
                  metric_mode: str = "min", n_sampling: int = 4,
                  epochs: int = 1, grace_epochs: int = 1, eta: int = 2,
                  seed: int = 0, parallelism: int = 1,
-                 backend: str = "thread"):
+                 backend: str = "thread",
+                 search_algorithm: str = "random"):
         self.trainable = trainable
         self.search_space = search_space
         self.mode = metric_mode
@@ -120,6 +121,11 @@ class SearchEngine:
             raise ValueError("metric_mode must be 'min' or 'max'")
         if backend not in ("thread", "process"):
             raise ValueError("backend must be 'thread' or 'process'")
+        if search_algorithm not in ("random", "tpe"):
+            raise ValueError(
+                "search_algorithm must be 'random' or 'tpe' (the "
+                "reference's skopt/bayesopt role is filled by TPE)")
+        self.search_algorithm = search_algorithm
         self.n_sampling = n_sampling
         self.epochs = epochs
         self.grace_epochs = max(1, grace_epochs)
@@ -148,8 +154,13 @@ class SearchEngine:
                 cfg.update(dict(zip(grid_keys, combo)))
                 configs.append(cfg)
             return configs
+        n = self.n_sampling
+        if self.search_algorithm == "tpe":
+            # warm-up half at random; the rest are TPE-sampled after the
+            # first rung's observations arrive (BOHB-style)
+            n = max(2, n // 2)
         return [hp_mod.sample_config(self.search_space, self.rng)
-                for _ in range(self.n_sampling)]
+                for _ in range(n)]
 
     def _sort_key(self, t: "Trial"):
         """NaN metrics (diverged trials) always rank worst."""
@@ -161,6 +172,103 @@ class SearchEngine:
 
     def _better(self, a: float, b: float) -> bool:
         return a < b if self.mode == "min" else a > b
+
+    # -- TPE (Tree-structured Parzen Estimator) -------------------------
+    #
+    # The reference plugs skopt/bayesopt into Ray Tune
+    # (ray_tune_search_engine.py search_alg); here the model-based
+    # sampler is hyperopt's TPE, dependency-free: observations split
+    # into good/bad by metric quantile, candidates are drawn from a
+    # kernel density over the GOOD configs and ranked by the density
+    # ratio l(x)/g(x).
+
+    _TPE_GAMMA = 0.3          # good-quantile fraction
+    _TPE_CANDIDATES = 24      # candidates scored per new trial
+
+    def _tpe_split(self):
+        scored = [t for t in self.trials if t.best_metric is not None
+                  and not math.isnan(t.best_metric)]
+        scored.sort(key=self._sort_key)
+        n_good = max(1, int(len(scored) * self._TPE_GAMMA))
+        return scored[:n_good], scored[n_good:]
+
+    def _tpe_sample_config(self, good: List[Trial],
+                           bad: List[Trial]) -> Dict[str, Any]:
+        from analytics_zoo_tpu.orca.automl.hp import (
+            Choice,
+            GridSearch,
+            LogUniform,
+            QUniform,
+            RandInt,
+            SampleSpace,
+        )
+
+        def density(values, x, lo, hi):
+            """Parzen estimate over observed numeric values."""
+            if not values:
+                return 1.0
+            bw = max((hi - lo) / max(len(values), 1), 1e-12)
+            return sum(
+                math.exp(-0.5 * ((x - v) / bw) ** 2) for v in values
+            ) / (len(values) * bw) + 1e-12
+
+        cfg = {}
+        for key, space in self.search_space.items():
+            if not isinstance(space, SampleSpace):
+                cfg[key] = space
+                continue
+            g_vals = [t.config[key] for t in good]
+            b_vals = [t.config[key] for t in bad]
+            if isinstance(space, (Choice, GridSearch)):
+                cats = space.grid_values()
+                # categorical TPE: counts in the good set + uniform prior
+                weights = [1.0 + sum(1 for v in g_vals if v == c)
+                           for c in cats]
+                total = sum(weights)
+                r = self.rng.random() * total
+                acc = 0.0
+                cfg[key] = cats[-1]
+                for c, w in zip(cats, weights):
+                    acc += w
+                    if r <= acc:
+                        cfg[key] = c
+                        break
+                continue
+            log = isinstance(space, LogUniform)
+            xform = math.log if log else (lambda v: v)
+            g_obs = [xform(v) for v in g_vals]
+            b_obs = [xform(v) for v in b_vals]
+            lo = min(g_obs + b_obs, default=0.0)
+            hi = max(g_obs + b_obs, default=1.0)
+            best_x, best_score = None, -math.inf
+            for _ in range(self._TPE_CANDIDATES):
+                # draw from the good-KDE: gaussian around a good point
+                if g_obs:
+                    center = self.rng.choice(g_obs)
+                    bw = max((hi - lo) / max(len(g_obs), 1), 1e-12)
+                    x = self.rng.gauss(center, bw)
+                else:
+                    x = xform(space.sample(self.rng))
+                score = (density(g_obs, x, lo, hi)
+                         / density(b_obs, x, lo, hi))
+                if score > best_score:
+                    best_x, best_score = x, score
+            raw = math.exp(best_x) if log else best_x
+            # clamp to the space's EXACT bounds and honor its value
+            # contract (ints for RandInt, q-steps for QUniform)
+            if log:
+                raw = min(max(raw, math.exp(space.log_lower)),
+                          math.exp(space.log_upper))
+            elif isinstance(space, RandInt):
+                raw = int(min(max(round(raw), space.lower),
+                              space.upper - 1))
+            elif isinstance(space, QUniform):
+                raw = round(raw / space.q) * space.q
+                raw = min(max(raw, space.lower), space.upper)
+            else:
+                raw = min(max(raw, space.lower), space.upper)
+            cfg[key] = raw
+        return cfg
 
     def run(self) -> Trial:
         self.trials = [Trial(i, c) for i, c in enumerate(self._configs())]
@@ -179,9 +287,15 @@ class SearchEngine:
                                                None]) -> Trial:
         alive = list(self.trials)
         budget = self.grace_epochs
+        from analytics_zoo_tpu.orca.automl.hp import GridSearch
+        grid_mode = any(isinstance(v, GridSearch)
+                        for v in self.search_space.values())
+        # grid mode compares like with like — TPE must not pollute it
+        tpe_pending = (self.search_algorithm == "tpe" and not grid_mode
+                       and len(self.trials) < self.n_sampling)
         while alive:
             # a lone survivor always trains to the full epoch budget
-            if len(alive) == 1:
+            if len(alive) == 1 and not tpe_pending:
                 budget = self.epochs
             work = []
             for t in alive:
@@ -189,6 +303,21 @@ class SearchEngine:
                 if add > 0:
                     work.append((t, add))
             train_batch(work)
+            if tpe_pending:
+                # first-rung observations are in: spend the remaining
+                # sampling budget on model-guided configs at the same rung
+                tpe_pending = False
+                good, bad = self._tpe_split()
+                fresh = []
+                for _ in range(self.n_sampling - len(self.trials)):
+                    t = Trial(len(self.trials),
+                              self._tpe_sample_config(good, bad))
+                    self.trials.append(t)
+                    fresh.append(t)
+                if fresh:
+                    train_batch([(t, min(budget, self.epochs))
+                                 for t in fresh])
+                    alive = alive + fresh
             # errored trials are dead regardless of rank
             alive = [t for t in alive if not t.stopped]
             if budget >= self.epochs or not alive:

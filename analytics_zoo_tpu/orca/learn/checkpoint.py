@@ -67,7 +67,14 @@ def _lookup_path(tree, key_path):
             node = node[k.key]
         elif hasattr(k, "idx"):       # SequenceKey
             if isinstance(node, dict):
-                node = node.get(k.idx, node.get(str(k.idx)))
+                if k.idx in node:
+                    node = node[k.idx]
+                elif str(k.idx) in node:
+                    node = node[str(k.idx)]
+                else:
+                    raise KeyError(
+                        f"checkpoint missing sequence index {k.idx} "
+                        f"(has {sorted(node)[:8]})")
             else:
                 node = node[k.idx]
         else:

@@ -25,7 +25,6 @@ import numpy as np
 from analytics_zoo_tpu.utils.tf_example import (
     _len_delim,
     _read_varint,
-    _tag,
     _varint,
     to_signed,
     walk_fields,
@@ -135,12 +134,19 @@ class GrpcInputQueue:
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b)
 
-    def predict(self, *inputs: np.ndarray):
-        reply = self._fn(encode_predict_request(
-            tuple(np.asarray(a, np.float32) for a in inputs)))
+    def predict(self, *inputs: np.ndarray, batched: bool = False):
+        """Like the HTTP InputQueue: a single RECORD by default (gets a
+        batch dim added and joins the dynamic batch; the dim is stripped
+        from the result); pass batched=True for pre-batched arrays."""
+        arrays = tuple(np.asarray(a, np.float32) for a in inputs)
+        if not batched:
+            arrays = tuple(a[None] for a in arrays)
+        reply = self._fn(encode_predict_request(arrays))
         outputs, error = decode_predict_response(reply)
         if error:
             raise RuntimeError(f"serving error: {error}")
+        if not batched:
+            outputs = [o[0] for o in outputs]
         return outputs[0] if len(outputs) == 1 else tuple(outputs)
 
     def close(self):

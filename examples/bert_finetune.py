@@ -1,0 +1,40 @@
+"""BERT classifier fine-tune (reference: tfpark bert_classifier.py —
+BASELINE config #5).  A small config keeps this runnable in minutes;
+scale hidden/blocks for the real thing — the TP shard rules and masked
+flash attention engage automatically."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from a checkout without install
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.models.bert import BERTClassifier
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    n, seq, vocab = 512, 64, 1000
+    ids = rng.integers(3, vocab, (n, seq)).astype(np.int32)
+    seg = np.zeros((n, seq), np.int32)
+    msk = np.ones((n, seq), np.int32)
+    # learnable: does token 7 appear in the sequence?
+    y = (ids == 7).any(axis=1).astype(np.int32)
+
+    model = BERTClassifier(num_classes=2, vocab=vocab, hidden_size=64,
+                           n_block=4, n_head=4, intermediate_size=128,
+                           max_position_len=seq, hidden_drop=0.1,
+                           attn_drop=0.1)
+    est = model.estimator(learning_rate=1e-3)
+    est.fit({"x": [ids, seg, msk], "y": y}, epochs=6, batch_size=64)
+    print("final:", est.evaluate({"x": [ids, seg, msk], "y": y},
+                                 batch_size=64))
+    stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

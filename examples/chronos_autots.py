@@ -1,0 +1,45 @@
+"""Chronos AutoTS on a synthetic nyc-taxi-like series (reference:
+pyzoo/zoo/chronos/examples/auto_model/autolstm_nyc_taxi.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from a checkout without install
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.chronos.autots import AutoTSEstimator
+from analytics_zoo_tpu.chronos.data import TSDataset
+
+
+
+def make_series(n=2000):
+    ts = pd.date_range("2024-01-01", periods=n, freq="30min")
+    t = np.arange(n)
+    value = (10 + 3 * np.sin(2 * np.pi * t / 48)       # daily cycle
+             + 1.5 * np.sin(2 * np.pi * t / (48 * 7))  # weekly cycle
+             + np.random.default_rng(0).normal(0, 0.3, n))
+    return pd.DataFrame({"timestamp": ts, "value": value})
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    df = make_series()
+    train, _, test = TSDataset.from_pandas(
+        df, dt_col="timestamp", target_col="value", with_split=True,
+        test_ratio=0.1)
+
+    auto = AutoTSEstimator(model="lstm", past_seq_len=48,
+                           future_seq_len=1)
+    pipeline = auto.fit(train, epochs=3, n_sampling=3, batch_size=64)
+    pred = pipeline.predict(test)
+    print("forecast shape:", pred.shape)
+    print("eval:", pipeline.evaluate(test))
+    stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""NCF on a DataFrame with feature_cols/label_cols (reference:
+README.md:66-86 + apps/recommendation-ncf)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from a checkout without install
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.models.recommendation import NeuralCF
+from analytics_zoo_tpu.orca.learn import Estimator
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    n = 10_000
+    df = pd.DataFrame({
+        "user": rng.integers(1, 2001, n),
+        "item": rng.integers(1, 501, n),
+    })
+    df["label"] = ((df.user * 31 + df.item) % 2).astype(np.int32)
+
+    est = Estimator.from_flax(
+        NeuralCF(user_count=2000, item_count=500, class_num=2),
+        loss="sparse_categorical_crossentropy", optimizer="adam",
+        learning_rate=5e-3, metrics=["accuracy"])
+    est.fit(df, epochs=5, batch_size=256,
+            feature_cols=["user", "item"], label_cols=["label"])
+    stats = est.evaluate(df, batch_size=256,
+                         feature_cols=["user", "item"],
+                         label_cols=["label"])
+    print("final:", stats)
+    preds = est.predict(df.head(8), batch_size=8,
+                        feature_cols=["user", "item"])
+    print("sample predictions:\n", np.asarray(preds))
+    stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

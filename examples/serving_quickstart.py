@@ -1,0 +1,58 @@
+"""Serving quickstart (reference: Cluster Serving programming guide) —
+one process exposing a trained model over HTTP and gRPC with dynamic
+batching."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from a checkout without install
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.serving import (
+    GrpcInputQueue,
+    GrpcServingFrontend,
+    InferenceModel,
+    InputQueue,
+    ServingServer,
+)
+
+
+def main():
+    import flax.linen as nn
+    import jax
+
+    init_orca_context(cluster_mode="local")
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(nn.relu(nn.Dense(32)(x)))
+
+    m = MLP()
+    params = m.init(jax.random.PRNGKey(0),
+                    np.zeros((1, 8), np.float32))["params"]
+    im = InferenceModel(supported_concurrent_num=4).load_flax(m, params)
+
+    http_srv = ServingServer(im, port=0).start()
+    grpc_srv = GrpcServingFrontend(http_srv, port=0).start()
+    print(f"HTTP on :{http_srv.port}  gRPC on :{grpc_srv.port}")
+
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    http_out = InputQueue("127.0.0.1", http_srv.port).predict(
+        x, batched=True)
+    grpc_client = GrpcInputQueue(port=grpc_srv.port)
+    grpc_out = grpc_client.predict(x, batched=True)
+    print("HTTP == gRPC:",
+          bool(np.allclose(http_out, grpc_out, atol=1e-5)))
+
+    grpc_client.close()
+    grpc_srv.stop()
+    http_srv.stop()
+    stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Wide & Deep with friesian feature engineering (reference:
+apps/recommendation-wide-n-deep + friesian/feature/table.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from a checkout without install
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.friesian import FeatureTable
+from analytics_zoo_tpu.models.recommendation import (
+    ColumnFeatureInfo,
+    WideAndDeep,
+)
+from analytics_zoo_tpu.orca.learn import Estimator
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    n = 4000
+    df = pd.DataFrame({
+        "user": rng.integers(1, 101, n),
+        "item": rng.integers(1, 201, n),
+        "price": rng.uniform(0, 100, n),
+        "cat": rng.choice(["a", "b", "c", "d"], n),
+    })
+
+    t = FeatureTable.from_pandas(df, num_shards=4)
+    t, _ = t.category_encode("cat")
+    t = t.cross_hash_encode(["user", "item"], bins=128)
+    t, _ = t.min_max_scale("price")
+    out = t.to_pandas()
+    out["label"] = ((out.user + out.item) % 2).astype(np.int32)
+
+    info = ColumnFeatureInfo(
+        wide_base_cols=["cat"], wide_base_dims=[5],
+        wide_cross_cols=["user_item"], wide_cross_dims=[128],
+        embed_cols=["user", "item"], embed_in_dims=[101, 201],
+        embed_out_dims=[8, 8], continuous_cols=["price"])
+    model = WideAndDeep(class_num=2, column_info=info,
+                        compute_dtype=jnp.bfloat16)
+    x = out[info.feature_cols].to_numpy(np.float32)
+    est = Estimator.from_flax(
+        model, loss="sparse_categorical_crossentropy", optimizer="adam",
+        learning_rate=5e-3, metrics=["accuracy"])
+    est.fit({"x": x, "y": out["label"].to_numpy()}, epochs=6,
+            batch_size=128)
+    print("final:", est.evaluate({"x": x, "y": out["label"].to_numpy()},
+                                 batch_size=128))
+    stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,53 @@ def test_all_trials_error_raises():
         eng.run()
 
 
+# -- TPE search algorithm ---------------------------------------------------
+
+def test_tpe_concentrates_near_optimum():
+    """TPE's second-half samples cluster around the good region of a
+    quadratic landscape (reference role: skopt/bayesopt search algs)."""
+    target = 0.7
+
+    def trainable(config, state, add_epochs):
+        return None, (config["p"] - target) ** 2
+
+    eng = SearchEngine(trainable, {"p": hp.uniform(0.0, 1.0)},
+                       n_sampling=16, epochs=1, seed=3,
+                       search_algorithm="tpe")
+    best = eng.run()
+    assert abs(best.config["p"] - target) < 0.2, best.config
+    # model-guided half is closer to the optimum on average than the
+    # random warm-up half
+    warm = [t.config["p"] for t in eng.trials[:8]]
+    guided = [t.config["p"] for t in eng.trials[8:]]
+    import numpy as _np
+    assert len(guided) == 8
+    assert _np.mean(_np.abs(_np.array(guided) - target)) < \
+        _np.mean(_np.abs(_np.array(warm) - target))
+
+
+def test_tpe_categorical_and_loguniform():
+    def trainable(config, state, add_epochs):
+        penalty = 0.0 if config["act"] == "relu" else 1.0
+        import math
+        return None, penalty + abs(math.log10(config["lr"]) + 2)
+
+    eng = SearchEngine(
+        trainable,
+        {"act": hp.choice(["relu", "tanh", "sigmoid"]),
+         "lr": hp.loguniform(1e-4, 1e-1)},
+        n_sampling=20, epochs=1, seed=0, search_algorithm="tpe")
+    best = eng.run()
+    assert best.config["act"] == "relu"
+    assert 1e-3 < best.config["lr"] < 1e-1  # near 1e-2 optimum
+
+
+def test_tpe_rejected_with_unknown_algorithm():
+    with pytest.raises(ValueError, match="search_algorithm"):
+        SearchEngine(lambda c, s, e: (None, 0.0), {},
+                     search_algorithm="bayes")
+
+
 # -- process backend --------------------------------------------------------
 
 def _proc_trainable(config, state, add_epochs):
@@ -135,3 +182,65 @@ def test_auto_estimator_process_backend_exports_best_model():
     # best model rebuilt locally with exported weights staged
     assert isinstance(best, _TinyEst)
     assert np.isclose(best._params["w"], 10.0 * 0.5 ** 3)
+
+
+def test_tpe_honors_int_and_quantized_spaces():
+    def trainable(config, state, add_epochs):
+        assert isinstance(config["n_layers"], int), config
+        assert abs(config["q"] / 0.25 - round(config["q"] / 0.25)) < 1e-9
+        return None, abs(config["n_layers"] - 3) + abs(config["q"] - 0.5)
+
+    eng = SearchEngine(
+        trainable,
+        {"n_layers": hp.randint(1, 6), "q": hp.quniform(0.0, 1.0, 0.25)},
+        n_sampling=12, epochs=1, seed=1, search_algorithm="tpe")
+    best = eng.run()
+    assert isinstance(best.config["n_layers"], int)
+
+
+def test_tpe_grid_mode_stays_pure_grid():
+    def trainable(config, state, add_epochs):
+        return None, config["lr"]
+
+    eng = SearchEngine(
+        trainable,
+        {"lr": hp.grid_search([1.0, 2.0]), "units": hp.uniform(16, 64)},
+        n_sampling=6, epochs=1, search_algorithm="tpe")
+    eng.run()
+    # no TPE-injected extras: exactly the grid combos
+    assert len(eng.trials) == 2
+
+
+def test_grpc_single_record_batching():
+    import flax.linen as nn
+    import jax
+
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.serving import (GrpcInputQueue,
+                                           GrpcServingFrontend,
+                                           InferenceModel, ServingServer)
+
+    init_orca_context(cluster_mode="local")
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(x)
+
+    m = M()
+    params = m.init(jax.random.PRNGKey(0),
+                    np.zeros((1, 8), np.float32))["params"]
+    im = InferenceModel().load_flax(m, params)
+    srv = ServingServer(im, port=0).start()
+    g = GrpcServingFrontend(srv, port=0).start()
+    try:
+        q = GrpcInputQueue(port=g.port)
+        rec = np.arange(8, dtype=np.float32)
+        out = q.predict(rec)          # single RECORD, like InputQueue
+        assert out.shape == (3,)
+        np.testing.assert_allclose(
+            out, np.asarray(im.predict(rec[None]))[0], atol=1e-5)
+        q.close()
+    finally:
+        g.stop()
+        srv.stop()

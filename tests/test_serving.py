@@ -207,14 +207,14 @@ def test_grpc_frontend_predict_and_errors():
     try:
         q = GrpcInputQueue(port=grpc_srv.port)
         x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
-        out = q.predict(x)
+        out = q.predict(x, batched=True)
         assert out.shape == (4, 3)
         # matches the direct model output
         direct = np.asarray(im.predict(x))
         np.testing.assert_allclose(out, direct, atol=1e-5)
         # wrong input rank surfaces as a serving error, not a hang
         with pytest.raises(RuntimeError, match="serving error"):
-            q.predict(np.zeros((2, 5), np.float32))
+            q.predict(np.zeros((2, 5), np.float32), batched=True)
         q.close()
     finally:
         grpc_srv.stop()
