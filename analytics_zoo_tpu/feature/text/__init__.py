@@ -1,6 +1,6 @@
 """Text pipeline: TextSet tokenize/normalize/index
 (reference: pyzoo/zoo/feature/text/)."""
 
-from analytics_zoo_tpu.feature.text.text_set import TextSet
+from analytics_zoo_tpu.feature.text.text_set import Relation, TextSet
 
-__all__ = ["TextSet"]
+__all__ = ["Relation", "TextSet"]

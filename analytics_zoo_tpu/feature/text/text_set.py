@@ -19,7 +19,7 @@ import json
 import os
 import re
 import string
-from collections import Counter
+from collections import Counter, defaultdict
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -28,6 +28,19 @@ from analytics_zoo_tpu.orca.data.shard import XShards
 
 _TOKEN_RE = re.compile(r"\s+")
 _PUNCT_TABLE = str.maketrans("", "", string.punctuation)
+
+
+class Relation:
+    """(id1, id2, label) — a query/doc relevance triple (reference
+    feature/common.py Relation)."""
+
+    __slots__ = ("id1", "id2", "label")
+
+    def __init__(self, id1, id2, label: int):
+        self.id1, self.id2, self.label = id1, id2, int(label)
+
+    def __repr__(self):
+        return f"Relation({self.id1!r}, {self.id2!r}, {self.label})"
 
 
 class TextSet:
@@ -228,10 +241,109 @@ class TextSet:
         return [TextSet(XShards([part]) if part else XShards([[]]),
                         self._word_index) for part in splits]
 
+    # -- relations (text matching, reference text_set.py:369-434) -------
+
+    @staticmethod
+    def from_relation_pairs(relations: Sequence["Relation"],
+                            corpus1: "TextSet", corpus2: "TextSet",
+                            num_shards: Optional[int] = None
+                            ) -> "TextSet":
+        """Build pairwise matching samples for ranking models (KNRM):
+        each relation (id1, id2, label) joins corpus1[id1]'s indices with
+        corpus2[id2]'s; record["indices"] is their concatenation, the
+        convention KNRM consumes ([query ids | doc ids]).  Both corpora
+        must be tokenized/indexed/shaped first."""
+        _check_shared_vocab(corpus1, corpus2)
+        idx1 = corpus1._by_uri()
+        idx2 = corpus2._by_uri()
+        records = []
+        for r in relations:
+            a = idx1.get(str(r.id1))
+            b = idx2.get(str(r.id2))
+            if a is None or b is None:
+                raise KeyError(
+                    f"relation ({r.id1}, {r.id2}) references unknown "
+                    "corpus uris")
+            records.append({
+                "uri": f"{r.id1}|{r.id2}",
+                "indices1": np.asarray(a["indices"], np.int32),
+                "indices2": np.asarray(b["indices"], np.int32),
+                "label": int(r.label),
+            })
+        ts = TextSet(XShards.from_records(records, num_shards),
+                     corpus1.get_word_index())
+        return ts
+
+    @staticmethod
+    def from_relation_lists(relations: Sequence["Relation"],
+                            corpus1: "TextSet", corpus2: "TextSet",
+                            num_shards: Optional[int] = None
+                            ) -> "TextSet":
+        """Grouped variant (reference :401): one record per id1 with all
+        its related id2 docs stacked — used for listwise evaluation
+        (NDCG/MAP over each query's candidate list).  Queries may have
+        DIFFERENT candidate counts; `to_dataset` pads them per shard
+        with a -1 label marking padding rows."""
+        _check_shared_vocab(corpus1, corpus2)
+        by_q = defaultdict(list)
+        for r in relations:
+            by_q[str(r.id1)].append(r)
+        idx1 = corpus1._by_uri()
+        idx2 = corpus2._by_uri()
+        records = []
+        for qid, rels in by_q.items():
+            q = idx1.get(qid)
+            if q is None:
+                raise KeyError(f"unknown corpus1 uri {qid}")
+            docs, labels = [], []
+            for r in rels:
+                d = idx2.get(str(r.id2))
+                if d is None:
+                    raise KeyError(f"unknown corpus2 uri {r.id2}")
+                docs.append(np.concatenate([
+                    np.asarray(q["indices"], np.int32),
+                    np.asarray(d["indices"], np.int32)]))
+                labels.append(int(r.label))
+            records.append({"uri": qid,
+                            "indices": np.stack(docs),
+                            "label": np.asarray(labels, np.int32)})
+        return TextSet(XShards.from_records(records, num_shards),
+                       corpus1.get_word_index())
+
+    def _by_uri(self) -> Dict[str, Dict]:
+        return {str(r["uri"]): r for s in self.shards.collect()
+                for r in s}
+
     def to_dataset(self) -> XShards:
-        """Lower to XShards of {"x": [n, len] int32, "y": labels} for
-        `Estimator.fit`."""
+        """Lower to XShards of {"x": ..., "y": labels} for
+        `Estimator.fit`.  Relation-pair records ("indices1"/"indices2")
+        emit x as the (query_ids, doc_ids) tuple text-matching models
+        consume; plain records emit one [n, len] array."""
         def pack(shard):
+            if shard and "indices1" in shard[0]:
+                xs = [np.stack([np.asarray(r["indices1"], np.int32)
+                                for r in shard]),
+                      np.stack([np.asarray(r["indices2"], np.int32)
+                                for r in shard])]
+                out = {"x": xs}
+                if "label" in shard[0]:
+                    out["y"] = np.asarray([r["label"] for r in shard])
+                return out
+            first = np.asarray(shard[0]["indices"]) if shard else None
+            if first is not None and first.ndim == 2:
+                # grouped (listwise) records: ragged candidate counts pad
+                # to the shard max; label -1 marks padding rows
+                n_max = max(np.asarray(r["indices"]).shape[0]
+                            for r in shard)
+                xs, ys = [], []
+                for r in shard:
+                    idx = np.asarray(r["indices"], np.int32)
+                    lab = np.asarray(r["label"], np.int32)
+                    pad = n_max - idx.shape[0]
+                    xs.append(np.pad(idx, ((0, pad), (0, 0))))
+                    ys.append(np.pad(lab, (0, pad),
+                                     constant_values=-1))
+                return {"x": np.stack(xs), "y": np.stack(ys)}
             xs = np.stack([np.asarray(r["indices"], np.int32)
                            for r in shard])
             out = {"x": xs}
@@ -239,3 +351,21 @@ class TextSet:
                 out["y"] = np.asarray([r["label"] for r in shard])
             return out
         return self.shards.transform_shard(pack)
+
+
+def _check_shared_vocab(corpus1: "TextSet", corpus2: "TextSet"):
+    """Both corpora must index with ONE vocabulary — separate id spaces
+    would silently gather garbage embeddings (JAX clamps out-of-range
+    ids).  Build corpus2 with word2idx(existing_map=corpus1_vocab)."""
+    v1, v2 = corpus1.get_word_index(), corpus2.get_word_index()
+    if v1 is None or v2 is None:
+        raise ValueError("tokenize+word2idx both corpora before "
+                         "building relations")
+    small, big = (v1, v2) if len(v1) <= len(v2) else (v2, v1)
+    # compatible = one vocabulary EXTENDS the other (the existing_map
+    # flow); anything else means two id spaces
+    if any(big.get(w) != i for w, i in small.items()):
+        raise ValueError(
+            "corpus1 and corpus2 use different word indices; build "
+            "corpus2 with word2idx(existing_map=corpus1."
+            "get_word_index())")

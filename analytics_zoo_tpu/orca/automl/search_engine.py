@@ -194,15 +194,6 @@ class SearchEngine:
 
     def _tpe_sample_config(self, good: List[Trial],
                            bad: List[Trial]) -> Dict[str, Any]:
-        from analytics_zoo_tpu.orca.automl.hp import (
-            Choice,
-            GridSearch,
-            LogUniform,
-            QUniform,
-            RandInt,
-            SampleSpace,
-        )
-
         def density(values, x, lo, hi):
             """Parzen estimate over observed numeric values."""
             if not values:
@@ -214,12 +205,12 @@ class SearchEngine:
 
         cfg = {}
         for key, space in self.search_space.items():
-            if not isinstance(space, SampleSpace):
+            if not isinstance(space, hp_mod.SampleSpace):
                 cfg[key] = space
                 continue
             g_vals = [t.config[key] for t in good]
             b_vals = [t.config[key] for t in bad]
-            if isinstance(space, (Choice, GridSearch)):
+            if isinstance(space, (hp_mod.Choice, hp_mod.GridSearch)):
                 cats = space.grid_values()
                 # categorical TPE: counts in the good set + uniform prior
                 weights = [1.0 + sum(1 for v in g_vals if v == c)
@@ -234,7 +225,7 @@ class SearchEngine:
                         cfg[key] = c
                         break
                 continue
-            log = isinstance(space, LogUniform)
+            log = isinstance(space, hp_mod.LogUniform)
             xform = math.log if log else (lambda v: v)
             g_obs = [xform(v) for v in g_vals]
             b_obs = [xform(v) for v in b_vals]
@@ -259,10 +250,10 @@ class SearchEngine:
             if log:
                 raw = min(max(raw, math.exp(space.log_lower)),
                           math.exp(space.log_upper))
-            elif isinstance(space, RandInt):
+            elif isinstance(space, hp_mod.RandInt):
                 raw = int(min(max(round(raw), space.lower),
                               space.upper - 1))
-            elif isinstance(space, QUniform):
+            elif isinstance(space, hp_mod.QUniform):
                 raw = round(raw / space.q) * space.q
                 raw = min(max(raw, space.lower), space.upper)
             else:
@@ -287,8 +278,7 @@ class SearchEngine:
                                                None]) -> Trial:
         alive = list(self.trials)
         budget = self.grace_epochs
-        from analytics_zoo_tpu.orca.automl.hp import GridSearch
-        grid_mode = any(isinstance(v, GridSearch)
+        grid_mode = any(isinstance(v, hp_mod.GridSearch)
                         for v in self.search_space.values())
         # grid mode compares like with like — TPE must not pollute it
         tpe_pending = (self.search_algorithm == "tpe" and not grid_mode

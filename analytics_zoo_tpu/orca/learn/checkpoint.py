@@ -74,7 +74,7 @@ def _lookup_path(tree, key_path):
                 else:
                     raise KeyError(
                         f"checkpoint missing sequence index {k.idx} "
-                        f"(has {sorted(node)[:8]})")
+                        f"(has {sorted(node, key=str)[:8]})")
             else:
                 node = node[k.idx]
         else:

@@ -365,3 +365,75 @@ def test_write_from_directory_and_voc(tmp_path):
     rec_boxes = blocks[0]["boxes"]
     assert rec_boxes.shape[-1] == 4
     assert blocks[0]["labels"].shape[-1] == 2  # cat, dog per image
+
+
+def test_textset_relations_feed_knrm():
+    """Relation pairs join two corpora into KNRM-convention samples
+    (reference text_set.py:369 from_relation_pairs; trains the text
+    matching model end to end)."""
+    from analytics_zoo_tpu.feature.text import Relation
+    from analytics_zoo_tpu.models.textmatching import KNRM
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    pos_words = ["alpha", "beta", "gamma", "delta"]
+    neg_words = ["one", "two", "three", "four"]
+    q_texts = [" ".join(rng.choice(pos_words, 3)) for _ in range(8)] + \
+              [" ".join(rng.choice(neg_words, 3)) for _ in range(8)]
+    d_texts = [" ".join(rng.choice(pos_words, 6)) for _ in range(8)] + \
+              [" ".join(rng.choice(neg_words, 6)) for _ in range(8)]
+    corpus_q = TextSet.from_texts(q_texts).tokenize().normalize() \
+        .word2idx().shape_sequence(len=4)
+    vocab = corpus_q.get_word_index()
+    corpus_d = TextSet.from_texts(d_texts).tokenize().normalize() \
+        .word2idx(existing_map=vocab).shape_sequence(len=8)
+
+    rels = []
+    for qi in range(16):
+        for di in (qi, (qi + 8) % 16):  # same-domain pos, cross neg
+            label = 1 if (qi < 8) == (di < 8) else 0
+            rels.append(Relation(str(qi), str(di), label))
+    paired = TextSet.from_relation_pairs(rels, corpus_q, corpus_d)
+    ds = paired.to_dataset()
+    blocks = ds.collect()
+    assert blocks[0]["x"][0].shape[1] == 4   # query ids
+    assert blocks[0]["x"][1].shape[1] == 8   # doc ids
+
+    model = KNRM(text1_length=4, text2_length=8,
+                 vocab_size=len(vocab) + 1, embed_dim=16,
+                 target_mode="classification")
+    est = Estimator.from_flax(model, loss=model.default_loss,
+                              optimizer="adam", learning_rate=1e-2)
+    est.fit(ds, epochs=15, batch_size=16)
+    stats = est.evaluate(ds, batch_size=16)
+    assert stats["loss"] < 0.5, stats
+
+    grouped = TextSet.from_relation_lists(rels, corpus_q, corpus_d)
+    recs = [r for s in grouped.shards.collect() for r in s]
+    assert all(r["indices"].shape == (2, 12) for r in recs)
+
+
+def test_relation_lists_ragged_and_vocab_guard():
+    from analytics_zoo_tpu.feature.text import Relation
+
+    init_orca_context(cluster_mode="local")
+    texts = ["a b", "c d", "e f", "g h"]
+    cq = TextSet.from_texts(texts).tokenize().word2idx() \
+        .shape_sequence(len=2)
+    cd = TextSet.from_texts(texts).tokenize().word2idx(
+        existing_map=cq.get_word_index()).shape_sequence(len=3)
+    # ragged: query 0 has two candidates, query 1 has one
+    rels = [Relation("0", "0", 1), Relation("0", "1", 0),
+            Relation("1", "2", 1)]
+    grouped = TextSet.from_relation_lists(rels, cq, cd, num_shards=1)
+    block = grouped.to_dataset().collect()[0]
+    assert block["x"].shape == (2, 2, 5)   # padded to 2 candidates
+    assert block["y"].shape == (2, 2)
+    assert block["y"][1, 1] == -1          # padding marked
+
+    # separate vocabularies are rejected, not silently mis-gathered
+    alien = TextSet.from_texts(["z y", "x w"]).tokenize().word2idx() \
+        .shape_sequence(len=3)
+    with pytest.raises(ValueError, match="word ind"):
+        TextSet.from_relation_pairs([Relation("0", "0", 1)], cq, alien)
