@@ -320,7 +320,11 @@ class TextSet:
         emit x as the (query_ids, doc_ids) tuple text-matching models
         consume; plain records emit one [n, len] array."""
         def pack(shard):
-            if shard and "indices1" in shard[0]:
+            if not shard:
+                raise ValueError(
+                    "cannot lower an empty TextSet shard to a dataset "
+                    "(no relations/records survived construction)")
+            if "indices1" in shard[0]:
                 xs = [np.stack([np.asarray(r["indices1"], np.int32)
                                 for r in shard]),
                       np.stack([np.asarray(r["indices2"], np.int32)

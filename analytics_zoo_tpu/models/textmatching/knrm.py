@@ -12,10 +12,11 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
+from analytics_zoo_tpu.models.common.ranker import Ranker
 from analytics_zoo_tpu.models.common.zoo_model import ZooModel
 
 
-class KNRM(nn.Module, ZooModel):
+class KNRM(nn.Module, ZooModel, Ranker):
     text1_length: int = 10          # query length
     text2_length: int = 40          # doc length
     vocab_size: int = 20000

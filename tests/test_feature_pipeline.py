@@ -437,3 +437,53 @@ def test_relation_lists_ragged_and_vocab_guard():
         .shape_sequence(len=3)
     with pytest.raises(ValueError, match="word ind"):
         TextSet.from_relation_pairs([Relation("0", "0", 1)], cq, alien)
+
+
+def test_knrm_ranker_ndcg_map():
+    """Ranker mixin (reference Ranker.scala evaluateNDCG/evaluateMAP):
+    a trained KNRM ranks relevant docs above irrelevant ones on the
+    grouped relation dataset."""
+    from analytics_zoo_tpu.feature.text import Relation
+    from analytics_zoo_tpu.models.common.ranker import (
+        mean_average_precision, ndcg_at_k)
+    from analytics_zoo_tpu.models.textmatching import KNRM
+
+    # exact metric math on a hand-built case
+    scores = np.array([[3.0, 2.0, 1.0], [1.0, 2.0, 3.0]])
+    labels = np.array([[1, 0, 0], [0, 0, 1]])
+    assert np.isclose(ndcg_at_k(scores, labels, k=1), 1.0)
+    assert np.isclose(mean_average_precision(scores, labels), 1.0)
+    # relevant item ranked second in query 0 -> AP 0.5
+    labels2 = np.array([[0, 1, 0], [0, 0, 1]])
+    assert np.isclose(mean_average_precision(scores, labels2),
+                      (0.5 + 1.0) / 2)
+    # padding rows (-1) are ignored
+    labels3 = np.array([[1, 0, -1], [0, 1, -1]])
+    assert 0.0 < ndcg_at_k(scores, labels3, k=2) <= 1.0
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    pos = ["alpha", "beta", "gamma", "delta"]
+    neg = ["one", "two", "three", "four"]
+    q_texts = [" ".join(rng.choice(pos, 3)) for _ in range(8)] + \
+              [" ".join(rng.choice(neg, 3)) for _ in range(8)]
+    d_texts = [" ".join(rng.choice(pos, 6)) for _ in range(8)] + \
+              [" ".join(rng.choice(neg, 6)) for _ in range(8)]
+    cq = TextSet.from_texts(q_texts).tokenize().normalize().word2idx() \
+        .shape_sequence(len=4)
+    cd = TextSet.from_texts(d_texts).tokenize().normalize().word2idx(
+        existing_map=cq.get_word_index()).shape_sequence(len=8)
+    rels = [Relation(str(qi), str(di), 1 if (qi < 8) == (di < 8) else 0)
+            for qi in range(16) for di in (qi, (qi + 8) % 16)]
+    paired = TextSet.from_relation_pairs(rels, cq, cd)
+
+    model = KNRM(text1_length=4, text2_length=8,
+                 vocab_size=len(cq.get_word_index()) + 1, embed_dim=16,
+                 target_mode="ranking")
+    est = model.estimator(learning_rate=1e-2)
+    est.fit(paired.to_dataset(), epochs=30, batch_size=16)
+    grouped = TextSet.from_relation_lists(rels, cq, cd)
+    ndcg = model.evaluate_ndcg(grouped.to_dataset(), k=1)
+    m = model.evaluate_map(grouped.to_dataset())
+    assert ndcg > 0.8, ndcg
+    assert m > 0.8, m
