@@ -19,9 +19,10 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+from analytics_zoo_tpu.models.recommendation.recommender import Recommender
 
 
-class NeuralCF(nn.Module, ZooModel):
+class NeuralCF(nn.Module, ZooModel, Recommender):
     user_count: int
     item_count: int
     class_num: int = 2

@@ -17,8 +17,10 @@ from typing import Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+from analytics_zoo_tpu.models.recommendation.recommender import Recommender
 
 
 class ColumnFeatureInfo:
@@ -54,7 +56,7 @@ class ColumnFeatureInfo:
                 + self.continuous_cols)
 
 
-class WideAndDeep(nn.Module, ZooModel):
+class WideAndDeep(nn.Module, ZooModel, Recommender):
     """Input: ONE array [batch, n_features] whose columns are ordered
     exactly as `column_info.feature_cols`: wide_base, wide_cross,
     indicator, embed (all categorical ids), then continuous floats."""
@@ -64,6 +66,15 @@ class WideAndDeep(nn.Module, ZooModel):
     hidden_layers: Sequence[int] = (40, 20, 10)
     model_type: str = "wide_n_deep"  # "wide" | "deep" | "wide_n_deep"
     compute_dtype: jnp.dtype = jnp.bfloat16
+
+    def _pair_features(self, users, items, feats):
+        # Recommender ranking input: the stacked per-pair feature rows
+        # (built by rows_to_features), not bare ids
+        if feats is None:
+            raise ValueError(
+                "WideAndDeep ranking needs per-pair feature rows; build "
+                "them with rows_to_features/to_user_item_feature")
+        return [np.asarray(feats, np.float32)]
 
     @nn.compact
     def __call__(self, features, training: bool = False):

@@ -1,0 +1,200 @@
+"""Recommendation feature utils + Recommender ranking surface
+(reference pyzoo/zoo/models/recommendation/{utils,recommender}.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.models.recommendation import (
+    ColumnFeatureInfo,
+    NeuralCF,
+    UserItemFeature,
+    WideAndDeep,
+    categorical_from_vocab_list,
+    get_boundaries,
+    get_deep_tensors,
+    get_negative_samples,
+    get_wide_indices,
+    hash_bucket,
+    rows_to_features,
+    to_user_item_feature,
+)
+
+
+def test_hash_bucket_stable_and_vectorized():
+    a = hash_bucket("abc", bucket_size=100, start=5)
+    assert 5 <= a < 105
+    assert a == hash_bucket("abc", bucket_size=100, start=5)
+    arr = hash_bucket(["abc", "def", "abc"], bucket_size=100)
+    assert arr.shape == (3,) and arr[0] == arr[2]
+    assert (arr >= 0).all() and (arr < 100).all()
+
+
+def test_categorical_and_boundaries():
+    vocab = ["a", "b", "c"]
+    assert categorical_from_vocab_list("b", vocab, start=1) == 2
+    assert categorical_from_vocab_list("z", vocab, default=-1) == -1
+    np.testing.assert_array_equal(
+        categorical_from_vocab_list(["c", "z"], vocab, start=1),
+        [3, 0])
+
+    bnds = [18, 25, 35]
+    assert get_boundaries(17, bnds) == 0
+    assert get_boundaries(25, bnds) == 2  # right-closed like the ref loop
+    assert get_boundaries(99, bnds) == 3
+    assert get_boundaries("?", bnds, default=-1, start=1) == 0
+    np.testing.assert_array_equal(
+        get_boundaries(pd.Series([17, 99, "?"]), bnds), [0, 3, -1])
+
+
+def test_negative_samples_avoid_positives():
+    df = pd.DataFrame({"userId": [1, 1, 2], "itemId": [1, 2, 1],
+                       "label": [5, 4, 3]})
+    neg = get_negative_samples(df, neg_num=2, item_count=50, seed=1)
+    assert len(neg) == 6
+    assert (neg["label"] == 1).all()
+    pos = set(zip(df.userId, df.itemId))
+    assert not any((u, i) in pos for u, i in zip(neg.userId, neg.itemId))
+
+
+def _ci():
+    return ColumnFeatureInfo(
+        wide_base_cols=["gender", "age_bucket"],
+        wide_base_dims=[2, 4],
+        wide_cross_cols=["gender_x_age"],
+        wide_cross_dims=[8],
+        indicator_cols=["occupation"],
+        indicator_dims=[3],
+        embed_cols=["userId", "itemId"],
+        embed_in_dims=[20, 30],
+        embed_out_dims=[8, 8],
+        continuous_cols=["hours"])
+
+
+def _rows(n=12, seed=0):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "userId": rng.integers(0, 20, n),
+        "itemId": rng.integers(0, 30, n),
+        "gender": rng.integers(0, 2, n),
+        "age_bucket": rng.integers(0, 4, n),
+        "gender_x_age": rng.integers(0, 8, n),
+        "occupation": rng.integers(0, 3, n),
+        "hours": rng.random(n).astype(np.float32),
+        "label": rng.integers(1, 3, n),
+    })
+    return df
+
+
+def test_wide_indices_offsets():
+    ci, df = _ci(), _rows(4)
+    idx = get_wide_indices(df, ci)
+    assert idx.shape == (4, 3)
+    # column 1 offset by dim of column 0 (2), column 2 by 2+4
+    np.testing.assert_array_equal(idx[:, 0], df.gender)
+    np.testing.assert_array_equal(idx[:, 1], df.age_bucket + 2)
+    np.testing.assert_array_equal(idx[:, 2], df.gender_x_age + 6)
+    # single-row Series path
+    one = get_wide_indices(df.iloc[0], ci)
+    np.testing.assert_array_equal(one, idx[0])
+
+
+def test_deep_tensors_and_features_matrix():
+    ci, df = _ci(), _rows(6)
+    parts = get_deep_tensors(df, ci)
+    assert parts[0].shape == (6, 3)          # indicator multi-hot
+    assert (parts[0].sum(axis=1) == 1).all()
+    assert parts[1].shape == (6, 2) and parts[2].shape == (6, 1)
+
+    feats = rows_to_features(df, ci)
+    assert feats.shape == (6, len(ci.feature_cols))
+
+    uif = to_user_item_feature(df.iloc[0], ci)
+    assert isinstance(uif, UserItemFeature)
+    assert uif.sample.shape == (len(ci.feature_cols),)
+    assert uif.label in (0, 1)
+
+
+def test_ncf_recommend_for_user_and_item():
+    model = NeuralCF(user_count=20, item_count=30, class_num=2,
+                     user_embed=8, item_embed=8, hidden_layers=(16,),
+                     mf_embed=4)
+    pairs = [UserItemFeature(u, i, None)
+             for u in range(1, 6) for i in range(1, 8)]
+    preds = model.predict_user_item_pair(pairs)
+    assert len(preds) == 35
+    assert all(p.prediction in (1, 2) for p in preds)
+    assert all(0.0 <= p.probability <= 1.0 for p in preds)
+
+    top = model.recommend_for_user(pairs, max_items=3)
+    per_user = {}
+    for p in top:
+        per_user.setdefault(p.user_id, []).append(
+            (p.prediction, p.probability))
+    assert set(per_user) == set(range(1, 6))
+    assert all(len(v) == 3 for v in per_user.values())
+    # ranked by rating first, then confidence
+    assert all(v == sorted(v, reverse=True) for v in per_user.values())
+
+    by_item = model.recommend_for_item(pairs, max_users=2)
+    per_item = {}
+    for p in by_item:
+        per_item.setdefault(p.item_id, []).append(p.user_id)
+    assert all(len(v) == 2 for v in per_item.values())
+
+
+def test_wide_and_deep_ranking_needs_features():
+    ci = _ci()
+    model = WideAndDeep(column_info=ci, class_num=2, hidden_layers=(8,))
+    with pytest.raises(ValueError, match="feature rows"):
+        model.predict_user_item_pair(
+            [UserItemFeature(1, 2, None)])
+    df = _rows(8, seed=3)
+    pairs = [to_user_item_feature(r, ci) for _, r in df.iterrows()]
+    preds = model.predict_user_item_pair(pairs)
+    assert len(preds) == 8
+    top = model.recommend_for_user(pairs, max_items=2)
+    assert all(p.probability <= 1.0 for p in top)
+
+
+def test_negative_samples_dense_user_drops_not_mislabels():
+    # user 1 rated the whole 5-item catalog: no valid negatives exist
+    df = pd.DataFrame({"userId": [1] * 5, "itemId": [1, 2, 3, 4, 5],
+                       "label": [5] * 5})
+    with pytest.warns(UserWarning, match="dropped"):
+        neg = get_negative_samples(df, neg_num=1, item_count=5)
+    assert len(neg) == 0
+
+
+def test_empty_pairs_returns_empty():
+    model = NeuralCF(user_count=5, item_count=5, class_num=2,
+                     user_embed=4, item_embed=4, hidden_layers=(8,),
+                     mf_embed=2)
+    assert model.predict_user_item_pair([]) == []
+    assert model.recommend_for_user([], max_items=3) == []
+
+
+def test_rows_to_features_rejects_unrepresentable_ids():
+    ci = ColumnFeatureInfo(embed_cols=["userId"],
+                           embed_in_dims=[2 ** 25],
+                           embed_out_dims=[4],
+                           continuous_cols=["hours"])
+    df = pd.DataFrame({"userId": [2 ** 24 + 1], "hours": [0.5]})
+    with pytest.raises(ValueError, match="2\\*\\*24"):
+        rows_to_features(df, ci, model_type="deep")
+
+
+def test_rank_prefers_predicted_positive_over_confident_negative():
+    class _Fixed(NeuralCF):
+        # item 1 → confidently negative, item 2 → moderately positive
+        def predict(self, data, **kw):
+            items = np.asarray(data["x"][1])
+            return np.where(items[:, None] == 1,
+                            np.array([[3.0, 0.0]]), np.array([[0.0, 0.85]]))
+
+    model = _Fixed(user_count=5, item_count=5, class_num=2,
+                   user_embed=4, item_embed=4, hidden_layers=(8,),
+                   mf_embed=2)
+    pairs = [UserItemFeature(1, 1, None), UserItemFeature(1, 2, None)]
+    top = model.recommend_for_user(pairs, max_items=1)
+    assert len(top) == 1 and top[0].item_id == 2 and top[0].prediction == 2
