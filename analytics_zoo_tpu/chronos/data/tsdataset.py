@@ -46,6 +46,49 @@ _ROLLING_SETTINGS = {
 }
 
 
+def _global_stats(v: np.ndarray, settings: str) -> dict:
+    """Per-series global statistics (the reference's tsfresh
+    extract_features; tsfresh isn't in the image so the standard
+    aggregate families are built in, vectorized numpy)."""
+    out = {
+        "mean": float(np.mean(v)), "std": float(np.std(v)),
+        "min": float(np.min(v)), "max": float(np.max(v)),
+        "median": float(np.median(v)), "length": float(v.size),
+    }
+    if settings == "minimal":
+        return out
+    d = np.diff(v) if v.size > 1 else np.zeros(1)
+    out.update({
+        "sum": float(np.sum(v)),
+        "abs_energy": float(np.dot(v, v)),
+        "mean_abs_change": float(np.mean(np.abs(d))),
+        "mean_change": float(np.mean(d)),
+        "count_above_mean": float(np.sum(v > v.mean())),
+        "count_below_mean": float(np.sum(v < v.mean())),
+        "last_location_of_maximum": float(
+            1.0 - np.argmax(v[::-1]) / v.size),
+        "first_location_of_maximum": float(np.argmax(v) / v.size),
+    })
+    if settings == "efficient":
+        return out
+    # comprehensive: distribution shape + trend + autocorrelation
+    sd = out["std"]
+    c = v - v.mean()
+    out.update({
+        "skewness": float(np.mean(c ** 3) / sd ** 3) if sd > 0 else 0.0,
+        "kurtosis": float(np.mean(c ** 4) / sd ** 4 - 3.0)
+        if sd > 0 else 0.0,
+        "autocorr_lag1": float(np.dot(c[:-1], c[1:])
+                               / (np.dot(c, c) or 1.0))
+        if v.size > 1 else 0.0,
+        "linear_trend_slope": float(np.polyfit(
+            np.arange(v.size), v, 1)[0]) if v.size > 1 else 0.0,
+        "quantile_25": float(np.quantile(v, 0.25)),
+        "quantile_75": float(np.quantile(v, 0.75)),
+    })
+    return out
+
+
 def _as_list(x) -> List[str]:
     if x is None:
         return []
@@ -108,6 +151,25 @@ class TSDataset:
             frames = [p.reset_index(drop=True) for p in split_one(df)]
         return tuple(TSDataset(f, dt_col, target_col, id_col, feature_col)
                      for f in frames)
+
+    @staticmethod
+    def from_parquet(path: str, dt_col: str,
+                     target_col: Union[str, Sequence[str]],
+                     id_col: Optional[str] = None,
+                     extra_feature_col: Union[str, Sequence[str],
+                                              None] = None,
+                     with_split: bool = False, val_ratio: float = 0,
+                     test_ratio: float = 0.1, columns=None):
+        """Build a TSDataset from a parquet file/dir (reference
+        tsdataset.py:163), reading only the needed columns."""
+        if columns is None:
+            columns = ([dt_col] + _as_list(target_col)
+                       + (_as_list(id_col)) + _as_list(extra_feature_col))
+        df = pd.read_parquet(path, columns=columns)
+        return TSDataset.from_pandas(
+            df, dt_col, target_col, id_col=id_col,
+            extra_feature_col=extra_feature_col, with_split=with_split,
+            val_ratio=val_ratio, test_ratio=test_ratio)
 
     def _groups(self):
         if self.id_col:
@@ -230,6 +292,34 @@ class TSDataset:
                     self.feature_col.append(col)
         return self
 
+    def gen_global_feature(self, settings: str = "comprehensive"):
+        """Append per-series global statistics of each target column,
+        broadcast to every row of that series (reference
+        gen_global_feature, tsdataset.py:358 — tsfresh-backed there;
+        built-in numpy aggregate families here).  `settings`: "minimal" |
+        "efficient" | "comprehensive" (growing stat sets)."""
+        if settings not in ("minimal", "efficient", "comprehensive"):
+            raise ValueError(
+                f"settings must be minimal/efficient/comprehensive, "
+                f"got '{settings}'")
+        new_cols = set()
+
+        def _one(g):
+            for c in self.target_col:
+                stats = _global_stats(
+                    g[c].to_numpy(np.float64), settings)
+                for name, val in stats.items():
+                    col = f"{c}__{name}"
+                    g[col] = val
+                    new_cols.add(col)
+            return g
+
+        self._apply_per_group(_one)
+        for col in sorted(new_cols):
+            if col not in self.feature_col:
+                self.feature_col.append(col)
+        return self
+
     # ------------------------------------------------------------------
     # scaling (reference tsdataset.py:467)
     # ------------------------------------------------------------------
@@ -314,6 +404,38 @@ class TSDataset:
 
     def to_pandas(self) -> pd.DataFrame:
         return self.df.copy()
+
+    def to_loader(self, batch_size: int = 32, *, roll: bool = False,
+                  lookback: Optional[int] = None,
+                  horizon: Union[int, Sequence[int], None] = None,
+                  shuffle: bool = True, seed: int = 0,
+                  drop_last: bool = False):
+        """Batch iterator over the rolled windows (the reference's
+        to_torch_data_loader, tsdataset.py:596, minus torch — yields
+        (x, y) numpy batches ready for Estimator/forecaster trainers).
+
+        With `roll=True`, rolls with the given lookback/horizon first."""
+        if roll:
+            if lookback is None or horizon is None:
+                raise ValueError(
+                    "roll=True needs lookback= and horizon=")
+            self.roll(lookback, horizon)
+        if self.numpy_x is None:
+            raise RuntimeError(
+                "call roll(lookback, horizon) first, or pass roll=True "
+                "with lookback/horizon")
+        x, y = self.numpy_x, self.numpy_y
+
+        def _iter():
+            n = len(x)
+            order = np.arange(n)
+            if shuffle:
+                np.random.default_rng(seed).shuffle(order)
+            stop = (n - n % batch_size) if drop_last else n
+            for lo in range(0, stop, batch_size):
+                sel = order[lo:lo + batch_size]
+                yield (x[sel], y[sel] if y is not None else None)
+        return _iter()
 
     # convenience accessors used by forecasters
     @property

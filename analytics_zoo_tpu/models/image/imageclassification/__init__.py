@@ -4,3 +4,18 @@ from analytics_zoo_tpu.models.image.imageclassification.resnet import (  # noqa:
     ResNet50,
     ImageClassifier,
 )
+from analytics_zoo_tpu.models.image.imageclassification.inception import (  # noqa: F401,E501
+    InceptionV1,
+)
+from analytics_zoo_tpu.models.image.imageclassification.mobilenet import (  # noqa: F401,E501
+    MobileNetV2,
+)
+from analytics_zoo_tpu.models.image.imageclassification.vgg import (  # noqa: F401,E501
+    VGG16,
+)
+
+ImageClassifier.BACKBONES.update({
+    "inception-v1": InceptionV1,
+    "mobilenet-v2": MobileNetV2,
+    "vgg-16": VGG16,
+})

@@ -1,0 +1,102 @@
+"""Inception-v1 (GoogLeNet) — the reference's headline training-scaling
+benchmark model (BigDL whitepaper `docs/docs/wp-bigdl.md:160-164`:
+"ImageNet Inception-v1 ... scales almost linear up to 128 nodes"; BigDL
+nets are loaded via `models/image/imageclassification/` in the reference).
+
+TPU-first: NHWC, bf16 convs on the MXU, f32 BatchNorm (the original used
+LRN; BN is the standard modern substitute and what BigDL's
+Inception_v1_NoAuxClassifier variants train with), branch concat on the
+channel (last, lane-aligned) axis.  `width` scales all channel counts for
+tiny-test configs."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+
+
+def _scaled(c: int, width: float) -> int:
+    return max(1, int(round(c * width)))
+
+
+class InceptionBlock(nn.Module):
+    """Four parallel branches concatenated channelwise:
+    1x1 | 1x1→3x3 | 1x1→5x5 | maxpool→1x1."""
+
+    c1: int
+    c3r: int
+    c3: int
+    c5r: int
+    c5: int
+    cp: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        def conv_bn(y, ch, kernel, name):
+            y = nn.Conv(ch, kernel, padding="SAME", use_bias=False,
+                        dtype=self.dtype, name=name)(y)
+            y = nn.BatchNorm(use_running_average=not training,
+                             dtype=jnp.float32, name=f"{name}_bn")(y)
+            return nn.relu(y)
+
+        b1 = conv_bn(x, self.c1, (1, 1), "b1")
+        b3 = conv_bn(x, self.c3r, (1, 1), "b3_reduce")
+        b3 = conv_bn(b3, self.c3, (3, 3), "b3")
+        b5 = conv_bn(x, self.c5r, (1, 1), "b5_reduce")
+        b5 = conv_bn(b5, self.c5, (5, 5), "b5")
+        bp = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = conv_bn(bp, self.cp, (1, 1), "bpool")
+        return jnp.concatenate([b1, b3, b5, bp], axis=-1)
+
+
+#: (c1, c3r, c3, c5r, c5, cp) per block, grouped by stage
+_V1_BLOCKS = {
+    "3a": (64, 96, 128, 16, 32, 32),
+    "3b": (128, 128, 192, 32, 96, 64),
+    "4a": (192, 96, 208, 16, 48, 64),
+    "4b": (160, 112, 224, 24, 64, 64),
+    "4c": (128, 128, 256, 24, 64, 64),
+    "4d": (112, 144, 288, 32, 64, 64),
+    "4e": (256, 160, 320, 32, 128, 128),
+    "5a": (256, 160, 320, 32, 128, 128),
+    "5b": (384, 192, 384, 48, 128, 128),
+}
+
+
+class InceptionV1(nn.Module, ZooModel):
+    num_classes: int = 1000
+    width: float = 1.0
+    dropout: float = 0.4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        def conv_bn(y, ch, kernel, strides, name):
+            y = nn.Conv(_scaled(ch, self.width), kernel, strides,
+                        padding="SAME", use_bias=False, dtype=self.dtype,
+                        name=name)(y)
+            y = nn.BatchNorm(use_running_average=not training,
+                             dtype=jnp.float32, name=f"{name}_bn")(y)
+            return nn.relu(y)
+
+        x = conv_bn(x, 64, (7, 7), (2, 2), "stem1")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = conv_bn(x, 64, (1, 1), (1, 1), "stem2_reduce")
+        x = conv_bn(x, 192, (3, 3), (1, 1), "stem2")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for name, cfg in _V1_BLOCKS.items():
+            scaled: Tuple[int, ...] = tuple(
+                _scaled(c, self.width) for c in cfg)
+            x = InceptionBlock(*scaled, dtype=self.dtype,
+                               name=f"inception_{name}")(x, training)
+            if name in ("3b", "4e"):
+                x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = x.mean(axis=(1, 2)).astype(jnp.float32)
+        x = nn.Dropout(self.dropout, deterministic=not training)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x)

@@ -1,0 +1,91 @@
+"""MobileNetV2 (reference loads MobileNet ImageNet nets through BigDL's
+model zoo, `models/image/imageclassification/`).
+
+TPU-first: NHWC; the depthwise 3x3 runs as a grouped conv
+(`feature_group_count = channels`) which Mosaic/XLA lowers to the VPU,
+while the 1x1 expand/project matmuls carry the FLOPs on the MXU in bf16.
+ReLU6 + linear bottlenecks per the paper; f32 BatchNorm."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    out = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if out < 0.9 * v:  # never round down more than 10%
+        out += divisor
+    return out
+
+
+class InvertedResidual(nn.Module):
+    filters: int
+    strides: int = 1
+    expand: int = 6
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        def bn(y, name):
+            return nn.BatchNorm(use_running_average=not training,
+                                dtype=jnp.float32, name=name)(y)
+
+        inp = x.shape[-1]
+        hidden = inp * self.expand
+        y = x
+        if self.expand != 1:
+            y = nn.Conv(hidden, (1, 1), use_bias=False, dtype=self.dtype,
+                        name="expand")(y)
+            y = jnp.clip(bn(y, "expand_bn"), 0.0, 6.0)
+        y = nn.Conv(hidden, (3, 3), (self.strides, self.strides),
+                    padding="SAME", feature_group_count=hidden,
+                    use_bias=False, dtype=self.dtype, name="depthwise")(y)
+        y = jnp.clip(bn(y, "depthwise_bn"), 0.0, 6.0)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="project")(y)
+        y = bn(y, "project_bn")  # linear bottleneck: no activation
+        if self.strides == 1 and inp == self.filters:
+            y = y + x
+        return y
+
+
+#: (expand, channels, repeats, first-stride)
+_V2_STAGES = ((1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+              (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+              (6, 320, 1, 1))
+
+
+class MobileNetV2(nn.Module, ZooModel):
+    num_classes: int = 1000
+    width: float = 1.0
+    dropout: float = 0.2
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        def bn(y, name):
+            return nn.BatchNorm(use_running_average=not training,
+                                dtype=jnp.float32, name=name)(y)
+
+        first = _make_divisible(32 * self.width)
+        x = nn.Conv(first, (3, 3), (2, 2), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="stem")(x)
+        x = jnp.clip(bn(x, "stem_bn"), 0.0, 6.0)
+        for si, (t, c, n, s) in enumerate(_V2_STAGES):
+            ch = _make_divisible(c * self.width)
+            for j in range(n):
+                x = InvertedResidual(
+                    ch, strides=s if j == 0 else 1, expand=t,
+                    dtype=self.dtype, name=f"stage{si}_block{j}")(
+                        x, training)
+        last = _make_divisible(1280 * max(1.0, self.width))
+        x = nn.Conv(last, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="head_conv")(x)
+        x = jnp.clip(bn(x, "head_bn"), 0.0, 6.0)
+        x = x.mean(axis=(1, 2)).astype(jnp.float32)
+        x = nn.Dropout(self.dropout, deterministic=not training)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x)

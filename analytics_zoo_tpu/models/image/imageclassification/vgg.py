@@ -1,0 +1,50 @@
+"""VGG-16 (reference loads VGG ImageNet nets through BigDL's model zoo,
+`models/image/imageclassification/`).
+
+TPU-first: NHWC, bf16 3x3 convs (large dense matmul-like convs — MXU
+food), f32 head.  `width` scales channels and `fc_dim` the classifier so
+tiny-test configs stay cheap; BatchNorm replaces the original's
+biases-only training recipe for stability at bf16."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+
+#: channels per conv, "M" = 2x2 maxpool (VGG-16 configuration D)
+_VGG16 = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+          512, 512, 512, "M", 512, 512, 512, "M")
+
+
+class VGG16(nn.Module, ZooModel):
+    num_classes: int = 1000
+    width: float = 1.0
+    fc_dim: int = 4096
+    dropout: float = 0.5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        conv_i = 0
+        for spec in _VGG16:
+            if spec == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+                continue
+            ch = max(1, int(round(spec * self.width)))
+            x = nn.Conv(ch, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype, name=f"conv{conv_i}")(x)
+            x = nn.BatchNorm(use_running_average=not training,
+                             dtype=jnp.float32,
+                             name=f"bn{conv_i}")(x)
+            x = nn.relu(x)
+            conv_i += 1
+        x = x.reshape(x.shape[0], -1).astype(self.dtype)
+        for j in range(2):
+            x = nn.relu(nn.Dense(self.fc_dim, dtype=self.dtype,
+                                 name=f"fc{j}")(x))
+            x = nn.Dropout(self.dropout,
+                           deterministic=not training)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))

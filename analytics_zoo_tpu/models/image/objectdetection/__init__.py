@@ -1,6 +1,10 @@
 from analytics_zoo_tpu.models.image.objectdetection.ssd import (
     SSDDetector,
 )
+from analytics_zoo_tpu.models.image.objectdetection.faster_rcnn import (
+    FasterRCNNDetector,
+    roi_align,
+)
 from analytics_zoo_tpu.models.image.objectdetection.box_utils import (
     decode_boxes,
     encode_boxes,
@@ -9,5 +13,6 @@ from analytics_zoo_tpu.models.image.objectdetection.box_utils import (
     nms,
 )
 
-__all__ = ["SSDDetector", "generate_anchors", "iou_matrix",
-           "encode_boxes", "decode_boxes", "nms"]
+__all__ = ["SSDDetector", "FasterRCNNDetector", "roi_align",
+           "generate_anchors", "iou_matrix", "encode_boxes",
+           "decode_boxes", "nms"]

@@ -80,6 +80,23 @@ def decode_boxes(deltas, anchors_xyxy):
     return jnp.concatenate([c - wh / 2, c + wh / 2], axis=-1)
 
 
+def pad_ground_truth(boxes_list: Sequence[np.ndarray],
+                     labels_list: Sequence[np.ndarray],
+                     max_boxes: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad per-image variable GT to static [n, max_boxes, ...] (labels
+    0 = padding) — the static-shape GT convention both detectors train
+    on."""
+    n = len(boxes_list)
+    boxes = np.zeros((n, max_boxes, 4), np.float32)
+    labels = np.zeros((n, max_boxes), np.int32)
+    for i, (bx, lb) in enumerate(zip(boxes_list, labels_list)):
+        k = min(len(lb), max_boxes)
+        if k:
+            boxes[i, :k] = bx[:k]
+            labels[i, :k] = lb[:k]
+    return boxes, labels
+
+
 def nms(boxes: np.ndarray, scores: np.ndarray,
         iou_threshold: float = 0.45, max_det: int = 100
         ) -> List[int]:

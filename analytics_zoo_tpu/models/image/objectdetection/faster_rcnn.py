@@ -1,0 +1,296 @@
+"""Faster-RCNN-style two-stage object detection (reference: the scala
+object-detection family `zoo/src/main/scala/.../models/image/
+objectdetection/` ships both SSD and Faster-RCNN pipelines; python
+surface `pyzoo/zoo/models/image/objectdetection/object_detector.py`).
+
+TPU-native two-stage design — every stage static-shaped and jittable:
+* Backbone → single stride-8 feature map (NHWC, bf16 convs).
+* RPN head emits objectness + deltas over a static anchor grid; the
+  proposal stage picks a FIXED `num_proposals` via `jax.lax.top_k`
+  (no dynamic-shape NMS inside jit — score-ranked proposals are the
+  XLA-friendly equivalent; box NMS runs host-side at detect()).
+* ROIAlign: bilinear sampling of a static PxP grid per proposal,
+  vmapped over proposals and batch — pure gathers, MXU-friendly head.
+* Both stages train jointly in ONE jitted step: RPN binary
+  objectness/box loss on anchors + ROI-head class/box loss on
+  (stop-gradient) proposals, matched to padded GT by IoU — same padded
+  static-GT convention as SSD's multibox_loss.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.common.zoo_model import ZooModel
+from analytics_zoo_tpu.models.image.objectdetection.box_utils import (
+    decode_boxes,
+    encode_boxes,
+    iou_matrix,
+    nms,
+    pad_ground_truth,
+)
+
+
+def roi_align(feat: jnp.ndarray, boxes: jnp.ndarray, pool: int
+              ) -> jnp.ndarray:
+    """Bilinear ROIAlign.  feat [H, W, C], boxes [K, 4] normalized
+    xyxy → [K, pool, pool, C].  Static shapes; pure gathers."""
+    h, w = feat.shape[0], feat.shape[1]
+    x0, y0, x1, y1 = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
+    # sample centers of a pool x pool grid inside each box
+    steps = (jnp.arange(pool, dtype=jnp.float32) + 0.5) / pool  # [P]
+    ys = (y0[:, None] + steps[None, :] * (y1 - y0)[:, None]) * h - 0.5
+    xs = (x0[:, None] + steps[None, :] * (x1 - x0)[:, None]) * w - 0.5
+
+    def bilinear(yy, xx):  # yy [K, P], xx [K, P] → [K, P, P, C]
+        yy = jnp.clip(yy, 0.0, h - 1.0)
+        xx = jnp.clip(xx, 0.0, w - 1.0)
+        yf, xf = jnp.floor(yy), jnp.floor(xx)
+        yi0 = yf.astype(jnp.int32)
+        xi0 = xf.astype(jnp.int32)
+        yi1 = jnp.minimum(yi0 + 1, h - 1)
+        xi1 = jnp.minimum(xi0 + 1, w - 1)
+        wy = (yy - yf)[:, :, None, None]      # [K, P, 1, 1]
+        wx = (xx - xf)[:, None, :, None]      # [K, 1, P, 1]
+
+        def g(yi, xi):                        # → [K, P, P, C]
+            return feat[yi[:, :, None], xi[:, None, :]]
+
+        return ((1 - wy) * (1 - wx) * g(yi0, xi0)
+                + (1 - wy) * wx * g(yi0, xi1)
+                + wy * (1 - wx) * g(yi1, xi0)
+                + wy * wx * g(yi1, xi1))
+
+    return bilinear(ys, xs)
+
+
+class _FasterRCNNNet(nn.Module):
+    num_classes: int              # foreground classes; background = 0
+    n_anchors_per_cell: int
+    num_proposals: int
+    pool_size: int
+    anchors: Tuple[Tuple[float, float, float, float], ...]
+    channels: Sequence[int] = (16, 32, 64)
+    head_dim: int = 128
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = x.astype(self.compute_dtype)
+        for i, ch in enumerate(self.channels):
+            x = nn.relu(nn.Conv(ch, (3, 3), strides=2, padding="SAME",
+                                dtype=self.compute_dtype,
+                                name=f"conv{i}")(x))
+        feat = x                                       # [b, H, W, C]
+        b = feat.shape[0]
+        k = self.n_anchors_per_cell
+
+        # ---- stage 1: RPN over the static anchor grid ----
+        rpn = nn.relu(nn.Conv(self.head_dim, (3, 3), padding="SAME",
+                              dtype=self.compute_dtype, name="rpn")(feat))
+        obj = nn.Conv(k, (1, 1), dtype=jnp.float32,
+                      name="rpn_obj")(rpn).reshape(b, -1)      # [b, N]
+        rpn_deltas = nn.Conv(k * 4, (1, 1), dtype=jnp.float32,
+                             name="rpn_box")(rpn).reshape(b, -1, 4)
+
+        anchors = jnp.asarray(self.anchors, jnp.float32)       # [N, 4]
+        # top `num_proposals` anchors by objectness — the static-shape
+        # stand-in for NMS proposal selection
+        _, top_idx = jax.lax.top_k(obj, self.num_proposals)    # [b, P]
+        sel_deltas = jnp.take_along_axis(
+            rpn_deltas, top_idx[:, :, None], axis=1)
+        sel_anchors = anchors[top_idx]                         # [b, P, 4]
+        proposals = jax.vmap(decode_boxes)(sel_deltas, sel_anchors)
+        proposals = jnp.clip(proposals, 0.0, 1.0)
+        # clamp to a minimum size: a proposal clipped to zero area would
+        # put a_wh=0 into encode_boxes (inf/NaN targets whose masked
+        # smooth-L1 still NaNs the backward pass)
+        lo = jnp.minimum(proposals[..., :2], 1.0 - 1e-3)
+        hi = jnp.maximum(proposals[..., 2:], lo + 1e-3)
+        proposals = jnp.concatenate([lo, hi], axis=-1)
+        # the ROI head refines proposals; it must not backprop into the
+        # RPN through the box coordinates (standard two-stage practice)
+        proposals = jax.lax.stop_gradient(proposals)
+
+        # ---- stage 2: ROIAlign + detection head ----
+        pooled = jax.vmap(roi_align, in_axes=(0, 0, None))(
+            feat.astype(jnp.float32), proposals, self.pool_size)
+        pooled = pooled.reshape(b, self.num_proposals, -1).astype(
+            self.compute_dtype)
+        hdn = nn.relu(nn.Dense(self.head_dim, dtype=self.compute_dtype,
+                               name="roi_fc1")(pooled))
+        hdn = nn.relu(nn.Dense(self.head_dim, dtype=self.compute_dtype,
+                               name="roi_fc2")(hdn))
+        roi_cls = nn.Dense(self.num_classes + 1, dtype=jnp.float32,
+                           name="roi_cls")(hdn)       # [b, P, C+1]
+        roi_deltas = nn.Dense(4, dtype=jnp.float32,
+                              name="roi_box")(hdn)    # [b, P, 4]
+        return obj, rpn_deltas, proposals, roi_cls, roi_deltas
+
+
+def faster_rcnn_loss(anchors: jnp.ndarray, rpn_pos_iou: float = 0.5,
+                     rpn_neg_iou: float = 0.3, roi_pos_iou: float = 0.5):
+    """Joint two-stage loss for the engine. labels = (gt_boxes
+    [b, M, 4] normalized xyxy, gt_labels [b, M] 1-based, 0 = pad)."""
+
+    def per_example(obj, rpn_deltas, proposals, roi_cls, roi_deltas,
+                    gt_boxes, gt_labels):
+        valid = gt_labels > 0
+        n_gt = jnp.maximum(valid.sum(), 1)
+
+        # ---- RPN: binary objectness + box regression on anchors ----
+        iou = jnp.where(valid[None, :],
+                        iou_matrix(anchors, gt_boxes), -1.0)   # [N, M]
+        best_iou = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        # force-match each valid gt's best anchor (sentinel slot for pads)
+        n_anchors = anchors.shape[0]
+        best_anchor = jnp.where(valid, jnp.argmax(iou, axis=0), n_anchors)
+        forced = jnp.zeros(n_anchors + 1, bool).at[best_anchor].set(
+            True)[:n_anchors]
+        pos = (best_iou >= rpn_pos_iou) | forced
+        neg = (best_iou < rpn_neg_iou) & ~forced
+        obj_ce = jnp.where(
+            pos, jax.nn.softplus(-obj),
+            jnp.where(neg, jax.nn.softplus(obj), 0.0))
+        rpn_cls_loss = obj_ce.sum() / jnp.maximum(pos.sum() + neg.sum(), 1)
+
+        rpn_targets = encode_boxes(gt_boxes[best_gt], anchors)
+        diff = jnp.abs(rpn_deltas - rpn_targets)
+        sl1 = jnp.where(diff < 1.0, 0.5 * diff ** 2, diff - 0.5)
+        rpn_box_loss = jnp.where(pos[:, None], sl1, 0.0).sum() / n_gt
+
+        # ---- ROI head: classify + refine the selected proposals ----
+        piou = jnp.where(valid[None, :],
+                         iou_matrix(proposals, gt_boxes), -1.0)  # [P, M]
+        p_best_iou = jnp.max(piou, axis=1)
+        p_best_gt = jnp.argmax(piou, axis=1)
+        p_pos = p_best_iou >= roi_pos_iou
+        target_cls = jnp.where(p_pos, gt_labels[p_best_gt], 0)
+        roi_ce = -jax.nn.log_softmax(roi_cls)[
+            jnp.arange(roi_cls.shape[0]), target_cls]
+        roi_cls_loss = roi_ce.mean()
+
+        roi_targets = encode_boxes(gt_boxes[p_best_gt], proposals)
+        rdiff = jnp.abs(roi_deltas - roi_targets)
+        rsl1 = jnp.where(rdiff < 1.0, 0.5 * rdiff ** 2, rdiff - 0.5)
+        roi_box_loss = jnp.where(p_pos[:, None], rsl1, 0.0).sum() \
+            / jnp.maximum(p_pos.sum(), 1)
+
+        return rpn_cls_loss + rpn_box_loss + roi_cls_loss + roi_box_loss
+
+    def loss_fn(preds, labels):
+        obj, rpn_deltas, proposals, roi_cls, roi_deltas = preds
+        gt_boxes, gt_labels = labels[0], labels[1].astype(jnp.int32)
+        return jax.vmap(per_example)(obj, rpn_deltas, proposals, roi_cls,
+                                     roi_deltas, gt_boxes, gt_labels)
+
+    return loss_fn
+
+
+class FasterRCNNDetector(ZooModel):
+    """Two-stage detector with the SSDDetector surface: fit on
+    {"x": images, "y": [gt_boxes, gt_labels]} (padded, 0 = pad label);
+    `detect(images)` → per-image (boxes, scores, classes)."""
+
+    default_metrics = ()
+
+    def __init__(self, num_classes: int, image_size: int = 64,
+                 channels: Sequence[int] = (16, 32, 64),
+                 scales: Sequence[float] = (0.25, 0.5),
+                 ratios: Sequence[float] = (1.0, 2.0, 0.5),
+                 num_proposals: int = 32, pool_size: int = 4,
+                 lr: float = 1e-3, compute_dtype=jnp.bfloat16,
+                 seed: int = 0):
+        self.num_classes = num_classes
+        self.image_size = image_size
+        self.channels = tuple(channels)
+        self.scales = tuple(scales)
+        self.ratios = tuple(ratios)
+        self.num_proposals = num_proposals
+        self.pool_size = pool_size
+        self.lr = lr
+        self.seed = seed
+        self.compute_dtype = compute_dtype
+        stride = 2 ** len(channels)
+        fmap = -(-image_size // stride)
+        # all (scale, ratio) anchors live on the ONE stride-2^len map,
+        # cell-major with (scale, ratio) innermost — matching the RPN
+        # head's reshape(b, H*W*k) layout (k = |scales|*|ratios|)
+        cy, cx = np.meshgrid((np.arange(fmap) + 0.5) / fmap,
+                             (np.arange(fmap) + 0.5) / fmap,
+                             indexing="ij")
+        per = []
+        for s in scales:
+            for r in ratios:
+                w, h = s * np.sqrt(r), s / np.sqrt(r)
+                per.append(np.stack([cx - w / 2, cy - h / 2,
+                                     cx + w / 2, cy + h / 2],
+                                    axis=-1).reshape(-1, 4))
+        self.anchors = np.clip(
+            np.stack(per, axis=1).reshape(-1, 4), 0.0, 1.0
+        ).astype(np.float32)
+        self._module = _FasterRCNNNet(
+            num_classes=num_classes,
+            n_anchors_per_cell=len(scales) * len(ratios),
+            num_proposals=num_proposals, pool_size=pool_size,
+            anchors=tuple(map(tuple, self.anchors.tolist())),
+            channels=self.channels, compute_dtype=compute_dtype)
+        self.default_loss = faster_rcnn_loss(jnp.asarray(self.anchors))
+
+    def module(self):
+        return self._module
+
+    def estimator(self, **kwargs):
+        kwargs.setdefault("learning_rate", self.lr)
+        kwargs.setdefault("seed", self.seed)
+        return super().estimator(**kwargs)
+
+    def get_config(self) -> Dict:
+        return dict(num_classes=self.num_classes,
+                    image_size=self.image_size, channels=self.channels,
+                    scales=self.scales, ratios=self.ratios,
+                    num_proposals=self.num_proposals,
+                    pool_size=self.pool_size, lr=self.lr,
+                    compute_dtype=self.compute_dtype, seed=self.seed)
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 16, **kw):
+        self._require_estimator().fit(data, epochs=epochs,
+                                      batch_size=batch_size, **kw)
+        return self
+
+    def detect(self, images: np.ndarray, score_threshold: float = 0.5,
+               nms_iou: float = 0.45, max_det: int = 20
+               ) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Per image: (boxes [k, 4] normalized xyxy, scores [k],
+        classes [k] 1-based) from the refined second-stage outputs."""
+        preds = self._require_estimator().predict({"x": images},
+                                                  batch_size=16)
+        _, _, proposals, roi_cls, roi_deltas = preds
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(roi_cls), axis=-1))
+        boxes_all = np.asarray(jax.vmap(decode_boxes)(
+            jnp.asarray(roi_deltas), jnp.asarray(proposals)))
+        out = []
+        for b in range(len(images)):
+            scores = probs[b, :, 1:]
+            cls_ids = scores.argmax(axis=1)
+            cls_scores = scores.max(axis=1)
+            m = cls_scores >= score_threshold
+            boxes, sc, cid = (boxes_all[b][m], cls_scores[m],
+                              cls_ids[m] + 1)
+            keep: List[int] = []
+            for c in np.unique(cid):
+                idx = np.flatnonzero(cid == c)
+                kept = nms(boxes[idx], sc[idx], nms_iou, max_det)
+                keep.extend(idx[kept].tolist())
+            keep = sorted(keep, key=lambda i: -sc[i])[:max_det]
+            out.append((np.clip(boxes[keep], 0, 1), sc[keep], cid[keep]))
+        return out
+
+    # shared static-GT padding helper (box_utils.pad_ground_truth)
+    pad_ground_truth = staticmethod(pad_ground_truth)

@@ -32,6 +32,7 @@ from analytics_zoo_tpu.models.image.objectdetection.box_utils import (
     generate_anchors,
     iou_matrix,
     nms,
+    pad_ground_truth,
 )
 
 
@@ -228,18 +229,5 @@ class SSDDetector(ZooModel):
             out.append((np.clip(boxes[keep], 0, 1), sc[keep], cid[keep]))
         return out
 
-    @staticmethod
-    def pad_ground_truth(boxes_list: Sequence[np.ndarray],
-                         labels_list: Sequence[np.ndarray],
-                         max_boxes: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Pad per-image variable GT to static [n, max_boxes, ...]
-        (labels 0 = padding)."""
-        n = len(boxes_list)
-        boxes = np.zeros((n, max_boxes, 4), np.float32)
-        labels = np.zeros((n, max_boxes), np.int32)
-        for i, (bx, lb) in enumerate(zip(boxes_list, labels_list)):
-            k = min(len(lb), max_boxes)
-            if k:
-                boxes[i, :k] = bx[:k]
-                labels[i, :k] = lb[:k]
-        return boxes, labels
+    # shared static-GT padding helper (box_utils.pad_ground_truth)
+    pad_ground_truth = staticmethod(pad_ground_truth)

@@ -87,20 +87,16 @@ def categorical_from_vocab_list(sth, vocab_list: Sequence,
 
 def get_boundaries(target, boundaries: Sequence[float],
                    default: int = -1, start: int = 0):
-    """Bucketize `target` by sorted `boundaries` ('?' → default).
-    Scalar or vectorized."""
+    """Bucketize `target` by sorted `boundaries` ('?'/NaN/non-numeric →
+    default).  Scalar or vectorized — the scalar path routes through the
+    same code so missing-value handling cannot diverge."""
     bnds = np.asarray(boundaries, np.float64)
-    if isinstance(target, (pd.Series, np.ndarray, list, tuple)):
-        s = pd.Series(target)
-        missing = s.astype(str).eq("?")
-        vals = pd.to_numeric(s.where(~missing, other=np.nan),
-                             errors="coerce").to_numpy(np.float64)
-        idx = np.searchsorted(bnds, vals, side="right")
-        idx = np.where(np.isnan(vals) | missing.to_numpy(), default, idx)
-        return idx.astype(np.int64) + start
-    if target == "?":
-        return default + start
-    return int(np.searchsorted(bnds, float(target), side="right")) + start
+    scalar = not isinstance(target, (pd.Series, np.ndarray, list, tuple))
+    s = pd.Series([target] if scalar else target)
+    vals = pd.to_numeric(s, errors="coerce").to_numpy(np.float64)
+    idx = np.searchsorted(bnds, vals, side="right")
+    idx = np.where(np.isnan(vals), default, idx).astype(np.int64) + start
+    return int(idx[0]) if scalar else idx
 
 
 def get_negative_samples(indexed: pd.DataFrame, user_col: str = "userId",
@@ -117,21 +113,32 @@ def get_negative_samples(indexed: pd.DataFrame, user_col: str = "userId",
     Vectorized: draws candidates in bulk and rejects collisions against a
     per-user positive set, redrawing only the collided slots."""
     rng = np.random.default_rng(seed)
-    users = indexed[user_col].to_numpy()
-    items = indexed[item_col].to_numpy()
+    users = indexed[user_col].to_numpy(np.int64)
+    items = indexed[item_col].to_numpy(np.int64)
     max_item = int(item_count if item_count is not None else items.max())
-    pos = set(zip(users.tolist(), items.tolist()))
+    # encode (user, item) pairs as sortable int keys: collision checks
+    # become vectorized searchsorted, and each round only re-checks the
+    # redrawn slots
+    pos_keys = np.unique(users * (max_item + 1) + items)
+
+    def collides(u, d):
+        if pos_keys.size == 0:
+            return np.zeros(len(u), bool)
+        k = u * (max_item + 1) + d
+        j = np.searchsorted(pos_keys, k)
+        j = np.minimum(j, len(pos_keys) - 1)
+        return pos_keys[j] == k
 
     rep_users = np.repeat(users, neg_num)
     draws = rng.integers(1, max_item + 1, rep_users.shape[0])
-    bad = np.zeros(rep_users.shape[0], bool)
+    pending = np.flatnonzero(collides(rep_users, draws))
     for _ in range(100):
-        bad = np.fromiter(
-            ((u, i) in pos for u, i in zip(rep_users, draws)),
-            bool, rep_users.shape[0])
-        if not bad.any():
+        if pending.size == 0:
             break
-        draws[bad] = rng.integers(1, max_item + 1, int(bad.sum()))
+        draws[pending] = rng.integers(1, max_item + 1, pending.size)
+        pending = pending[collides(rep_users[pending], draws[pending])]
+    bad = np.zeros(rep_users.shape[0], bool)
+    bad[pending] = True
     if bad.any():
         # near-dense users can make some slots unsatisfiable — drop them
         # rather than emit positives mislabeled as negatives

@@ -9,7 +9,13 @@ from analytics_zoo_tpu.serving.grpc_frontend import (
     GrpcServingFrontend,
 )
 from analytics_zoo_tpu.serving.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.quantize import (
+    dequantize_params,
+    quantize_params,
+    quantized_size_bytes,
+)
 from analytics_zoo_tpu.serving.server import ServingServer
 
 __all__ = ["InferenceModel", "ServingServer", "InputQueue", "OutputQueue",
-           "GrpcInputQueue", "GrpcServingFrontend"]
+           "GrpcInputQueue", "GrpcServingFrontend", "quantize_params",
+           "dequantize_params", "quantized_size_bytes"]

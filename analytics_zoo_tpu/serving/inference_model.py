@@ -51,16 +51,40 @@ class InferenceModel:
     # the one engine is jitted JAX)
     # ------------------------------------------------------------------
 
-    def load_flax(self, module, params, model_state=None):
-        """Serve a flax module with given params."""
+    def load_flax(self, module, params, model_state=None,
+                  quantize: bool = False):
+        """Serve a flax module with given params.  `quantize=True`
+        stores weights int8 in HBM (reference wp-bigdl.md:192 int8
+        inference: ~4x model-size cut) and dequantizes to bf16 inside
+        the jitted forward, where XLA fuses it into the matmuls."""
         import jax
-
-        variables = {"params": params, **(model_state or {})}
-        variables = jax.device_put(variables)
 
         from analytics_zoo_tpu.orca.learn.flax_adapter import _mode_kwarg
         kw, invert = _mode_kwarg(module)
         kwargs = {kw: True if invert else False} if kw else {}
+
+        if quantize:
+            import jax.numpy as jnp
+
+            from analytics_zoo_tpu.serving.quantize import (
+                dequantize_params, quantize_params)
+            qparams, self.quantize_stats = quantize_params(params)
+            qvars = jax.device_put(
+                {"qparams": qparams, "state": model_state or {}})
+
+            @jax.jit
+            def qfn(qvars, *feats):
+                variables = {
+                    "params": dequantize_params(qvars["qparams"],
+                                                dtype=jnp.bfloat16),
+                    **qvars["state"]}
+                return module.apply(variables, *feats, **kwargs)
+
+            self._predict_fn = lambda *feats: qfn(qvars, *feats)
+            return self
+
+        variables = {"params": params, **(model_state or {})}
+        variables = jax.device_put(variables)
 
         @jax.jit
         def fn(variables, *feats):
@@ -86,9 +110,12 @@ class InferenceModel:
         self._predict_fn = lambda *feats: fn(params, model_state, *feats)
         return self
 
-    def load_model(self, path: str, model_cls=None):
+    def load_model(self, path: str, model_cls=None,
+                   quantize: bool = False):
         """Load a `ZooModel.save_model` directory (reference
-        doLoadModel); `model_cls` overrides the saved class lookup."""
+        doLoadModel); `model_cls` overrides the saved class lookup;
+        `quantize=True` serves int8 weights (reference doLoadBigDL's
+        quantized path)."""
         import pickle
         import os
 
@@ -102,7 +129,8 @@ class InferenceModel:
         if hasattr(module, "module"):
             module = module.module()
         return self.load_flax(module, saved["params"],
-                              saved.get("model_state") or {})
+                              saved.get("model_state") or {},
+                              quantize=quantize)
 
     def load_estimator(self, estimator):
         """Serve a (possibly still-training) Estimator's current params."""

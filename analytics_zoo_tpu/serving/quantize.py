@@ -1,0 +1,105 @@
+"""Int8 weight quantization for inference.
+
+Capability match: the reference's int8 quantized inference — "up to 2x
+inference speedup and 4x model-size reduction with <0.1% accuracy drop"
+(BigDL whitepaper `docs/docs/wp-bigdl.md:192`; surfaced through BigDL's
+`quantize()` on loaded models).
+
+TPU-native design: symmetric per-output-channel int8 weights with f32
+scales, stored int8 in HBM (the 4x size cut) and dequantized to bf16
+*inside* the jitted forward — XLA fuses the dequant multiply into the
+consuming matmul/conv, so weight HBM traffic drops 4x vs f32, which is
+the win for bandwidth-bound serving.  Activations stay bf16 (weight-only
+quantization); there is no calibration pass to run.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+_QKEY = "__int8__"
+
+
+def _quantize_leaf(w: np.ndarray) -> Dict[str, Any]:
+    """Symmetric per-output-channel (last axis) int8 quantization."""
+    axes = tuple(range(w.ndim - 1))
+    amax = np.max(np.abs(w), axis=axes, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    # NOTE: no string metadata in the tree — it rides through
+    # jax.device_put/jit as a runtime arg and strings aren't JAX types
+    return {_QKEY: q, "scale": scale}
+
+
+def is_quantized_leaf(node: Any) -> bool:
+    return isinstance(node, dict) and _QKEY in node
+
+
+def quantize_params(params, *, min_size: int = 512, min_ndim: int = 2
+                    ) -> Tuple[Any, Dict[str, float]]:
+    """Quantize every float weight array with >= `min_ndim` dims and
+    >= `min_size` elements (kernels/embeddings; biases and norm scales
+    stay float).  Returns (quantized tree, stats) where stats reports
+    original/quantized byte sizes and the compression ratio."""
+    stats = {"orig_bytes": 0, "quant_bytes": 0}
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        arr = np.asarray(node)
+        nbytes = arr.size * arr.dtype.itemsize
+        stats["orig_bytes"] += nbytes
+        if (arr.ndim >= min_ndim and arr.size >= min_size
+                and np.issubdtype(arr.dtype, np.floating)):
+            q = _quantize_leaf(arr.astype(np.float32))
+            stats["quant_bytes"] += (
+                q[_QKEY].size + q["scale"].size * 4)
+            return q
+        stats["quant_bytes"] += nbytes
+        return node
+
+    qtree = walk(params)
+    stats["compression"] = (stats["orig_bytes"]
+                            / max(stats["quant_bytes"], 1))
+    return qtree, stats
+
+
+def dequantize_params(qparams, dtype=None):
+    """Rebuild a float param tree; jit-traceable (jnp ops), so calling
+    it inside the served forward lets XLA fuse dequantization into the
+    consumer matmul.  `dtype` sets the restored dtype (float32 default;
+    pass jnp.bfloat16 for serving)."""
+    import jax.numpy as jnp
+
+    target = dtype if dtype is not None else jnp.float32
+
+    def walk(node):
+        if is_quantized_leaf(node):
+            return (node[_QKEY].astype(jnp.float32)
+                    * node["scale"]).astype(target)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(qparams)
+
+
+def quantized_size_bytes(qparams) -> int:
+    """Total serialized weight bytes of a (possibly mixed) tree."""
+    total = 0
+
+    def walk(node):
+        nonlocal total
+        if is_quantized_leaf(node):
+            total += node[_QKEY].size + node["scale"].size * 4
+        elif isinstance(node, dict):
+            for v in node.values():
+                walk(v)
+        else:
+            arr = np.asarray(node)
+            total += arr.size * arr.dtype.itemsize
+
+    walk(qparams)
+    return total

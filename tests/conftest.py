@@ -22,6 +22,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# persistent compile cache (same idea as bench.py/__graft_entry__.py):
+# the suite is dominated by XLA CPU compiles of conv/transformer train
+# steps; warm reruns skip them.  sitecustomize pre-imports jax, so the
+# env var is read too early — set the live config instead.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache_tests"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 import pytest  # noqa: E402
 

@@ -270,3 +270,48 @@ def test_threshold_detector_scalar_threshold():
     td = ThresholdDetector().set_params(threshold=2.0)
     td.fit(y)
     assert list(td.anomaly_indexes()) == [7]
+
+
+def test_tsdataset_from_parquet_roundtrip(tmp_path):
+    df = _series_df(60)
+    p = str(tmp_path / "ts.parquet")
+    df.to_parquet(p)
+    ts = TSDataset.from_parquet(p, dt_col="ts", target_col="value",
+                                extra_feature_col="extra")
+    assert len(ts.df) == 60 and ts.feature_col == ["extra"]
+    x, y = ts.roll(lookback=12, horizon=3).to_numpy()
+    assert x.shape[1:] == (12, 2) and y.shape[1:] == (3, 1)
+
+
+def test_gen_global_feature_broadcasts_per_series():
+    df = _series_df(50, ids=["a", "b"])
+    # make series 'b' clearly different
+    df.loc[df["id"] == "b", "value"] += 10
+    ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value",
+                               id_col="id", extra_feature_col="extra")
+    ts.gen_global_feature(settings="comprehensive")
+    assert "value__mean" in ts.feature_col
+    assert "value__autocorr_lag1" in ts.feature_col
+    g = ts.df.groupby("id")["value__mean"].nunique()
+    assert (g == 1).all()  # constant within a series
+    means = ts.df.groupby("id")["value__mean"].first()
+    assert abs(means["b"] - means["a"] - 10) < 1.0
+
+    with pytest.raises(ValueError, match="minimal/efficient"):
+        ts.gen_global_feature(settings="bogus")
+
+
+def test_to_loader_batches_and_shapes():
+    df = _series_df(100)
+    ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value")
+    batches = list(ts.to_loader(batch_size=16, roll=True, lookback=10,
+                                horizon=2, shuffle=True, seed=3))
+    n = sum(len(b[0]) for b in batches)
+    assert n == 100 - 10 - 2 + 1
+    assert batches[0][0].shape == (16, 10, 1)
+    assert batches[0][1].shape == (16, 2, 1)
+    # drop_last trims the ragged tail
+    full = list(ts.to_loader(batch_size=16, drop_last=True))
+    assert all(len(b[0]) == 16 for b in full)
+    with pytest.raises(ValueError, match="lookback"):
+        ts.to_loader(roll=True)

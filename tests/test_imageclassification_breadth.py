@@ -1,0 +1,57 @@
+"""Inception-v1 / MobileNetV2 / VGG16 (reference ImageNet nets via
+BigDL, models/image/imageclassification/; Inception-v1 is the headline
+scaling-benchmark model of docs/docs/wp-bigdl.md:160)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.models.image.imageclassification import (
+    ImageClassifier,
+    InceptionV1,
+    MobileNetV2,
+    VGG16,
+)
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context(cluster_mode="local")
+    yield
+
+
+def _data(n=16, hw=32, classes=2, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, hw, hw, 3)).astype(np.float32)
+    y = rng.integers(0, classes, n).astype(np.int32)
+    return x, y
+
+
+@pytest.mark.parametrize("model", [
+    InceptionV1(num_classes=2, width=0.125),
+    MobileNetV2(num_classes=2, width=0.125),
+    VGG16(num_classes=2, width=0.125, fc_dim=32),
+])
+def test_backbone_fit_predict(model):
+    x, y = _data()
+    est = model.estimator(learning_rate=1e-3)
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=8)
+    preds = est.predict({"x": x}, batch_size=8)
+    assert preds.shape == (16, 2)
+    assert np.isfinite(np.asarray(preds)).all()
+
+
+def test_backbones_registered_in_image_classifier():
+    for name in ("inception-v1", "mobilenet-v2", "vgg-16"):
+        assert name in ImageClassifier.BACKBONES
+    clf = ImageClassifier("mobilenet-v2", num_classes=3)
+    assert clf.get_config()["model_name"] == "mobilenet-v2"
+
+
+def test_mobilenet_residual_shapes():
+    import jax
+    m = MobileNetV2(num_classes=4, width=0.25)
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(variables, x)
+    assert out.shape == (2, 4)

@@ -1,0 +1,94 @@
+"""Int8 weight-only quantized inference (reference wp-bigdl.md:192 —
+"2x inference speedup, 4x model-size reduction, <0.1% accuracy drop")."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.serving.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.quantize import (
+    dequantize_params,
+    quantize_params,
+    quantized_size_bytes,
+)
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context(cluster_mode="local")
+    yield
+
+
+def test_quantize_roundtrip_error_small():
+    rng = np.random.default_rng(0)
+    params = {"dense": {"kernel": rng.normal(size=(64, 32)).astype(np.float32),
+                        "bias": rng.normal(size=32).astype(np.float32)}}
+    q, stats = quantize_params(params)
+    # kernel quantized, bias untouched
+    assert "__int8__" in q["dense"]["kernel"]
+    assert isinstance(q["dense"]["bias"], np.ndarray)
+    deq = dequantize_params(q)
+    err = np.abs(np.asarray(deq["dense"]["kernel"]) -
+                 params["dense"]["kernel"]).max()
+    # per-channel symmetric int8: max error <= scale/2 ~ amax/254
+    assert err <= np.abs(params["dense"]["kernel"]).max() / 127
+    np.testing.assert_array_equal(np.asarray(deq["dense"]["bias"]),
+                                  params["dense"]["bias"])
+
+
+def test_quantize_size_reduction_approaches_4x():
+    rng = np.random.default_rng(1)
+    params = {f"layer{i}": {"kernel":
+              rng.normal(size=(256, 256)).astype(np.float32)}
+              for i in range(4)}
+    q, stats = quantize_params(params)
+    assert stats["compression"] > 3.9
+    assert quantized_size_bytes(q) == stats["quant_bytes"]
+
+
+def test_quantized_inference_model_accuracy():
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(64)(x))
+            x = nn.relu(nn.Dense(64)(x))
+            return nn.Dense(4)(x)
+
+    import jax
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(128, 16)).astype(np.float32)
+    m = MLP()
+    params = jax.device_get(
+        m.init(jax.random.PRNGKey(0), x[:1]))["params"]
+
+    ref = InferenceModel().load_flax(m, params)
+    qt = InferenceModel().load_flax(m, params, quantize=True)
+    p_ref = ref.predict(x)
+    p_q = qt.predict(x)
+    assert p_q.shape == p_ref.shape
+    # <0.1% top-1 disagreement is the reference claim; tiny random MLP
+    # with bf16 dequant: allow a couple of flips
+    agree = (np.argmax(p_ref, -1) == np.argmax(p_q, -1)).mean()
+    assert agree >= 0.97
+    assert qt.quantize_stats["compression"] > 2.0
+
+
+def test_zoo_model_quantized_load(tmp_path):
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 50, size=(32, 10))
+    y = (toks[:, 0] % 2).astype(np.int32)
+    model = TextClassifier(class_num=2, vocab_size=50, embed_dim=8,
+                           sequence_length=10, encoder="cnn",
+                           encoder_output_dim=16)
+    est = model.estimator(learning_rate=1e-2)
+    est.fit({"x": toks, "y": y}, epochs=2, batch_size=16)
+    model.save_model(str(tmp_path / "m"))
+
+    im = InferenceModel().load_model(str(tmp_path / "m"), quantize=True)
+    p_q = im.predict(toks)
+    p_f = np.asarray(est.predict({"x": toks}))
+    agree = (np.argmax(p_f, -1) == np.argmax(p_q, -1)).mean()
+    assert agree >= 0.95
