@@ -50,6 +50,7 @@ class OrcaContextMeta(type):
     _shard_size = None
     _log_output = False
     _train_data_store = "DRAM"
+    _device_cache_bytes = 256 * 1024 * 1024
     _failure_retry_times = 5
     _failure_retry_interval_s = 1.0
 
@@ -105,17 +106,35 @@ class OrcaContextMeta(type):
 
     @property
     def train_data_store(cls):
-        """"DRAM" or "DISK_n" — whether host-side datasets are kept in RAM or
-        spilled to disk and streamed (reference FeatureSet tiers,
-        zoo/src/main/scala/.../feature/FeatureSet.scala:233,557)."""
+        """"DRAM", "DISK_n" or "DEVICE" — where training data lives between
+        epochs (reference FeatureSet tiers,
+        zoo/src/main/scala/.../feature/FeatureSet.scala:233,557).  "DEVICE"
+        is the TPU-native tier the reference couldn't have: the dataset is
+        uploaded to HBM once (sharded over the mesh's data axes) and every
+        epoch reads it in place — zero host→device traffic in the steady
+        state.  Capped by `device_cache_bytes`; mutating the source numpy
+        arrays after fit() starts will NOT be seen by cached epochs."""
         return cls._train_data_store
 
     @train_data_store.setter
     def train_data_store(cls, value):
         value = str(value).upper()
-        if value != "DRAM" and not value.startswith("DISK"):
-            raise ValueError("train_data_store must be 'DRAM' or 'DISK_n'")
+        if value not in ("DRAM", "DEVICE") and not value.startswith("DISK"):
+            raise ValueError(
+                "train_data_store must be 'DRAM', 'DEVICE' or 'DISK_n'")
         cls._train_data_store = value
+
+    @property
+    def device_cache_bytes(cls):
+        """Max TOTAL bytes the DEVICE store pins in HBM across cached
+        datasets (an estimator evicts older entries before exceeding
+        it); a single dataset over the cap falls back to host streaming
+        with a warning."""
+        return cls._device_cache_bytes
+
+    @device_cache_bytes.setter
+    def device_cache_bytes(cls, value):
+        cls._device_cache_bytes = int(value)
 
     @property
     def failure_retry_times(cls):

@@ -76,6 +76,9 @@ class Estimator:
         self._tb_writers = None
         #: per-step wall times from fit(..., profile=True)
         self.profile_stats: List[Dict[str, Any]] = []
+        #: HBM dataset cache (OrcaContext.train_data_store == "DEVICE")
+        self._device_cache: Dict[Any, Any] = {}
+        self.device_cache_hits = 0
 
     # ------------------------------------------------------------------
     # factories
@@ -197,6 +200,9 @@ class Estimator:
                                         label_cols)
                   if validation_data is not None else None)
         self._ensure_engine(ds.probe(batch_size))
+        dds = (self._device_dataset(ds, batch_size)
+               if OrcaContext.train_data_store.upper() == "DEVICE"
+               else None)
         trigger = checkpoint_trigger
         if trigger is None and self.model_dir:
             trigger = EveryEpoch()
@@ -214,7 +220,8 @@ class Estimator:
                     self._restore_latest(start_epoch, target_epoch)
                     pending_restore = False
                 self._fit_one_epoch(ds, val_ds, batch_size, trigger,
-                                    shuffle, nan_policy, profile)
+                                    shuffle, nan_policy, profile,
+                                    dds=dds)
             except (NaNLossError, KeyboardInterrupt):
                 raise
             except Exception as e:
@@ -231,7 +238,7 @@ class Estimator:
         return self
 
     def _fit_one_epoch(self, ds, val_ds, batch_size, trigger, shuffle,
-                       nan_policy, profile=False):
+                       nan_policy, profile=False, dds=None):
         eng = self._engine
         mult = eng.pad_multiple()
 
@@ -242,10 +249,21 @@ class Estimator:
                 self.save_checkpoint()
 
         t0 = time.time()
-        stats = eng.run_epoch(
-            ds.batches(batch_size, shuffle=shuffle, seed=self._seed,
-                       pad_to_multiple_of=mult, epoch=self._epoch),
-            train=True, on_step=on_step, profile=profile)
+        if dds is not None:
+            # only step-granular triggers need the per-step loop;
+            # EveryEpoch fires at epoch end, so the whole epoch can run
+            # as one dispatched scan program
+            step_cb = (on_step if (trigger and self.model_dir
+                                   and not isinstance(trigger, EveryEpoch))
+                       else None)
+            stats = eng.run_epoch_device(
+                dds, train=True, shuffle=shuffle, seed=self._seed,
+                epoch=self._epoch, on_step=step_cb, profile=profile)
+        else:
+            stats = eng.run_epoch(
+                ds.batches(batch_size, shuffle=shuffle, seed=self._seed,
+                           pad_to_multiple_of=mult, epoch=self._epoch),
+                train=True, on_step=on_step, profile=profile)
         if profile:
             self.profile_stats.extend(eng.last_profile)
         self._epoch += 1
@@ -278,6 +296,48 @@ class Estimator:
             if nan_policy == "raise":
                 raise NaNLossError(msg)
             logger.warning(msg)
+
+    def _device_dataset(self, ds, batch_size):
+        """Resolve the HBM-cached dataset for the DEVICE data store
+        (TPU-native analog of the reference's cached FeatureSet,
+        FeatureSet.scala:233).  Falls back to host streaming (None) for
+        streaming/XShards input or datasets over the
+        `OrcaContext.device_cache_bytes` cap.  The cache is keyed on the
+        source array identities: in-place mutation of those arrays
+        between fits is NOT observed (matching the reference's cached-
+        RDD semantics)."""
+        if type(ds) is not HostDataset:
+            logger.warning(
+                "train_data_store='DEVICE' ignored for streaming input; "
+                "using host streaming")
+            return None
+        arrays = tuple(ds.features) + tuple(ds.labels)
+        nbytes = sum(np.asarray(a).nbytes for a in arrays)
+        if nbytes > OrcaContext.device_cache_bytes:
+            logger.warning(
+                "dataset (%d bytes) exceeds device_cache_bytes (%d); "
+                "using host streaming", nbytes,
+                OrcaContext.device_cache_bytes)
+            return None
+        key = (tuple((id(a), np.asarray(a).shape, str(np.asarray(a).dtype))
+                     for a in arrays), int(batch_size), len(ds.features))
+        hit = self._device_cache.get(key)
+        if hit is not None:
+            self.device_cache_hits += 1
+            return hit[0]
+        # the cache caps TOTAL pinned HBM at device_cache_bytes, not
+        # per-dataset: evict everything before an insert would exceed it
+        pinned = sum(entry[0].nbytes
+                     for entry in self._device_cache.values())
+        if pinned + nbytes > OrcaContext.device_cache_bytes:
+            self._device_cache.clear()
+        dds = self._engine.cache_dataset(ds.features, ds.labels,
+                                         batch_size)
+        # hold the source arrays alongside the HBM copy: the id()-based
+        # key is only valid while the sources are alive (a freed array's
+        # address can be recycled, which would be a silent false hit)
+        self._device_cache[key] = (dds, arrays)
+        return dds
 
     def _restore_latest(self, start_epoch, target_epoch):
         """Rewind to the newest checkpoint under model_dir (or keep the

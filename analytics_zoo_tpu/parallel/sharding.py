@@ -56,6 +56,15 @@ def batch_sharding(mesh: Optional[Mesh] = None, ndim: int = None) -> NamedShardi
     return NamedSharding(mesh, spec)
 
 
+def stacked_batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Sharding for a [steps, batch, ...] device-cached dataset: the
+    per-step batch axis (dim 1) splits over the data axes, so indexing a
+    step yields exactly a `batch_sharding` batch with no resharding."""
+    mesh = mesh or OrcaContext.mesh
+    axes = data_axes(mesh)
+    return NamedSharding(mesh, P(None, axes if axes else None))
+
+
 def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
     mesh = mesh or OrcaContext.mesh
     return NamedSharding(mesh, P())

@@ -78,21 +78,35 @@ def _ncf_data(n):
 
 
 def ncf_estimator_throughput(batch: int, steps: int) -> float:
-    """samples/sec through Estimator.fit (the framework path)."""
+    """samples/sec through Estimator.fit (the framework path), with the
+    DEVICE train_data_store: the dataset is pinned in HBM once (the tier
+    above the reference's FeatureSet DRAM cache) so steady-state epochs
+    run with zero host→device traffic."""
+    from analytics_zoo_tpu.common.context import OrcaContext
     from analytics_zoo_tpu.orca.learn.estimator import Estimator
 
     u, i, y = _ncf_data(batch * steps)
-    est = Estimator.from_flax(
-        _ncf_model(), loss="sparse_categorical_crossentropy",
-        optimizer="adam", learning_rate=1e-3)
-    # full-size warmup epoch: compiles the step AND warms the device
-    # allocator/transfer path; then measure steady state
-    est.fit({"x": [u, i], "y": y}, epochs=1, batch_size=batch,
-            shuffle=False)
-    t0 = time.perf_counter()
-    est.fit({"x": [u, i], "y": y}, epochs=1, batch_size=batch,
-            shuffle=False)
-    dt = time.perf_counter() - t0
+    prev_store = OrcaContext.train_data_store
+    prev_cap = OrcaContext.device_cache_bytes
+    OrcaContext.train_data_store = "DEVICE"
+    OrcaContext.device_cache_bytes = 1 << 30
+    try:
+        est = Estimator.from_flax(
+            _ncf_model(), loss="sparse_categorical_crossentropy",
+            optimizer="adam", learning_rate=1e-3)
+        # 2 warmup epochs: epoch 0 compiles the epoch-scan program and
+        # pins the dataset in HBM; epoch 1 absorbs the one recompile
+        # triggered by the donated state's post-scan shardings; epoch 2+
+        # is steady state
+        est.fit({"x": [u, i], "y": y}, epochs=2, batch_size=batch,
+                shuffle=False)
+        t0 = time.perf_counter()
+        est.fit({"x": [u, i], "y": y}, epochs=1, batch_size=batch,
+                shuffle=False)
+        dt = time.perf_counter() - t0
+    finally:
+        OrcaContext.train_data_store = prev_store
+        OrcaContext.device_cache_bytes = prev_cap
     return batch * steps / dt
 
 

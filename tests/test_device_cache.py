@@ -1,0 +1,131 @@
+"""DEVICE train_data_store: HBM-cached datasets (TPU-native tier above
+the reference's FeatureSet DRAM cache, FeatureSet.scala:233)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.common.context import OrcaContext
+from analytics_zoo_tpu.orca.learn.estimator import Estimator
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context(cluster_mode="local")
+    prev = OrcaContext.train_data_store
+    yield
+    OrcaContext.train_data_store = prev
+
+
+def _toy(n=203, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    return x, y
+
+
+def _mlp():
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(nn.relu(nn.Dense(16)(x)))
+    return MLP()
+
+
+def _fit(store, shuffle, epochs=3, batch=32):
+    OrcaContext.train_data_store = store
+    x, y = _toy()
+    est = Estimator.from_flax(_mlp(), loss="sparse_categorical_crossentropy",
+                              optimizer="sgd", learning_rate=0.1,
+                              metrics=["accuracy"], seed=0)
+    est.fit({"x": x, "y": y}, epochs=epochs, batch_size=batch,
+            shuffle=shuffle)
+    return est, x, y
+
+
+def test_device_store_matches_host_path_no_shuffle():
+    e_host, x, y = _fit("DRAM", shuffle=False)
+    e_dev, _, _ = _fit("DEVICE", shuffle=False)
+    # identical batches in identical order -> same training trajectory
+    h = [s["loss"] for s in e_host.train_summary]
+    d = [s["loss"] for s in e_dev.train_summary]
+    np.testing.assert_allclose(d, h, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(e_dev.predict({"x": x})),
+        np.asarray(e_host.predict({"x": x})), atol=1e-5)
+
+
+def test_device_store_learns_with_shuffle_and_uneven_batches():
+    est, x, y = _fit("DEVICE", shuffle=True, epochs=6, batch=33)  # 203 % 33 != 0
+    accs = [s["accuracy"] for s in est.train_summary]
+    assert accs[-1] > 0.8
+    # evaluate goes through the host path; counts must be exact
+    ev = est.evaluate({"x": x, "y": y}, batch_size=33)
+    assert ev["accuracy"] > 0.8
+
+
+def test_device_cache_reused_across_fits():
+    OrcaContext.train_data_store = "DEVICE"
+    x, y = _toy()
+    est = Estimator.from_flax(_mlp(), loss="sparse_categorical_crossentropy",
+                              optimizer="sgd", learning_rate=0.05)
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=32, shuffle=False)
+    assert est.device_cache_hits == 0
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=32, shuffle=False)
+    assert est.device_cache_hits == 1
+
+
+def test_device_store_cap_falls_back_to_streaming():
+    OrcaContext.train_data_store = "DEVICE"
+    prev_cap = OrcaContext.device_cache_bytes
+    OrcaContext.device_cache_bytes = 1024
+    try:
+        x, y = _toy()
+        est = Estimator.from_flax(_mlp(),
+                                  loss="sparse_categorical_crossentropy",
+                                  optimizer="sgd", learning_rate=0.05)
+        est.fit({"x": x, "y": y}, epochs=1, batch_size=32)  # no crash
+        assert len(est._device_cache) == 0
+    finally:
+        OrcaContext.device_cache_bytes = prev_cap
+
+
+def test_device_store_rejects_bad_value():
+    with pytest.raises(ValueError):
+        OrcaContext.train_data_store = "HBM_EXTREME"
+
+
+def test_device_cache_pins_sources_and_total_cap(tmp_path):
+    OrcaContext.train_data_store = "DEVICE"
+    x, y = _toy()
+    est = Estimator.from_flax(_mlp(), loss="sparse_categorical_crossentropy",
+                              optimizer="sgd", learning_rate=0.05)
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=32, shuffle=False)
+    # the cache holds the SOURCE arrays (id()-keys stay valid) ...
+    (dds, arrays), = est._device_cache.values()
+    assert any(a is x for a in arrays)
+    # ... and the byte cap bounds the TOTAL across entries
+    prev = OrcaContext.device_cache_bytes
+    OrcaContext.device_cache_bytes = dds.nbytes + 1  # room for ~1 entry
+    try:
+        x2 = x + 1.0
+        est.fit({"x": x2, "y": y}, epochs=1, batch_size=32, shuffle=False)
+        assert len(est._device_cache) == 1  # evicted the first entry
+    finally:
+        OrcaContext.device_cache_bytes = prev
+
+
+def test_device_store_with_everyepoch_checkpoint(tmp_path):
+    from analytics_zoo_tpu.orca.learn.trigger import EveryEpoch
+    OrcaContext.train_data_store = "DEVICE"
+    x, y = _toy()
+    est = Estimator.from_flax(_mlp(), loss="sparse_categorical_crossentropy",
+                              optimizer="sgd", learning_rate=0.05,
+                              model_dir=str(tmp_path))
+    est.fit({"x": x, "y": y}, epochs=2, batch_size=32, shuffle=False,
+            checkpoint_trigger=EveryEpoch())
+    import os
+    assert any("ckpt" in f or "epoch" in f or f.endswith(".pkl")
+               for f in os.listdir(tmp_path))
