@@ -200,7 +200,7 @@ class Estimator:
                                         label_cols)
                   if validation_data is not None else None)
         self._ensure_engine(ds.probe(batch_size))
-        dds = (self._device_dataset(ds, batch_size)
+        dds = (self._device_dataset(ds, batch_size, shuffle)
                if OrcaContext.train_data_store.upper() == "DEVICE"
                else None)
         trigger = checkpoint_trigger
@@ -297,12 +297,14 @@ class Estimator:
                 raise NaNLossError(msg)
             logger.warning(msg)
 
-    def _device_dataset(self, ds, batch_size):
+    def _device_dataset(self, ds, batch_size, shuffle=False):
         """Resolve the HBM-cached dataset for the DEVICE data store
         (TPU-native analog of the reference's cached FeatureSet,
         FeatureSet.scala:233).  Falls back to host streaming (None) for
-        streaming/XShards input or datasets over the
-        `OrcaContext.device_cache_bytes` cap.  The cache is keyed on the
+        streaming/XShards input or when the PINNED footprint — padded
+        [steps, batch, ...] bytes, doubled for shuffled epochs (the
+        device-side permutation materializes a second copy) — exceeds
+        `OrcaContext.device_cache_bytes`.  The cache is keyed on the
         source array identities: in-place mutation of those arrays
         between fits is NOT observed (matching the reference's cached-
         RDD semantics)."""
@@ -312,11 +314,18 @@ class Estimator:
                 "using host streaming")
             return None
         arrays = tuple(ds.features) + tuple(ds.labels)
-        nbytes = sum(np.asarray(a).nbytes for a in arrays)
+        steps, b = self._engine.cached_layout(
+            ds.n, batch_size, self._engine.pad_multiple())
+        row_bytes = sum(
+            np.asarray(a).dtype.itemsize
+            * int(np.prod(np.asarray(a).shape[1:], dtype=np.int64))
+            for a in arrays) + 4  # + float32 mask
+        nbytes = steps * b * row_bytes * (2 if shuffle else 1)
         if nbytes > OrcaContext.device_cache_bytes:
             logger.warning(
-                "dataset (%d bytes) exceeds device_cache_bytes (%d); "
-                "using host streaming", nbytes,
+                "dataset needs %d device bytes (padded%s), over "
+                "device_cache_bytes (%d); using host streaming", nbytes,
+                ", x2 for shuffle" if shuffle else "",
                 OrcaContext.device_cache_bytes)
             return None
         key = (tuple((id(a), np.asarray(a).shape, str(np.asarray(a).dtype))

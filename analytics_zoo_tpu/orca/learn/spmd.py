@@ -261,28 +261,37 @@ class SPMDEngine:
     def put_batch(self, batch: Dict[str, Any]):
         return shard_batch(batch, self.mesh)
 
+    @staticmethod
+    def cached_layout(n: int, batch_size: int, mult: int):
+        """(steps, padded_batch) of the DEVICE-tier layout: the SAME
+        batch composition as the host-streaming path — `batch_size` real
+        rows per step (fewer in the last), each step padded up to a
+        multiple of the data parallelism."""
+        b = -(-batch_size // mult) * mult
+        steps = max(1, -(-n // batch_size))
+        return steps, b
+
     def cache_dataset(self, features: Sequence[np.ndarray],
                       labels: Sequence[np.ndarray],
                       batch_size: int) -> DeviceDataset:
         """Upload the whole dataset ONCE as [steps, batch, ...] sharded
-        arrays (the DEVICE train_data_store tier).  Rows are padded to a
-        full final batch; the padded mask rides along, so masked stats
-        and gradients match the host-streaming path exactly."""
-        mult = self.pad_multiple()
-        b = -(-batch_size // mult) * mult
+        arrays (the DEVICE train_data_store tier).  Each step holds
+        `batch_size` real rows padded (with mask) to the data-parallel
+        multiple — identical batch composition, step count and masks to
+        the host-streaming path, so trajectories match exactly."""
         n = len(features[0]) if features else len(labels[0])
-        steps = max(1, -(-n // b))
-        total = steps * b
+        steps, b = self.cached_layout(n, batch_size,
+                                      self.pad_multiple())
 
         def prep(a):
             a = np.asarray(a)
-            if len(a) < total:
-                pad = [(0, total - len(a))] + [(0, 0)] * (a.ndim - 1)
-                a = np.pad(a, pad)
-            return a.reshape((steps, b) + a.shape[1:])
+            out = np.zeros((steps, b) + a.shape[1:], a.dtype)
+            for i in range(steps):
+                rows = a[i * batch_size:(i + 1) * batch_size]
+                out[i, :len(rows)] = rows
+            return out
 
-        mask = np.zeros(total, np.float32)
-        mask[:n] = 1.0
+        mask = np.ones(n, np.float32)
         tree = {"features": tuple(prep(a) for a in features),
                 "labels": tuple(prep(a) for a in labels),
                 "mask": prep(mask)}

@@ -8,6 +8,11 @@ from analytics_zoo_tpu.serving.grpc_frontend import (
     GrpcInputQueue,
     GrpcServingFrontend,
 )
+from analytics_zoo_tpu.serving.config import (
+    ServingConfig,
+    start_serving,
+    stop_serving,
+)
 from analytics_zoo_tpu.serving.inference_model import InferenceModel
 from analytics_zoo_tpu.serving.quantize import (
     dequantize_params,
@@ -18,4 +23,5 @@ from analytics_zoo_tpu.serving.server import ServingServer
 
 __all__ = ["InferenceModel", "ServingServer", "InputQueue", "OutputQueue",
            "GrpcInputQueue", "GrpcServingFrontend", "quantize_params",
-           "dequantize_params", "quantized_size_bytes"]
+           "dequantize_params", "quantized_size_bytes", "ServingConfig",
+           "start_serving", "stop_serving"]

@@ -1,0 +1,146 @@
+"""Serving configuration — the reference's `config.yaml` surface
+(`scripts/cluster-serving/config.yaml`, parsed by
+`serving/utils/ConfigParser.scala` into ClusterServingHelper).
+
+Key mapping onto the TPU-native stack:
+  modelPath         -> a `ZooModel.save_model` directory
+  jobName           -> server name (informational)
+  modelParallelism  -> InferenceModel(supported_concurrent_num=...)
+  maxBatchSize      -> InferenceModel(max_batch_size=...) and the
+                       frontend batcher's max batch
+  quantize          -> int8 weight quantization at load (wp-bigdl.md:192)
+  protocol          -> "http" | "grpc" | "both" (reference: akka-http
+                       REST and gRPC frontends)
+  host/port/grpcPort-> bind addresses
+  batchTimeoutMs    -> frontend micro-batching window
+(coreNumberPerMachine/threadPerModel/redisUrl/flinkRestUrl have no
+analog: there is no Flink/Redis data plane — the frontends feed the
+jitted model directly.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+_DEFAULTS = {
+    "jobName": "serving_stream",
+    "protocol": "http",
+    "host": "127.0.0.1",
+    "port": 10020,
+    "grpcPort": 10021,
+    "modelParallelism": 4,
+    "maxBatchSize": 256,
+    "batchTimeoutMs": 5.0,
+    "quantize": False,
+    "modelClass": None,
+}
+
+_KNOWN = set(_DEFAULTS) | {"modelPath"}
+
+
+class ServingConfig:
+    """Validated serving configuration."""
+
+    def __init__(self, **kwargs):
+        unknown = set(kwargs) - _KNOWN
+        if unknown:
+            raise ValueError(
+                f"unknown serving config key(s): {sorted(unknown)}; "
+                f"known: {sorted(_KNOWN)}")
+        if "modelPath" not in kwargs or not kwargs["modelPath"]:
+            raise ValueError("serving config requires modelPath")
+        self.model_path = kwargs["modelPath"]
+        merged = {**_DEFAULTS, **kwargs}
+        self.job_name = str(merged["jobName"])
+        self.protocol = str(merged["protocol"]).lower()
+        if self.protocol not in ("http", "grpc", "both"):
+            raise ValueError("protocol must be http, grpc or both")
+        self.host = str(merged["host"])
+        self.port = int(merged["port"])
+        self.grpc_port = int(merged["grpcPort"])
+        self.model_parallelism = int(merged["modelParallelism"])
+        self.max_batch_size = int(merged["maxBatchSize"])
+        self.batch_timeout_ms = float(merged["batchTimeoutMs"])
+        self.quantize = bool(merged["quantize"])
+        self.model_class = merged["modelClass"]
+
+    @staticmethod
+    def load(path: str) -> "ServingConfig":
+        import yaml
+
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        if not isinstance(raw, dict):
+            raise ValueError(f"{path} must contain a YAML mapping")
+        return ServingConfig(**raw)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"modelPath": self.model_path, "jobName": self.job_name,
+                "protocol": self.protocol, "host": self.host,
+                "port": self.port, "grpcPort": self.grpc_port,
+                "modelParallelism": self.model_parallelism,
+                "maxBatchSize": self.max_batch_size,
+                "batchTimeoutMs": self.batch_timeout_ms,
+                "quantize": self.quantize,
+                "modelClass": self.model_class}
+
+
+def start_serving(config: "ServingConfig | str", block: bool = False,
+                  model_cls=None):
+    """Bring up serving from a config (path or object) — the
+    `cluster-serving-start` analog.  Returns the started frontend(s):
+    {"http": ServingServer?, "grpc": GrpcServingFrontend?, "model":
+    InferenceModel}."""
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    if isinstance(config, str):
+        config = ServingConfig.load(config)
+    cls = model_cls
+    if cls is None and config.model_class:
+        from analytics_zoo_tpu.serving.inference_model import (
+            _find_zoo_model_class)
+        cls = _find_zoo_model_class(config.model_class)
+    model = InferenceModel(
+        supported_concurrent_num=config.model_parallelism,
+        max_batch_size=config.max_batch_size)
+    model.load_model(config.model_path, model_cls=cls,
+                     quantize=config.quantize)
+
+    # the ServingServer owns the dynamic batcher; frontends are ingress
+    # into the same batcher (reference: REST and gRPC frontends share
+    # one Flink serving stream).  protocol=grpc starts batcher-only —
+    # no HTTP port is bound or served
+    from analytics_zoo_tpu.serving.server import ServingServer
+    serve_http = config.protocol in ("http", "both")
+    srv = ServingServer(model, host=config.host,
+                        port=config.port if serve_http else 0,
+                        max_batch_size=config.max_batch_size,
+                        batch_timeout_ms=config.batch_timeout_ms)
+    srv.start(block=False, http=serve_http)
+    out: Dict[str, Any] = {"model": model}
+    if serve_http:
+        out["http"] = srv
+    else:
+        out["_batcher"] = srv   # still needs stop()
+    if config.protocol in ("grpc", "both"):
+        from analytics_zoo_tpu.serving.grpc_frontend import (
+            GrpcServingFrontend)
+        out["grpc"] = GrpcServingFrontend(
+            srv, host=config.host, port=config.grpc_port).start()
+    if block:
+        import time as _time
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            stop_serving(out)
+    return out
+
+
+def stop_serving(servers: Dict[str, Any]) -> None:
+    for key in ("http", "grpc", "_batcher"):
+        srv = servers.get(key)
+        if srv is not None:
+            srv.stop()

@@ -230,17 +230,27 @@ class ServingServer:
 
     # ------------------------------------------------------------------
 
-    def start(self, block: bool = False):
+    def start(self, block: bool = False, http: bool = True):
+        """Start the dynamic batcher (always) and, with `http=True`, the
+        HTTP ingress.  `http=False` runs batcher-only — for deployments
+        where another frontend (gRPC) is the sole ingress."""
         t1 = threading.Thread(target=self._batcher, daemon=True)
-        t2 = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t1.start()
-        t2.start()
-        self._threads = [t1, t2]
-        if block:
-            t2.join()
+        self._threads = [t1]
+        self._http_started = http
+        if http:
+            t2 = threading.Thread(target=self._httpd.serve_forever,
+                                  daemon=True)
+            t2.start()
+            self._threads.append(t2)
+            if block:
+                t2.join()
         return self
 
     def stop(self):
         self._stop.set()
-        self._httpd.shutdown()
+        # shutdown() blocks on the serve_forever loop — only valid when
+        # that loop actually ran (http=False starts batcher-only)
+        if getattr(self, "_http_started", True):
+            self._httpd.shutdown()
         self._httpd.server_close()

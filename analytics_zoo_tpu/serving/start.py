@@ -1,0 +1,33 @@
+"""`python -m analytics_zoo_tpu.serving.start -c config.yaml` — the
+reference's `cluster-serving-start` script
+(`scripts/cluster-serving/cluster-serving-start` submitting
+ClusterServing.scala:108 with a parsed config.yaml)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Start analytics_zoo_tpu model serving")
+    ap.add_argument("-c", "--config", required=True,
+                    help="path to config.yaml")
+    ap.add_argument("--no-block", action="store_true",
+                    help="return instead of serving forever")
+    args = ap.parse_args(argv)
+
+    from analytics_zoo_tpu.serving.config import ServingConfig, \
+        start_serving
+
+    cfg = ServingConfig.load(args.config)
+    servers = start_serving(cfg, block=not args.no_block)
+    if args.no_block:
+        ports = {k: getattr(v, "port", None) for k, v in servers.items()
+                 if k != "model"}
+        print(f"serving '{cfg.job_name}' started: {ports}")
+    return servers
+
+
+if __name__ == "__main__":
+    main()

@@ -129,3 +129,17 @@ def test_device_store_with_everyepoch_checkpoint(tmp_path):
     import os
     assert any("ckpt" in f or "epoch" in f or f.endswith(".pkl")
                for f in os.listdir(tmp_path))
+
+
+def test_device_store_matches_host_path_non_divisible_batch():
+    # batch 33 on the 8-device mesh: host path runs ceil(203/33)=7 steps
+    # of 33 real rows; the DEVICE tier must do exactly the same
+    e_host, x, y = _fit("DRAM", shuffle=False, epochs=2, batch=33)
+    e_dev, _, _ = _fit("DEVICE", shuffle=False, epochs=2, batch=33)
+    import numpy as _np
+    s_host = int(_np.asarray(e_host._engine.state.step))
+    s_dev = int(_np.asarray(e_dev._engine.state.step))
+    assert s_dev == s_host == 2 * -(-203 // 33)
+    h = [s["loss"] for s in e_host.train_summary]
+    d = [s["loss"] for s in e_dev.train_summary]
+    np.testing.assert_allclose(d, h, rtol=1e-5)

@@ -1,0 +1,106 @@
+"""Serving config.yaml + start CLI (reference
+scripts/cluster-serving/config.yaml, serving/utils/ConfigParser.scala,
+cluster-serving-start)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.serving import (
+    InputQueue,
+    ServingConfig,
+    start_serving,
+    stop_serving,
+)
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context(cluster_mode="local")
+    yield
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="modelPath"):
+        ServingConfig(jobName="x")
+    with pytest.raises(ValueError, match="unknown"):
+        ServingConfig(modelPath="/m", redisUrl="localhost:6379")
+    with pytest.raises(ValueError, match="protocol"):
+        ServingConfig(modelPath="/m", protocol="flink")
+    cfg = ServingConfig(modelPath="/m", modelParallelism=2,
+                        quantize=True)
+    d = cfg.to_dict()
+    assert d["modelParallelism"] == 2 and d["quantize"] is True
+
+
+def _save_model(tmp_path):
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 50, size=(16, 10))
+    y = (toks[:, 0] % 2).astype(np.int32)
+    model = TextClassifier(class_num=2, vocab_size=50, embed_dim=8,
+                           sequence_length=10, encoder="cnn",
+                           encoder_output_dim=16)
+    est = model.estimator(learning_rate=1e-2)
+    est.fit({"x": toks, "y": y}, epochs=1, batch_size=16)
+    return model.save_model(str(tmp_path / "model")), toks
+
+
+def test_start_serving_from_yaml(tmp_path):
+    import yaml
+
+    model_path, toks = _save_model(tmp_path)
+    cfg_path = str(tmp_path / "config.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump({"modelPath": model_path, "jobName": "t",
+                        "port": 0, "modelParallelism": 2,
+                        "maxBatchSize": 16, "quantize": True,
+                        "protocol": "http"}, f)
+    servers = start_serving(cfg_path)
+    try:
+        http = servers["http"]
+        out = InputQueue(http.host, http.port).predict(
+            toks.astype(np.int32), batched=True)
+        assert np.asarray(out).shape == (16, 2)
+    finally:
+        stop_serving(servers)
+
+
+def test_start_cli_no_block(tmp_path):
+    import yaml
+
+    from analytics_zoo_tpu.serving.start import main
+
+    model_path, toks = _save_model(tmp_path)
+    cfg_path = str(tmp_path / "config.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump({"modelPath": model_path, "port": 0,
+                        "grpcPort": 0, "protocol": "both"}, f)
+    servers = main(["-c", cfg_path, "--no-block"])
+    try:
+        assert "http" in servers and "grpc" in servers
+        from analytics_zoo_tpu.serving import GrpcInputQueue
+        out = GrpcInputQueue(port=servers["grpc"].port).predict(
+            toks.astype(np.int32), batched=True)
+        assert np.asarray(out).shape == (16, 2)
+    finally:
+        stop_serving(servers)
+
+
+def test_grpc_only_binds_no_fixed_http_port(tmp_path):
+    import yaml
+
+    model_path, toks = _save_model(tmp_path)
+    cfg_path = str(tmp_path / "config.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump({"modelPath": model_path, "protocol": "grpc",
+                        "grpcPort": 0}, f)
+    servers = start_serving(cfg_path)
+    try:
+        assert "http" not in servers
+        from analytics_zoo_tpu.serving import GrpcInputQueue
+        out = GrpcInputQueue(port=servers["grpc"].port).predict(
+            toks.astype(np.int32), batched=True)
+        assert np.asarray(out).shape == (16, 2)
+    finally:
+        stop_serving(servers)
