@@ -151,6 +151,11 @@ class TransformerEncoder(nn.Module):
     with_pooler: bool = False
     attn_impl: str = "auto"
     scan_layers: bool = True
+    #: rematerialize each block's activations in the backward pass
+    #: (jax.checkpoint): ~n_block-fold cut in saved activations for
+    #: ~1/3 more FLOPs — the standard TPU trade that unlocks large
+    #: batch/sequence training (SURVEY.md: HBM is the usual bottleneck)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, input_ids, segment_ids=None, position_ids=None,
@@ -176,6 +181,19 @@ class TransformerEncoder(nn.Module):
         # pass the raw [b, t] key-validity mask down: each attention impl
         # (einsum/flash/ring) lowers it appropriately
         mask = attention_mask
+        block_cls = TransformerBlock
+        if self.remat:
+            # scan-over-remat: checkpoint each block's boundary so the
+            # backward pass recomputes block internals instead of
+            # keeping them live; static_argnums pins the python-bool
+            # `training` arg (index 3 — the module instance is arg 0).
+            # prevent_cse=False is only safe under scan (the loop
+            # structure already blocks CSE); the unrolled path keeps the
+            # default, else XLA could CSE the recomputation back into
+            # the saved forward and quietly forfeit the memory savings
+            block_cls = nn.remat(
+                TransformerBlock, static_argnums=(3,),
+                prevent_cse=not (self.scan_layers and self.n_block > 0))
         if self.scan_layers and self.n_block > 0:
             def body(block, carry, _):
                 return block(carry, mask, training), None
@@ -186,7 +204,7 @@ class TransformerEncoder(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 length=self.n_block)
             x, _ = scan(
-                TransformerBlock(
+                block_cls(
                     self.hidden_size, self.n_head,
                     self.intermediate_size, self.attn_dropout,
                     self.residual_dropout, self.causal,
@@ -194,7 +212,7 @@ class TransformerEncoder(nn.Module):
                 x, None)
         else:
             for i in range(self.n_block):
-                x = TransformerBlock(
+                x = block_cls(
                     self.hidden_size, self.n_head, self.intermediate_size,
                     self.attn_dropout, self.residual_dropout, self.causal,
                     attn_impl=self.attn_impl,

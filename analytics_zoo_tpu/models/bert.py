@@ -43,6 +43,7 @@ class BERTClassifier(nn.Module, ZooModel):
     hidden_drop: float = 0.1
     attn_drop: float = 0.1
     attn_impl: str = "auto"
+    remat: bool = False
 
     default_loss = "sparse_categorical_crossentropy"
     default_metrics = ("accuracy",)
@@ -59,6 +60,7 @@ class BERTClassifier(nn.Module, ZooModel):
             attn_dropout=self.attn_drop,
             residual_dropout=self.hidden_drop,
             causal=False, with_pooler=True, attn_impl=self.attn_impl,
+            remat=self.remat,
             name="bert")(input_ids, segment_ids, None, attention_mask,
                          training)
         pooled = nn.Dropout(self.hidden_drop)(pooled,
@@ -82,6 +84,7 @@ class BERTNER(nn.Module, ZooModel):
     max_position_len: int = 512
     hidden_drop: float = 0.1
     attn_impl: str = "auto"
+    remat: bool = False
 
     default_loss = "sparse_categorical_crossentropy"
     default_metrics = ("accuracy",)
@@ -98,6 +101,7 @@ class BERTNER(nn.Module, ZooModel):
             attn_dropout=self.hidden_drop,
             residual_dropout=self.hidden_drop,
             causal=False, with_pooler=False, attn_impl=self.attn_impl,
+            remat=self.remat,
             name="bert")(input_ids, segment_ids, None, attention_mask,
                          training)
         seq = nn.Dropout(self.hidden_drop)(seq, deterministic=not training)
@@ -120,6 +124,7 @@ class BERTSQuAD(nn.Module, ZooModel):
     max_position_len: int = 512
     hidden_drop: float = 0.1
     attn_impl: str = "auto"
+    remat: bool = False
 
     default_loss = "sparse_categorical_crossentropy"
     default_metrics = ()
@@ -136,6 +141,7 @@ class BERTSQuAD(nn.Module, ZooModel):
             attn_dropout=self.hidden_drop,
             residual_dropout=self.hidden_drop,
             causal=False, with_pooler=False, attn_impl=self.attn_impl,
+            remat=self.remat,
             name="bert")(input_ids, segment_ids, None, attention_mask,
                          training)
         logits = nn.Dense(2, name="span_head")(seq)     # [b, t, 2]

@@ -123,10 +123,16 @@ def start_serving(config: "ServingConfig | str", block: bool = False,
     else:
         out["_batcher"] = srv   # still needs stop()
     if config.protocol in ("grpc", "both"):
-        from analytics_zoo_tpu.serving.grpc_frontend import (
-            GrpcServingFrontend)
-        out["grpc"] = GrpcServingFrontend(
-            srv, host=config.host, port=config.grpc_port).start()
+        try:
+            from analytics_zoo_tpu.serving.grpc_frontend import (
+                GrpcServingFrontend)
+            out["grpc"] = GrpcServingFrontend(
+                srv, host=config.host, port=config.grpc_port).start()
+        except Exception:
+            # don't leak the already-running batcher/HTTP server (and
+            # its bound port) when the gRPC frontend can't come up
+            stop_serving(out)
+            raise
     if block:
         import time as _time
         try:

@@ -243,8 +243,10 @@ class ServingServer:
                                   daemon=True)
             t2.start()
             self._threads.append(t2)
-            if block:
-                t2.join()
+        if block:
+            # batcher-only mode blocks on the batcher thread (it exits
+            # on stop()); http mode blocks on the serving loop
+            self._threads[-1].join()
         return self
 
     def stop(self):
@@ -254,3 +256,12 @@ class ServingServer:
         if getattr(self, "_http_started", True):
             self._httpd.shutdown()
         self._httpd.server_close()
+        # wake requests still queued behind the (now stopped) batcher:
+        # their handler threads block on event.wait() with no timeout
+        try:
+            while True:
+                p = self._queue.get_nowait()
+                p.error = "server stopped"
+                p.event.set()
+        except queue.Empty:
+            pass

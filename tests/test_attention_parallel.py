@@ -216,3 +216,33 @@ def test_mha_key_mask_all_impls_agree():
             np.testing.assert_allclose(a, b, atol=2e-4, err_msg=impl)
     finally:
         OrcaContextMeta._mesh, OrcaContextMeta._initialized = prev
+
+
+def test_remat_encoder_matches_no_remat():
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.keras.layers.self_attention import (
+        TransformerEncoder)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (4, 16)).astype(np.int32)
+    kw = dict(vocab=64, hidden_size=32, n_head=4, n_block=2,
+              intermediate_size=64, max_position_len=16,
+              embedding_dropout=0.0, attn_dropout=0.0,
+              residual_dropout=0.0)
+    enc = TransformerEncoder(**kw)
+    enc_r = TransformerEncoder(remat=True, **kw)
+    params = enc.init(jax.random.PRNGKey(0), ids)["params"]
+
+    def loss(m, p):
+        return jnp.sum(m.apply({"params": p}, ids,
+                               training=True) ** 2)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(enc, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(enc_r, p))(params)
+    # remat changes WHEN activations are computed, never WHAT
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
