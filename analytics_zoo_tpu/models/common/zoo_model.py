@@ -60,14 +60,32 @@ class ZooModel:
         return self._require_estimator().evaluate(data, **kwargs)
 
     # -- save/load (reference ZooModel.saveModel/loadModel) --
-    def save_model(self, path: str):
+    def save_model(self, path: str, encrypt_key: str = None):
+        """With `encrypt_key`, weights are written encrypted at rest
+        (weights.pkl.enc — reference EncryptSupportive.scala model
+        encryption); load with the same key."""
         est = self._require_estimator()
         os.makedirs(path, exist_ok=True)
         params = est.get_model()
         model_state = est.get_model_state()
-        with open(os.path.join(path, "weights.pkl"), "wb") as f:
-            pickle.dump({"params": params, "model_state": model_state}, f,
-                        protocol=pickle.HIGHEST_PROTOCOL)
+        blob = pickle.dumps({"params": params,
+                             "model_state": model_state},
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        enc_path = os.path.join(path, "weights.pkl.enc")
+        plain_path = os.path.join(path, "weights.pkl")
+        if encrypt_key is not None:
+            from analytics_zoo_tpu.serving.encrypt import encrypt_bytes
+            with open(enc_path, "wb") as f:
+                f.write(encrypt_bytes(blob, encrypt_key))
+            other = plain_path
+        else:
+            with open(plain_path, "wb") as f:
+                f.write(blob)
+            other = enc_path
+        # a re-save must not leave the other variant behind: loaders
+        # prefer .enc, so a stale one would shadow fresh weights
+        if os.path.exists(other):
+            os.remove(other)
         with open(os.path.join(path, "config.pkl"), "wb") as f:
             pickle.dump({"class": type(self).__name__,
                          "config": self.get_config()}, f)
@@ -83,13 +101,27 @@ class ZooModel:
         return {}
 
     @classmethod
-    def load_model(cls, path: str):
+    def load_model(cls, path: str, decrypt_key: str = None):
         with open(os.path.join(path, "config.pkl"), "rb") as f:
             meta = pickle.load(f)
-        with open(os.path.join(path, "weights.pkl"), "rb") as f:
-            saved = pickle.load(f)
+        saved = _read_weights(path, decrypt_key)
         model = cls(**meta["config"])
         est = model.estimator()
         est._params = saved["params"]
         est._model_state = saved.get("model_state") or {}
         return model
+
+
+def _read_weights(path: str, decrypt_key: str = None) -> Dict[str, Any]:
+    """Read weights.pkl / weights.pkl.enc from a save_model dir."""
+    enc = os.path.join(path, "weights.pkl.enc")
+    plain = os.path.join(path, "weights.pkl")
+    if os.path.exists(enc):
+        if decrypt_key is None:
+            raise ValueError(
+                f"{enc} is encrypted at rest; pass decrypt_key")
+        from analytics_zoo_tpu.serving.encrypt import decrypt_bytes
+        with open(enc, "rb") as f:
+            return pickle.loads(decrypt_bytes(f.read(), decrypt_key))
+    with open(plain, "rb") as f:
+        return pickle.load(f)

@@ -94,6 +94,74 @@ def poisson(preds, labels):
     return (p - y * jnp.log(p + 1e-7)).mean(axis=-1)
 
 
+def squared_hinge(preds, labels):
+    """(reference objectives.py SquaredHinge; same {0,1}/{-1,1} label
+    handling as hinge)."""
+    p, y = _first(preds), _first(labels)
+    p = p.reshape(p.shape[0], -1)
+    y = y.reshape(y.shape[0], -1).astype(p.dtype)
+    y = jnp.where(jnp.min(y) >= 0, 2.0 * y - 1.0, y)
+    return (jnp.maximum(0.0, 1.0 - y * p) ** 2).mean(axis=-1)
+
+
+def cosine_proximity(preds, labels):
+    """Negative cosine similarity (reference objectives.py
+    CosineProximity)."""
+    p, y = _first(preds), _first(labels)
+    p = p.reshape(p.shape[0], -1)
+    y = y.reshape(y.shape[0], -1).astype(p.dtype)
+    pn = p / jnp.maximum(jnp.linalg.norm(p, axis=-1, keepdims=True), 1e-8)
+    yn = y / jnp.maximum(jnp.linalg.norm(y, axis=-1, keepdims=True), 1e-8)
+    return -(pn * yn).sum(axis=-1)
+
+
+def mean_absolute_percentage_error(preds, labels):
+    """(reference objectives.py MeanAbsolutePercentageError)."""
+    p, y = _first(preds), _first(labels)
+    p = p.reshape(p.shape[0], -1)
+    y = y.reshape(y.shape[0], -1).astype(p.dtype)
+    return (100.0 * jnp.abs(p - y)
+            / jnp.maximum(jnp.abs(y), 1e-7)).mean(axis=-1)
+
+
+def mean_squared_logarithmic_error(preds, labels):
+    """(reference objectives.py MeanSquaredLogarithmicError)."""
+    p, y = _first(preds), _first(labels)
+    p = p.reshape(p.shape[0], -1)
+    y = y.reshape(y.shape[0], -1).astype(p.dtype)
+    return ((jnp.log1p(jnp.maximum(p, 0.0))
+             - jnp.log1p(jnp.maximum(y, 0.0))) ** 2).mean(axis=-1)
+
+
+def log_cosh(preds, labels):
+    p, y = _first(preds), _first(labels)
+    p = p.reshape(p.shape[0], -1)
+    y = y.reshape(y.shape[0], -1).astype(p.dtype)
+    d = p - y
+    # numerically stable log(cosh(d)) = d + softplus(-2d) - log 2
+    return (d + jax.nn.softplus(-2.0 * d)
+            - jnp.log(2.0)).mean(axis=-1)
+
+
+def rank_hinge(preds, labels, margin: float = 1.0):
+    """Pairwise ranking hinge over (positive, negative) consecutive row
+    pairs — the text-matching objective (reference objectives.py
+    RankHinge:269; rows must alternate pos, neg like the reference's
+    pairwise TextSet relations).  Returns one loss per PAIR, repeated
+    per row so the engine's per-example weighting stays valid."""
+    p = _first(preds)
+    if p.shape[0] % 2:
+        raise ValueError(
+            f"rank_hinge needs an even batch of (pos, neg) row pairs, "
+            f"got {p.shape[0]} rows; use an even batch_size and "
+            "pairwise-ordered data")
+    p = p.reshape(p.shape[0], -1)[:, 0]     # one score per row
+    pos = p[0::2]
+    neg = p[1::2]
+    pair = jnp.maximum(0.0, margin - pos + neg)
+    return jnp.repeat(pair, 2)
+
+
 _REGISTRY = {
     "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
     "categorical_crossentropy": categorical_crossentropy,
@@ -104,6 +172,15 @@ _REGISTRY = {
     "mean_absolute_error": mean_absolute_error,
     "huber": huber,
     "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "rank_hinge": rank_hinge,
+    "cosine_proximity": cosine_proximity,
+    "mape": mean_absolute_percentage_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "msle": mean_squared_logarithmic_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "logcosh": log_cosh,
+    "log_cosh": log_cosh,
     "kld": kld,
     "kullback_leibler_divergence": kld,
     "poisson": poisson,

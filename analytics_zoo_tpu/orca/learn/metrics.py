@@ -79,16 +79,30 @@ class BinaryAccuracy(Metric):
         return jnp.all(yhat == y, axis=-1).astype(jnp.float32)
 
 
-class Top5Accuracy(Metric):
-    name = "top5_accuracy"
+class TopKCategoricalAccuracy(Metric):
+    """Hit if the true class ranks in the top `k` predictions
+    (reference Top5Accuracy generalized; metrics.py Top5Accuracy)."""
+
+    def __init__(self, k: int = 5):
+        self.k = int(k)
+        if self.k < 1:
+            # k=0 would slice [..., -0:] == the whole class axis and
+            # report a constant 1.0
+            raise ValueError(f"top-k accuracy needs k >= 1, got {k}")
+        self.name = f"top{self.k}_accuracy"
 
     def __call__(self, preds, labels):
         p, y = _first(preds), _first(labels)
         if y.ndim == p.ndim:
             y = jnp.argmax(y, axis=-1)
-        top5 = jnp.argsort(p, axis=-1)[..., -5:]
-        return jnp.any(top5 == y[..., None].astype(top5.dtype),
+        topk = jnp.argsort(p, axis=-1)[..., -self.k:]
+        return jnp.any(topk == y[..., None].astype(topk.dtype),
                        axis=-1).astype(jnp.float32)
+
+
+class Top5Accuracy(TopKCategoricalAccuracy):
+    def __init__(self):
+        super().__init__(k=5)
 
 
 class MAE(Metric):
@@ -120,6 +134,13 @@ _REGISTRY = {
     "mae": MAE,
     "mse": MSE,
 }
+# "top3_accuracy"-style names resolve to TopKCategoricalAccuracy(k)
+import re as _re  # noqa: E402
+
+
+def _topk_from_name(key: str):
+    m = _re.fullmatch(r"top(\d+)_?accuracy", key)
+    return TopKCategoricalAccuracy(int(m.group(1))) if m else None
 
 
 def resolve(metric) -> Metric:
@@ -131,8 +152,12 @@ def resolve(metric) -> Metric:
     if isinstance(metric, str):
         key = metric.lower()
         if key not in _REGISTRY:
+            topk = _topk_from_name(key)
+            if topk is not None:
+                return topk
             raise ValueError(f"unknown metric '{metric}'; "
-                             f"known: {sorted(_REGISTRY)}")
+                             f"known: {sorted(_REGISTRY)} or "
+                             "'top<k>_accuracy'")
         return _REGISTRY[key]()
     if callable(metric):
         return _FnMetric(metric, getattr(metric, "__name__", "metric"))

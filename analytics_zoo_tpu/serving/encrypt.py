@@ -1,0 +1,90 @@
+"""Encrypt-at-rest for saved models (reference
+`zoo/src/main/scala/.../pipeline/inference/EncryptSupportive.scala` —
+AES-encrypted model files loaded by InferenceModel).
+
+Stdlib-only authenticated stream cipher: PBKDF2-HMAC-SHA256 key
+derivation, an HMAC-SHA256 counter-mode keystream (CTR over
+HMAC(key, nonce||counter) blocks), and an encrypt-then-MAC integrity
+tag.  No external crypto dependency is available in the image; this
+construction is standard PRF-CTR + EtM.  Layout:
+``b"AZTE1" | salt(16) | nonce(16) | tag(32) | ciphertext``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+
+import numpy as np
+
+_MAGIC = b"AZTE1"
+_ITERS = 100_000
+_BLOCK = 32  # sha256 digest size
+
+
+def _derive(key: str, salt: bytes):
+    """(k_enc, k_mac) — domain-separated so keystream PRF inputs and
+    MAC inputs can never collide (an 8-byte ciphertext equal to a
+    counter encoding would otherwise make the tag equal a keystream
+    block)."""
+    k = hashlib.pbkdf2_hmac("sha256", key.encode("utf-8"), salt, _ITERS)
+    k_enc = hmac.new(k, b"enc", hashlib.sha256).digest()
+    k_mac = hmac.new(k, b"mac", hashlib.sha256).digest()
+    return k_enc, k_mac
+
+
+def _keystream(k: bytes, nonce: bytes, n: int) -> bytes:
+    out = bytearray()
+    for counter in range(-(-n // _BLOCK)):
+        out += hmac.new(k, nonce + counter.to_bytes(8, "big"),
+                        hashlib.sha256).digest()
+    return bytes(out[:n])
+
+
+def _xor(data: bytes, ks: bytes) -> bytes:
+    return (np.frombuffer(data, np.uint8)
+            ^ np.frombuffer(ks, np.uint8)).tobytes()
+
+
+def encrypt_bytes(data: bytes, key: str) -> bytes:
+    salt = os.urandom(16)
+    nonce = os.urandom(16)
+    k_enc, k_mac = _derive(key, salt)
+    ct = _xor(data, _keystream(k_enc, nonce, len(data)))
+    tag = hmac.new(k_mac, nonce + ct, hashlib.sha256).digest()
+    return _MAGIC + salt + nonce + tag + ct
+
+
+def is_encrypted(blob: bytes) -> bool:
+    return blob[:len(_MAGIC)] == _MAGIC
+
+
+def decrypt_bytes(blob: bytes, key: str) -> bytes:
+    if not is_encrypted(blob):
+        raise ValueError("not an AZTE1-encrypted blob")
+    off = len(_MAGIC)
+    salt = blob[off:off + 16]
+    nonce = blob[off + 16:off + 32]
+    tag = blob[off + 32:off + 64]
+    ct = blob[off + 64:]
+    k_enc, k_mac = _derive(key, salt)
+    expect = hmac.new(k_mac, nonce + ct, hashlib.sha256).digest()
+    if not hmac.compare_digest(tag, expect):
+        raise ValueError("decryption failed: wrong key or corrupted "
+                         "file (integrity tag mismatch)")
+    return _xor(ct, _keystream(k_enc, nonce, len(ct)))
+
+
+def encrypt_file(path: str, key: str, out_path: str | None = None) -> str:
+    out_path = out_path or path + ".enc"
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(out_path, "wb") as f:
+        f.write(encrypt_bytes(data, key))
+    return out_path
+
+
+def decrypt_file(path: str, key: str) -> bytes:
+    with open(path, "rb") as f:
+        return decrypt_bytes(f.read(), key)

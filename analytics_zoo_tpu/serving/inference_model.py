@@ -111,18 +111,21 @@ class InferenceModel:
         return self
 
     def load_model(self, path: str, model_cls=None,
-                   quantize: bool = False):
+                   quantize: bool = False, decrypt_key: str = None):
         """Load a `ZooModel.save_model` directory (reference
         doLoadModel); `model_cls` overrides the saved class lookup;
         `quantize=True` serves int8 weights (reference doLoadBigDL's
-        quantized path)."""
+        quantized path); `decrypt_key` unlocks encrypted-at-rest
+        weights (reference EncryptSupportive)."""
         import pickle
         import os
 
+        from analytics_zoo_tpu.models.common.zoo_model import (
+            _read_weights)
+
         with open(os.path.join(path, "config.pkl"), "rb") as f:
             meta = pickle.load(f)
-        with open(os.path.join(path, "weights.pkl"), "rb") as f:
-            saved = pickle.load(f)
+        saved = _read_weights(path, decrypt_key)
         if model_cls is None:
             model_cls = _find_zoo_model_class(meta["class"])
         module = model_cls(**meta["config"])

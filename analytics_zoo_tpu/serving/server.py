@@ -68,6 +68,8 @@ class ServingServer:
         self._results_lock = threading.Lock()
         self._stop = threading.Event()
         self._batches_run = 0
+        from analytics_zoo_tpu.serving.timer import Timer
+        self.timer = Timer()
 
         server = self
 
@@ -91,6 +93,11 @@ class ServingServer:
                         "status": "ok",
                         "records_served": server.model.records_served,
                         "batches_run": server._batches_run})
+                    return
+                if self.path == "/metrics":
+                    # per-op latency histograms (reference Flink serving
+                    # Timer.scala printouts, as a scrapeable endpoint)
+                    self._json(200, server.timer.summary())
                     return
                 if self.path.startswith("/result/"):
                     uri = self.path[len("/result/"):]
@@ -207,10 +214,18 @@ class ServingServer:
         try:
             # group by input signature; same-shape single records stack
             sizes = [len(p.inputs[0]) for p in batch]
+            # record timings only on success: the heterogeneous-shape
+            # fallback re-runs per request, and counting the failed
+            # whole-batch attempt would double-book /metrics
+            t0 = time.perf_counter()
             stacked = tuple(
                 np.concatenate([p.inputs[i] for p in batch])
                 for i in range(len(batch[0].inputs)))
+            t1 = time.perf_counter()
             outs = self.model.predict(*stacked)
+            self.timer.record("batch_assemble", t1 - t0, sum(sizes))
+            self.timer.record("predict", time.perf_counter() - t1,
+                              sum(sizes))
             self._batches_run += 1
             if not isinstance(outs, tuple):
                 outs = (outs,)

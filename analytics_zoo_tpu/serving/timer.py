@@ -1,0 +1,71 @@
+"""Per-op serving timers (reference `serving/engine/Timer.scala:26-100`
+— accumulators + histogram printouts per op — and the `Supportive.timing`
+wrapper, `serving/utils/Supportive.scala:22`)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+
+class Timer:
+    """Thread-safe accumulators + bounded sample reservoirs per op."""
+
+    def __init__(self, reservoir: int = 1024):
+        self._lock = threading.Lock()
+        self._reservoir = reservoir
+        self._acc: Dict[str, Dict] = {}
+
+    @contextmanager
+    def timing(self, name: str, count: int = 1):
+        """`with timer.timing("predict", n_records): ...` — the
+        Supportive.timing analog."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, time.perf_counter() - t0, count)
+
+    def record(self, name: str, seconds: float, count: int = 1):
+        with self._lock:
+            a = self._acc.setdefault(
+                name, {"calls": 0, "records": 0, "total_s": 0.0,
+                       "samples": []})
+            a["calls"] += 1
+            a["records"] += count
+            a["total_s"] += seconds
+            s = a["samples"]
+            s.append(seconds)
+            if len(s) > self._reservoir:
+                del s[: len(s) - self._reservoir]
+
+    def summary(self) -> Dict[str, Dict]:
+        """{op: {calls, records, total_ms, avg_ms, p50_ms, p90_ms,
+        p99_ms, max_ms, records_per_s}} — the Timer.print histogram as
+        data."""
+        out = {}
+        with self._lock:
+            for name, a in self._acc.items():
+                s = sorted(a["samples"])
+                q = (lambda p: s[min(len(s) - 1,
+                                     int(p * len(s)))] if s else 0.0)
+                total = a["total_s"]
+                out[name] = {
+                    "calls": a["calls"],
+                    "records": a["records"],
+                    "total_ms": round(total * 1e3, 3),
+                    "avg_ms": round(total / max(a["calls"], 1) * 1e3, 3),
+                    "p50_ms": round(q(0.50) * 1e3, 3),
+                    "p90_ms": round(q(0.90) * 1e3, 3),
+                    "p99_ms": round(q(0.99) * 1e3, 3),
+                    "max_ms": round((s[-1] if s else 0.0) * 1e3, 3),
+                    "records_per_s": round(a["records"] / total, 1)
+                    if total > 0 else 0.0,
+                }
+        return out
+
+    def print(self):  # reference Timer.print
+        for name, row in self.summary().items():
+            print(f"[timer] {name}: {row}")

@@ -1,0 +1,188 @@
+"""Round-2 breadth: remaining reference objectives, top-k metric, the
+automl Evaluator registry, encrypt-at-rest, serving Timer."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_orca_context(cluster_mode="local")
+    yield
+
+
+def test_new_losses_resolve_and_compute():
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.orca.learn import losses
+
+    p = jnp.asarray([[0.3, 0.7], [0.9, 0.1]])
+    y = jnp.asarray([[0.0, 1.0], [1.0, 0.0]])
+    for name in ("squared_hinge", "cosine_proximity", "mape", "msle",
+                 "logcosh", "rank_hinge"):
+        fn = losses.resolve(name)
+        out = np.asarray(fn(p, y))
+        assert out.shape[0] == 2 and np.isfinite(out).all(), name
+    # cosine of identical vectors = -1 (proximity is negated similarity)
+    cp = np.asarray(losses.cosine_proximity(y, y))
+    np.testing.assert_allclose(cp, -1.0, atol=1e-6)
+    # rank_hinge: pos >> neg -> 0 loss; neg >> pos -> margin-ish
+    rh = np.asarray(losses.rank_hinge(jnp.asarray([5.0, -5.0]), None))
+    np.testing.assert_allclose(rh, 0.0)
+
+
+def test_topk_metric_names():
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.orca.learn import metrics
+
+    m = metrics.resolve("top3_accuracy")
+    assert m.get_name() == "top3_accuracy"
+    p = jnp.asarray([[0.1, 0.2, 0.3, 0.4], [0.4, 0.3, 0.2, 0.1]])
+    y = jnp.asarray([1, 3])
+    vals = np.asarray(m(p, y))
+    np.testing.assert_array_equal(vals, [1.0, 0.0])
+    with pytest.raises(ValueError):
+        metrics.resolve("topnope_accuracy")
+
+
+def test_evaluator_registry():
+    from analytics_zoo_tpu.orca.automl.metrics import AUC, Evaluator
+
+    y = np.array([0.0, 1.0, 1.0, 0.0])
+    p = np.array([0.1, 0.8, 0.6, 0.4])
+    assert Evaluator.evaluate("auc", y, p) == 1.0
+    assert Evaluator.evaluate("accuracy", y, p) == 1.0
+    assert Evaluator.get_metric_mode("rmse") == "min"
+    assert Evaluator.get_metric_mode("f1") == "max"
+    with pytest.raises(ValueError):
+        Evaluator.check_metric("nope")
+    # perfect separation = 1.0; anti-separation = 0.0; ties = 0.5
+    assert AUC(y, 1 - p) == 0.0
+    assert AUC(y, np.zeros(4)) == 0.5
+    # smape symmetric: swapping args preserves value
+    a = Evaluator.evaluate("smape", y + 1, p + 1, "uniform_average")
+    b = Evaluator.evaluate("smape", p + 1, y + 1, "uniform_average")
+    assert abs(a - b) < 1e-9
+    # multioutput raw vs averaged
+    yt = np.stack([y, y], 1)
+    yp = np.stack([p, p + 0.1], 1)
+    raw = Evaluator.evaluate("mae", yt, yp)
+    assert raw.shape == (2,)
+    avg = Evaluator.evaluate("mae", yt, yp, "uniform_average")
+    assert abs(avg - raw.mean()) < 1e-12
+
+
+def test_encrypt_roundtrip_and_tamper():
+    from analytics_zoo_tpu.serving.encrypt import (
+        decrypt_bytes, encrypt_bytes, is_encrypted)
+
+    data = np.random.default_rng(0).bytes(100_000)
+    blob = encrypt_bytes(data, "secret")
+    assert is_encrypted(blob) and blob != data
+    assert decrypt_bytes(blob, "secret") == data
+    with pytest.raises(ValueError, match="integrity|wrong key"):
+        decrypt_bytes(blob, "wrong")
+    tampered = blob[:-1] + bytes([blob[-1] ^ 1])
+    with pytest.raises(ValueError):
+        decrypt_bytes(tampered, "secret")
+
+
+def test_encrypted_model_save_load(tmp_path):
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 50, size=(16, 10))
+    y = (toks[:, 0] % 2).astype(np.int32)
+    model = TextClassifier(class_num=2, vocab_size=50, embed_dim=8,
+                           sequence_length=10, encoder="cnn",
+                           encoder_output_dim=16)
+    est = model.estimator(learning_rate=1e-2)
+    est.fit({"x": toks, "y": y}, epochs=1, batch_size=16)
+    p_ref = np.asarray(est.predict({"x": toks}))
+    path = model.save_model(str(tmp_path / "m"), encrypt_key="k3y")
+    import os
+    assert os.path.exists(os.path.join(path, "weights.pkl.enc"))
+    assert not os.path.exists(os.path.join(path, "weights.pkl"))
+
+    with pytest.raises(ValueError, match="decrypt_key"):
+        TextClassifier.load_model(path)
+    loaded = TextClassifier.load_model(path, decrypt_key="k3y")
+    np.testing.assert_allclose(np.asarray(loaded.predict({"x": toks})),
+                               p_ref, atol=1e-5)
+    im = InferenceModel().load_model(path, decrypt_key="k3y")
+    np.testing.assert_allclose(im.predict(toks), p_ref, atol=1e-5)
+
+
+def test_serving_timer_metrics_endpoint():
+    import flax.linen as nn
+    import jax
+    import json
+    from urllib.request import urlopen
+
+    from analytics_zoo_tpu.serving import InferenceModel, InputQueue
+    from analytics_zoo_tpu.serving.server import ServingServer
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    m = M()
+    x = np.ones((4, 8), np.float32)
+    params = jax.device_get(m.init(jax.random.PRNGKey(0), x))["params"]
+    im = InferenceModel().load_flax(m, params)
+    srv = ServingServer(im, port=0).start()
+    try:
+        InputQueue(srv.host, srv.port).predict(x, batched=True)
+        stats = json.loads(urlopen(
+            f"http://{srv.host}:{srv.port}/metrics").read())
+        assert stats["predict"]["calls"] >= 1
+        assert stats["predict"]["records"] >= 4
+        assert stats["predict"]["p50_ms"] >= 0
+    finally:
+        srv.stop()
+
+
+def test_rank_hinge_rejects_odd_batch():
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.orca.learn import losses
+    with pytest.raises(ValueError, match="even batch"):
+        losses.rank_hinge(jnp.asarray([1.0, 2.0, 3.0]), None)
+
+
+def test_top0_accuracy_rejected():
+    from analytics_zoo_tpu.orca.learn import metrics
+    with pytest.raises(ValueError, match="k >= 1"):
+        metrics.resolve("top0_accuracy")
+
+
+def test_auc_tie_averaging_large_fast():
+    import time as _t
+    from analytics_zoo_tpu.orca.automl.metrics import AUC
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, 200_000)
+    p = np.round(rng.random(200_000), 3)  # heavy ties
+    t0 = _t.perf_counter()
+    v = AUC(y, p)
+    assert _t.perf_counter() - t0 < 2.0
+    assert 0.45 < v < 0.55  # random scores ~ 0.5
+
+
+def test_plaintext_resave_removes_stale_encrypted(tmp_path):
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 50, size=(16, 10))
+    y = (toks[:, 0] % 2).astype(np.int32)
+    model = TextClassifier(class_num=2, vocab_size=50, embed_dim=8,
+                           sequence_length=10, encoder="cnn",
+                           encoder_output_dim=16)
+    est = model.estimator(learning_rate=1e-2)
+    est.fit({"x": toks, "y": y}, epochs=1, batch_size=16)
+    path = model.save_model(str(tmp_path / "m"), encrypt_key="k")
+    model.save_model(str(tmp_path / "m"))  # plaintext re-save
+    import os
+    assert not os.path.exists(os.path.join(path, "weights.pkl.enc"))
+    loaded = TextClassifier.load_model(path)  # no key needed now
+    assert loaded is not None
