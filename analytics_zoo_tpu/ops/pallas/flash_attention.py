@@ -294,6 +294,11 @@ def flash_attention(q, k, v, *, kv_mask=None, causal: bool = False,
 
     mask_bh = None
     if kv_mask is not None:
+        if kv_mask.shape != (b, t):
+            raise ValueError(
+                f"kv_mask shape {kv_mask.shape} != (batch, t) = "
+                f"({b}, {t}); note q/k/v are [batch, t, heads, d] "
+                "(BTHD), not BHTD")
         mask_bh = jnp.repeat(kv_mask.astype(jnp.int32), h, axis=0)  # [b*h, t]
 
     block_q = min(block_q, t)

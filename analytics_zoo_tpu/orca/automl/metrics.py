@@ -116,42 +116,46 @@ def MSPE(yt, yp):
                     ).mean(axis=0)
 
 
-def _labels_from(y_true, y_pred):
+def _labels_from(y_true, y_pred, from_logits: bool):
+    """Deterministic decision rule: multi-column scores -> argmax;
+    single-column scores threshold at 0.5 (probabilities, the sklearn
+    convention and this registry's default) or at 0.0 with
+    `from_logits=True` — never inferred from batch contents, which
+    would make the metric value depend on what else is in the batch."""
     yt = np.asarray(y_true)
     yp = np.asarray(y_pred)
-    if yp.ndim > 1 and yp.shape[-1] > 1:      # logits / probabilities
+    if yp.ndim > 1 and yp.shape[-1] > 1:      # per-class scores
         yhat = yp.argmax(axis=-1)
     else:
         yp = yp.reshape(len(yp), -1)[:, 0]
-        yhat = (yp > (0.5 if ((yp >= 0) & (yp <= 1)).all() else 0.0)
-                ).astype(np.int64)
+        yhat = (yp > (0.0 if from_logits else 0.5)).astype(np.int64)
     if yt.ndim > 1 and yt.shape[-1] > 1:      # one-hot
         yt = yt.argmax(axis=-1)
     return yt.reshape(-1).astype(np.int64), yhat.reshape(-1)
 
 
-def Accuracy(y_true, y_pred, multioutput=None):
-    yt, yhat = _labels_from(y_true, y_pred)
+def Accuracy(y_true, y_pred, multioutput=None, from_logits=False):
+    yt, yhat = _labels_from(y_true, y_pred, from_logits)
     return float((yt == yhat).mean())
 
 
-def Precision(y_true, y_pred, multioutput=None):
-    yt, yhat = _labels_from(y_true, y_pred)
+def Precision(y_true, y_pred, multioutput=None, from_logits=False):
+    yt, yhat = _labels_from(y_true, y_pred, from_logits)
     tp = float(((yhat == 1) & (yt == 1)).sum())
     fp = float(((yhat == 1) & (yt == 0)).sum())
     return tp / (tp + fp) if tp + fp else 0.0
 
 
-def Recall(y_true, y_pred, multioutput=None):
-    yt, yhat = _labels_from(y_true, y_pred)
+def Recall(y_true, y_pred, multioutput=None, from_logits=False):
+    yt, yhat = _labels_from(y_true, y_pred, from_logits)
     tp = float(((yhat == 1) & (yt == 1)).sum())
     fn = float(((yhat == 0) & (yt == 1)).sum())
     return tp / (tp + fn) if tp + fn else 0.0
 
 
-def F1Score(y_true, y_pred, multioutput=None):
-    p = Precision(y_true, y_pred)
-    r = Recall(y_true, y_pred)
+def F1Score(y_true, y_pred, multioutput=None, from_logits=False):
+    p = Precision(y_true, y_pred, from_logits=from_logits)
+    r = Recall(y_true, y_pred, from_logits=from_logits)
     return 2 * p * r / (p + r) if p + r else 0.0
 
 
@@ -162,7 +166,13 @@ def AUC(y_true, y_pred, multioutput=None):
     yp = np.asarray(y_pred)
     if yp.ndim > 1 and yp.shape[-1] == 2:
         yp = yp[..., 1]                       # positive-class score
+    elif yp.ndim > 1 and yp.shape[-1] > 2:
+        raise ValueError(
+            f"AUC is binary-only; got {yp.shape[-1]} score columns")
     yp = yp.reshape(-1).astype(np.float64)
+    if len(yp) != len(yt):
+        raise ValueError(
+            f"AUC: {len(yt)} labels vs {len(yp)} scores")
     pos = yt == 1
     n_pos, n_neg = int(pos.sum()), int((~pos).sum())
     if n_pos == 0 or n_neg == 0:

@@ -47,10 +47,14 @@ class Timer:
         data."""
         out = {}
         with self._lock:
+            import math
             for name, a in self._acc.items():
                 s = sorted(a["samples"])
+                # nearest-rank percentile: ceil(p*n) - 1 (int(p*n) is
+                # one rank high — p90 of 10 samples would be the max)
                 q = (lambda p: s[min(len(s) - 1,
-                                     int(p * len(s)))] if s else 0.0)
+                                     max(0, math.ceil(p * len(s)) - 1))]
+                     if s else 0.0)
                 total = a["total_s"]
                 out[name] = {
                     "calls": a["calls"],

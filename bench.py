@@ -202,6 +202,41 @@ def bert_finetune_metrics(batch: int = 256, seq: int = 128,
     return tokens_per_s, mfu, n_params
 
 
+def longctx_flash_ms(t: int = 16384) -> float:
+    """fwd+bwd ms/step of the Pallas flash-attention kernel at a
+    sequence length where materialized-scores attention cannot even
+    compile on one chip (16k: the [T, T] f32 scores would need 8.6 GB/
+    head-batch) — the long-context capability the reference lacks
+    entirely (SURVEY.md §5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops.pallas.flash_attention import (
+        flash_attention)
+
+    b, h, d = 1, 8, 64
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (b, t, h, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, t, h, d),
+                          jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, t, h, d),
+                          jnp.bfloat16)
+    mask = jnp.ones((b, t), jnp.int32)
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v,
+                               kv_mask=mask).astype(jnp.float32).sum()
+
+    fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    out = fn(q, k, v)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = fn(q, k, v)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / 3 * 1e3
+
+
 def main():
     t_start = time.monotonic()
     # default budget leaves the BERT stage ~425s: enough for ONE cold
@@ -234,6 +269,13 @@ def main():
     raw_tput = ncf_raw_throughput(jax.devices()[0].platform, batch,
                                   steps=steps, warmup=5)
 
+    longctx = {}
+    try:  # quick (~10s warm): never risks the primary metric
+        longctx = {"flash_attention_seq16k_fwdbwd_ms":
+                   round(longctx_flash_ms(), 1)}
+    except Exception as e:
+        longctx = {"longctx_error": f"{type(e).__name__}: {e}"[:120]}
+
     cpu = None
     for cpu_batch in (batch, 4096, 512):
         try:
@@ -257,6 +299,7 @@ def main():
             # so this ratio is transfer-bound here, not framework-bound.
             "estimator_vs_raw": round(est_tput / raw_tput, 3),
             "cpu_raw_samples_per_sec": round(cpu, 1) if cpu else None,
+            **longctx,
             **bert_extra,
         },
     }))

@@ -186,3 +186,45 @@ def test_plaintext_resave_removes_stale_encrypted(tmp_path):
     assert not os.path.exists(os.path.join(path, "weights.pkl.enc"))
     loaded = TextClassifier.load_model(path)  # no key needed now
     assert loaded is not None
+
+
+def test_labels_from_deterministic_threshold():
+    from analytics_zoo_tpu.orca.automl.metrics import Accuracy
+    # probabilities in [0,1]: threshold 0.5 regardless of batch contents
+    y = np.array([1, 0, 1])
+    assert Accuracy(y, np.array([0.6, 0.4, 0.3])) == pytest.approx(2 / 3)
+    # same scores declared as logits: threshold 0.0 -> all predicted 1
+    assert Accuracy(y, np.array([0.6, 0.4, 0.3]),
+                    from_logits=True) == pytest.approx(2 / 3)
+    assert Accuracy(np.array([0, 0]), np.array([0.4, -0.1]),
+                    from_logits=True) == pytest.approx(0.5)
+
+
+def test_auc_rejects_multiclass_and_mismatch():
+    from analytics_zoo_tpu.orca.automl.metrics import AUC
+    with pytest.raises(ValueError, match="binary-only"):
+        AUC(np.array([0, 1]), np.ones((2, 3)))
+    with pytest.raises(ValueError, match="labels vs"):
+        AUC(np.array([0, 1]), np.ones(5))
+
+
+def test_timer_nearest_rank_percentiles():
+    from analytics_zoo_tpu.serving.timer import Timer
+    t = Timer()
+    for ms in range(1, 11):                  # 1..10 ms
+        t.record("op", ms / 1e3)
+    s = t.summary()["op"]
+    assert s["p50_ms"] == 5.0                 # 5th of 10
+    assert s["p90_ms"] == 9.0                 # 9th of 10, not the max
+    assert s["max_ms"] == 10.0
+
+
+def test_encrypt_large_blob_fast():
+    import time as _t
+    from analytics_zoo_tpu.serving.encrypt import (decrypt_bytes,
+                                                   encrypt_bytes)
+    data = b"\x42" * (32 * 1024 * 1024)       # 32 MB
+    t0 = _t.perf_counter()
+    blob = encrypt_bytes(data, "k")
+    assert decrypt_bytes(blob, "k") == data
+    assert _t.perf_counter() - t0 < 5.0
