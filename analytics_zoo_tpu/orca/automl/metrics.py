@@ -162,7 +162,10 @@ def F1Score(y_true, y_pred, multioutput=None, from_logits=False):
 def AUC(y_true, y_pred, multioutput=None):
     """Binary ROC-AUC via the rank statistic (Mann-Whitney U) —
     equivalent to the trapezoidal ROC integral, no sklearn needed."""
-    yt = np.asarray(y_true).reshape(-1)
+    yt = np.asarray(y_true)
+    if yt.ndim > 1 and yt.shape[-1] > 1:      # one-hot labels
+        yt = yt.argmax(axis=-1)
+    yt = yt.reshape(-1)
     yp = np.asarray(y_pred)
     if yp.ndim > 1 and yp.shape[-1] == 2:
         yp = yp[..., 1]                       # positive-class score
@@ -215,10 +218,13 @@ class Evaluator:
 
     @staticmethod
     def evaluate(metric: str, y_true, y_pred,
-                 multioutput: str = "raw_values"
+                 multioutput: str = "raw_values", **kwargs
                  ) -> Union[float, np.ndarray, Sequence[float]]:
+        """kwargs pass through to the metric (e.g. `from_logits=True`
+        for accuracy/precision/recall/f1 on single-column logits)."""
         key = Evaluator.check_metric(metric)
-        return _METRICS[key](y_true, y_pred, multioutput=multioutput)
+        return _METRICS[key](y_true, y_pred, multioutput=multioutput,
+                             **kwargs)
 
     @staticmethod
     def get_metric_mode(metric: str) -> str:

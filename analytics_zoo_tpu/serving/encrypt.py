@@ -19,6 +19,7 @@ import os
 import numpy as np
 
 _MAGIC = b"AZTE2"
+_MAGIC_V1 = b"AZTE1"   # legacy HMAC-CTR format: still decryptable
 _ITERS = 100_000
 
 
@@ -55,23 +56,39 @@ def encrypt_bytes(data: bytes, key: str) -> bytes:
 
 
 def is_encrypted(blob: bytes) -> bool:
-    return blob[:len(_MAGIC)] == _MAGIC
+    return blob[:5] in (_MAGIC, _MAGIC_V1)
+
+
+def _legacy_v1_keystream(k: bytes, nonce: bytes, n: int) -> bytes:
+    out = bytearray()
+    for counter in range(-(-n // 32)):
+        out += hmac.new(k, nonce + counter.to_bytes(8, "big"),
+                        hashlib.sha256).digest()
+    return bytes(out[:n])
 
 
 def decrypt_bytes(blob: bytes, key: str) -> bytes:
     if not is_encrypted(blob):
-        raise ValueError("not an AZTE1-encrypted blob")
-    off = len(_MAGIC)
-    salt = blob[off:off + 16]
-    nonce = blob[off + 16:off + 32]
-    tag = blob[off + 32:off + 64]
-    ct = blob[off + 64:]
-    k_enc, k_mac = _derive(key, salt)
+        raise ValueError("not an AZTE-encrypted blob")
+    v1 = blob[:5] == _MAGIC_V1
+    salt = blob[5:21]
+    nonce = blob[21:37]
+    tag = blob[37:69]
+    ct = blob[69:]
+    if v1:
+        # legacy format: one PBKDF2 key for both keystream and tag
+        k = hashlib.pbkdf2_hmac("sha256", key.encode("utf-8"), salt,
+                                _ITERS)
+        k_enc = k_mac = k
+        ks = _legacy_v1_keystream(k_enc, nonce, len(ct))
+    else:
+        k_enc, k_mac = _derive(key, salt)
+        ks = _keystream(k_enc, nonce, len(ct))
     expect = hmac.new(k_mac, nonce + ct, hashlib.sha256).digest()
     if not hmac.compare_digest(tag, expect):
         raise ValueError("decryption failed: wrong key or corrupted "
                          "file (integrity tag mismatch)")
-    return _xor(ct, _keystream(k_enc, nonce, len(ct)))
+    return _xor(ct, ks)
 
 
 def encrypt_file(path: str, key: str, out_path: str | None = None) -> str:

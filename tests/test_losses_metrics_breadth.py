@@ -228,3 +228,50 @@ def test_encrypt_large_blob_fast():
     blob = encrypt_bytes(data, "k")
     assert decrypt_bytes(blob, "k") == data
     assert _t.perf_counter() - t0 < 5.0
+
+
+def test_legacy_azte1_blob_still_decrypts():
+    import hashlib, hmac as _hmac, os as _os
+    from analytics_zoo_tpu.serving import encrypt as E
+
+    # hand-build an AZTE1 blob with the legacy single-key HMAC-CTR scheme
+    data, key = b"legacy-weights" * 100, "k"
+    salt, nonce = _os.urandom(16), _os.urandom(16)
+    k = hashlib.pbkdf2_hmac("sha256", key.encode(), salt, 100_000)
+    ks = E._legacy_v1_keystream(k, nonce, len(data))
+    ct = E._xor(data, ks)
+    tag = _hmac.new(k, nonce + ct, hashlib.sha256).digest()
+    blob = b"AZTE1" + salt + nonce + tag + ct
+    assert E.is_encrypted(blob)
+    assert E.decrypt_bytes(blob, key) == data
+    with pytest.raises(ValueError):
+        E.decrypt_bytes(blob, "wrong")
+
+
+def test_flash_block_shrinks_to_divisor():
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.ops.pallas.flash_attention import (
+        flash_attention, _reference_attn)
+    # t=640 is not a multiple of the (512, 1024) defaults but divides 128
+    b, t, h, d = 1, 640, 2, 32
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (b, t, h, d))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, t, h, d))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, t, h, d))
+    out = flash_attention(q, k, v)
+    ref = flash_attention(q, k, v, block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5)
+
+
+def test_evaluator_passes_from_logits():
+    from analytics_zoo_tpu.orca.automl.metrics import AUC, Evaluator
+    y = np.array([1, 0])
+    logits = np.array([0.3, -1.2])
+    assert Evaluator.evaluate("accuracy", y, logits,
+                              from_logits=True) == 1.0
+    # one-hot labels accepted by AUC like the sibling metrics
+    onehot = np.eye(2)[y]
+    probs = np.stack([1 - np.array([0.9, 0.2]), np.array([0.9, 0.2])], 1)
+    assert AUC(onehot, probs) == 1.0
