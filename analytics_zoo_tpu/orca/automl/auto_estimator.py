@@ -71,7 +71,7 @@ class AutoEstimator:
             n_sampling: int = 4, epochs: int = 1, batch_size: int = 32,
             grace_epochs: int = 1, feature_cols=None, label_cols=None,
             parallelism: int = 1, backend: str = "thread",
-            **fit_kwargs):
+            search_alg: str = "random", **fit_kwargs):
         """Run the search.  `parallelism`/`backend` control concurrent
         trials (reference: Ray Tune runs trials as concurrent actors,
         ray_tune_search_engine.py:29-345); with backend="process" the
@@ -85,7 +85,7 @@ class AutoEstimator:
             trainable, search_space, metric_mode=self.metric_mode,
             n_sampling=n_sampling, epochs=epochs,
             grace_epochs=grace_epochs, parallelism=parallelism,
-            backend=backend)
+            backend=backend, search_algorithm=search_alg)
         self.best_trial = self._engine.run()
         if parallelism > 1 and backend == "process":
             # the engine raises if export failed; estimator-convention

@@ -37,7 +37,10 @@ def _reduce(vals: np.ndarray, multioutput: str):
 
 
 def _regression(fn):
-    def wrapped(y_true, y_pred, multioutput="raw_values"):
+    # extra kwargs (e.g. from_logits, meaningful only for the
+    # classification metrics) are accepted and ignored so callers can
+    # loop one kwargs dict over a mixed metric list
+    def wrapped(y_true, y_pred, multioutput="raw_values", **_ignored):
         yt, yp = _standardize(y_true, y_pred)
         return _reduce(fn(yt, yp), multioutput)
     wrapped.__name__ = fn.__name__
@@ -159,9 +162,11 @@ def F1Score(y_true, y_pred, multioutput=None, from_logits=False):
     return 2 * p * r / (p + r) if p + r else 0.0
 
 
-def AUC(y_true, y_pred, multioutput=None):
+def AUC(y_true, y_pred, multioutput=None, from_logits=False):
     """Binary ROC-AUC via the rank statistic (Mann-Whitney U) —
-    equivalent to the trapezoidal ROC integral, no sklearn needed."""
+    equivalent to the trapezoidal ROC integral, no sklearn needed.
+    (`from_logits` is accepted for metric-list uniformity; AUC is
+    rank-based, so monotone score transforms don't change it.)"""
     yt = np.asarray(y_true)
     if yt.ndim > 1 and yt.shape[-1] > 1:      # one-hot labels
         yt = yt.argmax(axis=-1)

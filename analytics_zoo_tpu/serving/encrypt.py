@@ -75,15 +75,11 @@ def decrypt_bytes(blob: bytes, key: str) -> bytes:
     nonce = blob[21:37]
     tag = blob[37:69]
     ct = blob[69:]
-    if v1:
-        # legacy format: one PBKDF2 key for both keystream and tag
-        k = hashlib.pbkdf2_hmac("sha256", key.encode("utf-8"), salt,
-                                _ITERS)
-        k_enc = k_mac = k
-        ks = _legacy_v1_keystream(k_enc, nonce, len(ct))
-    else:
-        k_enc, k_mac = _derive(key, salt)
-        ks = _keystream(k_enc, nonce, len(ct))
+    # both formats use the same domain-separated key derivation; only
+    # the keystream PRF changed (HMAC-CTR -> SHAKE-256 XOF)
+    k_enc, k_mac = _derive(key, salt)
+    ks = (_legacy_v1_keystream(k_enc, nonce, len(ct)) if v1
+          else _keystream(k_enc, nonce, len(ct)))
     expect = hmac.new(k_mac, nonce + ct, hashlib.sha256).digest()
     if not hmac.compare_digest(tag, expect):
         raise ValueError("decryption failed: wrong key or corrupted "

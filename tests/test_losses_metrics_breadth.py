@@ -231,16 +231,20 @@ def test_encrypt_large_blob_fast():
 
 
 def test_legacy_azte1_blob_still_decrypts():
-    import hashlib, hmac as _hmac, os as _os
+    import hmac as _hmac
+    import hashlib
+    import os as _os
     from analytics_zoo_tpu.serving import encrypt as E
 
-    # hand-build an AZTE1 blob with the legacy single-key HMAC-CTR scheme
+    # hand-build an AZTE1 blob EXACTLY as the historical encrypt_bytes
+    # wrote it (git bb34516): domain-separated _derive keys + the
+    # HMAC-CTR keystream; only the keystream PRF changed in AZTE2
     data, key = b"legacy-weights" * 100, "k"
     salt, nonce = _os.urandom(16), _os.urandom(16)
-    k = hashlib.pbkdf2_hmac("sha256", key.encode(), salt, 100_000)
-    ks = E._legacy_v1_keystream(k, nonce, len(data))
+    k_enc, k_mac = E._derive(key, salt)
+    ks = E._legacy_v1_keystream(k_enc, nonce, len(data))
     ct = E._xor(data, ks)
-    tag = _hmac.new(k, nonce + ct, hashlib.sha256).digest()
+    tag = _hmac.new(k_mac, nonce + ct, hashlib.sha256).digest()
     blob = b"AZTE1" + salt + nonce + tag + ct
     assert E.is_encrypted(blob)
     assert E.decrypt_bytes(blob, key) == data
@@ -275,3 +279,12 @@ def test_evaluator_passes_from_logits():
     onehot = np.eye(2)[y]
     probs = np.stack([1 - np.array([0.9, 0.2]), np.array([0.9, 0.2])], 1)
     assert AUC(onehot, probs) == 1.0
+
+
+def test_evaluator_kwargs_safe_across_metric_list():
+    from analytics_zoo_tpu.orca.automl.metrics import Evaluator
+    y = np.array([1, 0, 1, 0])
+    logits = np.array([2.0, -1.0, 0.5, -0.2])
+    for m in ("accuracy", "auc", "rmse", "f1"):
+        v = Evaluator.evaluate(m, y, logits, from_logits=True)
+        assert np.isfinite(np.asarray(v)).all(), m

@@ -244,3 +244,32 @@ def test_grpc_single_record_batching():
     finally:
         g.stop()
         srv.stop()
+
+
+def test_auto_estimator_search_alg_passthrough():
+    import numpy as np
+    from analytics_zoo_tpu.orca.automl import hp
+    from analytics_zoo_tpu.orca.automl.auto_estimator import AutoEstimator
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+    import flax.linen as nn
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+
+    def creator(config):
+        class M(nn.Module):
+            @nn.compact
+            def __call__(self, xx):
+                return nn.Dense(2)(nn.relu(
+                    nn.Dense(int(config["hidden"]))(xx)))
+        return Estimator.from_flax(
+            M(), loss="sparse_categorical_crossentropy",
+            optimizer="sgd", learning_rate=config["lr"])
+
+    auto = AutoEstimator.from_flax(creator)
+    auto.fit({"x": x, "y": y},
+             search_space={"lr": hp.loguniform(1e-3, 1e-1),
+                           "hidden": hp.choice([8, 16])},
+             n_sampling=4, epochs=1, batch_size=32, search_alg="tpe")
+    assert auto.get_best_config()["hidden"] in (8, 16)
