@@ -172,8 +172,9 @@ class XShardsTSDataset:
                              else horizon)
 
         def f(df):
-            empty = {"x": np.zeros((0, lookback, n_feat), np.float32),
-                     "y": np.zeros((0, h, n_tgt), np.float32)}
+            empty = {"x": np.zeros((0, lookback, n_feat), np.float32)}
+            if h:  # horizon-0 (predict-time) rolls carry no y anywhere
+                empty["y"] = np.zeros((0, h, n_tgt), np.float32)
             if len(df) == 0:  # empty hash partition: empty block
                 return empty
             if self.id_col is not None:

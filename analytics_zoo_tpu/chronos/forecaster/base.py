@@ -70,7 +70,22 @@ class BaseForecaster:
                 metrics=list(self.metrics), seed=self._seed)
         return self._est
 
+    def _as_stream(self, data, horizon):
+        """XShardsTSDataset input rolls per shard and STREAMS into the
+        estimator (never materialized on this host — the distributed
+        path the reference's XShardsTSDataset feeds to Orca)."""
+        from analytics_zoo_tpu.chronos.data.experimental import (
+            XShardsTSDataset)
+        if isinstance(data, XShardsTSDataset):
+            return data.roll(self.past_seq_len, horizon).to_xshards()
+        return None
+
     def fit(self, data, epochs: int = 1, batch_size: int = 32, **kwargs):
+        stream = self._as_stream(data, self.future_seq_len)
+        if stream is not None:
+            self._estimator().fit(stream, epochs=epochs,
+                                  batch_size=batch_size, **kwargs)
+            return self
         x, y = _resolve_data(data, self.past_seq_len, self.future_seq_len)
         if y is None:
             raise ValueError("fit requires targets")
@@ -80,10 +95,21 @@ class BaseForecaster:
         return self
 
     def predict(self, data, batch_size: int = 32):
+        # horizon 0 like the in-memory path: the newest windows —
+        # the forecast past the end of observed data — must be kept,
+        # not dropped for lack of future rows
+        stream = self._as_stream(data, 0)
+        if stream is not None:
+            return self._estimator().predict(stream,
+                                             batch_size=batch_size)
         x, _ = _resolve_data(data, self.past_seq_len, 0)
         return self._estimator().predict({"x": x}, batch_size=batch_size)
 
     def evaluate(self, data, batch_size: int = 32):
+        stream = self._as_stream(data, self.future_seq_len)
+        if stream is not None:
+            return self._estimator().evaluate(stream,
+                                              batch_size=batch_size)
         x, y = _resolve_data(data, self.past_seq_len, self.future_seq_len)
         if y is None:
             raise ValueError("evaluate requires targets")

@@ -33,6 +33,9 @@ _DEFAULTS = {
     "batchTimeoutMs": 5.0,
     "quantize": False,
     "modelClass": None,
+    # name of the ENV VAR holding the decrypt key for encrypted-at-rest
+    # models (the key itself never belongs in a config file)
+    "decryptKeyEnv": None,
 }
 
 _KNOWN = set(_DEFAULTS) | {"modelPath"}
@@ -63,6 +66,7 @@ class ServingConfig:
         self.batch_timeout_ms = float(merged["batchTimeoutMs"])
         self.quantize = bool(merged["quantize"])
         self.model_class = merged["modelClass"]
+        self.decrypt_key_env = merged["decryptKeyEnv"]
 
     @staticmethod
     def load(path: str) -> "ServingConfig":
@@ -82,7 +86,8 @@ class ServingConfig:
                 "maxBatchSize": self.max_batch_size,
                 "batchTimeoutMs": self.batch_timeout_ms,
                 "quantize": self.quantize,
-                "modelClass": self.model_class}
+                "modelClass": self.model_class,
+                "decryptKeyEnv": self.decrypt_key_env}
 
 
 def start_serving(config: "ServingConfig | str", block: bool = False,
@@ -100,11 +105,19 @@ def start_serving(config: "ServingConfig | str", block: bool = False,
         from analytics_zoo_tpu.serving.inference_model import (
             _find_zoo_model_class)
         cls = _find_zoo_model_class(config.model_class)
+    decrypt_key = None
+    if config.decrypt_key_env:
+        import os
+        decrypt_key = os.environ.get(config.decrypt_key_env)
+        if not decrypt_key:
+            raise ValueError(
+                f"config names decryptKeyEnv={config.decrypt_key_env!r} "
+                "but that environment variable is unset")
     model = InferenceModel(
         supported_concurrent_num=config.model_parallelism,
         max_batch_size=config.max_batch_size)
     model.load_model(config.model_path, model_cls=cls,
-                     quantize=config.quantize)
+                     quantize=config.quantize, decrypt_key=decrypt_key)
 
     # the ServingServer owns the dynamic batcher; frontends are ingress
     # into the same batcher (reference: REST and gRPC frontends share

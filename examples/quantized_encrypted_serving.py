@@ -1,0 +1,72 @@
+"""Config-driven serving with int8 quantization and encrypted model
+files (reference: cluster-serving-start + config.yaml, int8 inference
+of wp-bigdl.md:192, EncryptSupportive model encryption)."""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from a checkout without install
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.models.recommendation import NeuralCF
+from analytics_zoo_tpu.serving import (
+    InputQueue,
+    start_serving,
+    stop_serving,
+)
+
+
+def main():
+    import json
+    from urllib.request import urlopen
+
+    import yaml
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    u = rng.integers(1, 201, 1000).astype(np.int32)
+    i = rng.integers(1, 101, 1000).astype(np.int32)
+    y = ((u + i) % 2).astype(np.int32)
+
+    # train + save encrypted at rest
+    model = NeuralCF(user_count=200, item_count=100)
+    est = model.estimator(learning_rate=5e-3, metrics=["accuracy"])
+    est.fit({"x": [u, i], "y": y}, epochs=3, batch_size=128)
+    workdir = tempfile.mkdtemp()
+    path = model.save_model(os.path.join(workdir, "ncf"),
+                            encrypt_key="s3cret")
+    print("saved encrypted model:", os.listdir(path))
+
+    # config.yaml names the env var holding the key (never the key);
+    # quantize=true serves int8 weights (~4x smaller, dequant fused)
+    cfg = os.path.join(workdir, "config.yaml")
+    with open(cfg, "w") as f:
+        yaml.safe_dump({"modelPath": path, "jobName": "ncf-int8",
+                        "port": 0, "protocol": "http",
+                        "quantize": True, "modelParallelism": 2,
+                        "decryptKeyEnv": "NCF_MODEL_KEY"}, f)
+    os.environ["NCF_MODEL_KEY"] = "s3cret"
+
+    servers = start_serving(cfg)
+    try:
+        im = servers["model"]
+        print(f"int8 compression: "
+              f"{im.quantize_stats['compression']:.2f}x")
+        srv = servers["http"]
+        preds = InputQueue(srv.host, srv.port).predict(
+            u[:64], i[:64], batched=True)
+        print("served predictions:", np.asarray(preds).shape)
+        stats = json.loads(urlopen(
+            f"http://{srv.host}:{srv.port}/metrics").read())
+        print("predict p50 (ms):", stats["predict"]["p50_ms"])
+    finally:
+        stop_serving(servers)
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

@@ -104,3 +104,36 @@ def test_grpc_only_binds_no_fixed_http_port(tmp_path):
         assert np.asarray(out).shape == (16, 2)
     finally:
         stop_serving(servers)
+
+
+def test_config_decrypt_key_env(tmp_path, monkeypatch):
+    import yaml
+
+    model_path, toks = _save_model(tmp_path)
+    # re-save encrypted
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+    loaded = TextClassifier.load_model(model_path)
+    enc_path = loaded.save_model(str(tmp_path / "enc"),
+                                 encrypt_key="k3y")
+    cfg_path = str(tmp_path / "config.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump({"modelPath": enc_path, "port": 0,
+                        "protocol": "http",
+                        "decryptKeyEnv": "TEST_MODEL_KEY"}, f)
+    with pytest.raises(ValueError, match="unset"):
+        start_serving(cfg_path)
+    monkeypatch.setenv("TEST_MODEL_KEY", "k3y")
+    servers = start_serving(cfg_path)
+    try:
+        out = InputQueue(servers["http"].host,
+                         servers["http"].port).predict(
+            toks.astype(np.int32), batched=True)
+        assert np.asarray(out).shape == (16, 2)
+    finally:
+        stop_serving(servers)
+
+
+def test_config_to_dict_roundtrips_decrypt_key_env():
+    cfg = ServingConfig(modelPath="/m", decryptKeyEnv="MODEL_KEY")
+    again = ServingConfig(**cfg.to_dict())
+    assert again.decrypt_key_env == "MODEL_KEY"
