@@ -73,12 +73,22 @@ class BaseForecaster:
     def _as_stream(self, data, horizon):
         """XShardsTSDataset input rolls per shard and STREAMS into the
         estimator (never materialized on this host — the distributed
-        path the reference's XShardsTSDataset feeds to Orca)."""
+        path the reference's XShardsTSDataset feeds to Orca).  The
+        caller's roll state is restored afterwards: a predict-time
+        horizon-0 roll must never poison the user's own later
+        to_xshards() (same invariant as _resolve_data's cache check)."""
         from analytics_zoo_tpu.chronos.data.experimental import (
             XShardsTSDataset)
-        if isinstance(data, XShardsTSDataset):
+        if not isinstance(data, XShardsTSDataset):
+            return None
+        prev = (data.lookback, data.horizon)
+        try:
+            # to_xshards' shard closure captures lookback/horizon by
+            # value, so restoring after it is safe even though the
+            # shard transforms run lazily
             return data.roll(self.past_seq_len, horizon).to_xshards()
-        return None
+        finally:
+            data.lookback, data.horizon = prev
 
     def fit(self, data, epochs: int = 1, batch_size: int = 32, **kwargs):
         stream = self._as_stream(data, self.future_seq_len)

@@ -1,0 +1,7 @@
+from analytics_zoo_tpu.feature.image3d.transforms import (  # noqa: F401
+    AffineTransform3D,
+    CenterCrop3D,
+    Crop3D,
+    RandomCrop3D,
+    Rotate3D,
+)

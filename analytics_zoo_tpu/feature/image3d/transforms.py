@@ -1,0 +1,134 @@
+"""3D image transforms (medical-imaging pipelines).
+
+Capability match: reference `pyzoo/zoo/feature/image3d/transformation.py`
+(Crop3D:37, RandomCrop3D:49, CenterCrop3D:62, Rotate3D:75,
+AffineTransform3D:88) over scala `feature/image3d/{Cropper,Rotation,
+Affine}.scala`.
+
+Volumes are [depth, height, width] (or [d, h, w, c]) numpy arrays and
+chain through the same `Preprocessing` pipeline as the 2D transforms —
+one host-side shard pipeline feeding the device, no JVM/OpenCV."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.image.transforms import (
+    ImagePreprocessing,
+    RandomImagePreprocessing,
+)
+
+
+def _check3d(img: np.ndarray) -> np.ndarray:
+    if img.ndim not in (3, 4):
+        raise ValueError(
+            f"3D transforms expect [d, h, w] or [d, h, w, c], got "
+            f"{img.shape}")
+    return img
+
+
+class Crop3D(ImagePreprocessing):
+    """Fixed-position crop: `start` [d, h, w] corner, `patch_size`
+    [d, h, w] extent (reference Crop3D)."""
+
+    def __init__(self, start: Sequence[int], patch_size: Sequence[int]):
+        self.start = tuple(int(s) for s in start)
+        self.patch = tuple(int(p) for p in patch_size)
+
+    def apply_image(self, img):
+        img = _check3d(img)
+        for ax in range(3):
+            if (self.start[ax] < 0
+                    or self.start[ax] + self.patch[ax] > img.shape[ax]):
+                raise ValueError(
+                    f"crop [{self.start[ax]}:"
+                    f"{self.start[ax] + self.patch[ax]}] exceeds axis "
+                    f"{ax} of {img.shape}")
+        d0, h0, w0 = self.start
+        dd, hh, ww = self.patch
+        return img[d0:d0 + dd, h0:h0 + hh, w0:w0 + ww]
+
+
+class CenterCrop3D(ImagePreprocessing):
+    def __init__(self, crop_depth: int, crop_height: int, crop_width: int):
+        self.patch = (crop_depth, crop_height, crop_width)
+
+    def apply_image(self, img):
+        img = _check3d(img)
+        start = [(img.shape[ax] - self.patch[ax]) // 2 for ax in range(3)]
+        return Crop3D(start, self.patch).apply_image(img)
+
+
+class RandomCrop3D(RandomImagePreprocessing):
+    def __init__(self, crop_depth: int, crop_height: int, crop_width: int,
+                 seed: int = 0):
+        super().__init__(seed)
+        self.patch = (crop_depth, crop_height, crop_width)
+
+    def apply_image(self, img, rng: Optional[np.random.Generator] = None):
+        img = _check3d(img)
+        rng = rng or np.random.default_rng(self.seed)
+        start = [int(rng.integers(0, img.shape[ax] - self.patch[ax] + 1))
+                 for ax in range(3)]
+        return Crop3D(start, self.patch).apply_image(img)
+
+
+class Rotate3D(ImagePreprocessing):
+    """Rotate by Euler angles [yaw, pitch, roll] in radians around the
+    volume center (reference Rotate3D rotation_angles)."""
+
+    def __init__(self, rotation_angles: Sequence[float], order: int = 1):
+        self.angles = tuple(float(a) for a in rotation_angles)
+        self.order = order
+
+    def apply_image(self, img):
+        from scipy.ndimage import rotate
+
+        img = _check3d(img)
+        out = img.astype(np.float32)
+        # successive plane rotations: (h, w), (d, w), (d, h)
+        for angle, axes in zip(self.angles, ((1, 2), (0, 2), (0, 1))):
+            if angle:
+                out = rotate(out, np.degrees(angle), axes=axes,
+                             reshape=False, order=self.order,
+                             mode="nearest")
+        return out
+
+
+class AffineTransform3D(ImagePreprocessing):
+    """Apply a 3x3 affine matrix + translation about the volume center
+    (reference AffineTransform3D; clamp_mode "clamp" -> edge padding,
+    "padding" -> constant zeros)."""
+
+    def __init__(self, affine_mat: np.ndarray,
+                 translation: Optional[Sequence[float]] = None,
+                 clamp_mode: str = "clamp", pad_val: float = 0.0,
+                 order: int = 1):
+        self.mat = np.asarray(affine_mat, np.float64).reshape(3, 3)
+        self.translation = (np.zeros(3) if translation is None
+                            else np.asarray(translation, np.float64))
+        if clamp_mode not in ("clamp", "padding"):
+            raise ValueError("clamp_mode must be 'clamp' or 'padding'")
+        self.mode = "nearest" if clamp_mode == "clamp" else "constant"
+        self.pad_val = pad_val
+        self.order = order
+
+    def apply_image(self, img):
+        from scipy.ndimage import affine_transform
+
+        img = _check3d(img)
+        center = (np.asarray(img.shape[:3], np.float64) - 1) / 2
+        # rotate about the center: offset = c - M @ c - t
+        offset = center - self.mat @ center - self.translation
+
+        def one(vol):
+            return affine_transform(
+                vol.astype(np.float32), self.mat, offset=offset,
+                order=self.order, mode=self.mode, cval=self.pad_val)
+
+        if img.ndim == 4:
+            return np.stack([one(img[..., c])
+                             for c in range(img.shape[-1])], axis=-1)
+        return one(img)

@@ -39,14 +39,38 @@ def _post(url: str, payload: Dict[str, Any], timeout: float = 60.0):
             raise e from None
 
 
+def _post_bytes(url: str, blob: bytes, content_type: str,
+                timeout: float = 60.0) -> bytes:
+    """Raw-body POST sharing _post's error-body handling (error
+    responses are JSON even on binary endpoints)."""
+    req = urllib.request.Request(
+        url, data=blob, headers={"Content-Type": content_type})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read()
+    except urllib.error.HTTPError as e:
+        try:
+            err = json.loads(e.read()).get("error", str(e))
+        except Exception:
+            err = str(e)
+        raise RuntimeError(f"serving error: {err}") from None
+
+
 def _get(url: str, timeout: float = 60.0):
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return json.loads(r.read())
 
 
 class InputQueue:
-    def __init__(self, host: str = "127.0.0.1", port: int = 10020):
+    def __init__(self, host: str = "127.0.0.1", port: int = 10020,
+                 codec: str = "json"):
+        """`codec`: "json" (base64 ndarrays, the reference client
+        default) or "arrow" (Arrow IPC binary tensors — the reference's
+        Arrow serialization, smaller and faster on big payloads)."""
+        if codec not in ("json", "arrow"):
+            raise ValueError("codec must be 'json' or 'arrow'")
         self.base = f"http://{host}:{port}"
+        self.codec = codec
 
     def predict(self, *inputs: np.ndarray, batched: bool = False):
         """Synchronous prediction.  By default each input is ONE record
@@ -55,11 +79,21 @@ class InputQueue:
         arrays = [np.asarray(a) for a in inputs]
         if not batched:
             arrays = [a[None] for a in arrays]
-        resp = _post(f"{self.base}/predict",
-                     {"inputs": [encode_ndarray(a) for a in arrays]})
-        if "error" in resp:
-            raise RuntimeError(f"serving error: {resp['error']}")
-        outs = [decode_ndarray(o) for o in resp["outputs"]]
+        if self.codec == "arrow":
+            from analytics_zoo_tpu.serving.codec import (
+                ARROW_CONTENT_TYPE,
+                decode_arrow_tensors,
+                encode_arrow_tensors,
+            )
+            outs = decode_arrow_tensors(_post_bytes(
+                f"{self.base}/predict", encode_arrow_tensors(arrays),
+                ARROW_CONTENT_TYPE))
+        else:
+            resp = _post(f"{self.base}/predict",
+                         {"inputs": [encode_ndarray(a) for a in arrays]})
+            if "error" in resp:
+                raise RuntimeError(f"serving error: {resp['error']}")
+            outs = [decode_ndarray(o) for o in resp["outputs"]]
         if not batched:
             outs = [o[0] for o in outs]
         return outs[0] if len(outs) == 1 else tuple(outs)

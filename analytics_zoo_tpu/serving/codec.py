@@ -1,12 +1,17 @@
-"""ndarray ⇄ JSON wire encoding for serving (reference: the base64 ndarray
-encoding of `pyzoo/zoo/serving/client.py:157` InputQueue.enqueue)."""
+"""ndarray wire encodings for serving: base64-JSON (reference: the
+base64 ndarray encoding of `pyzoo/zoo/serving/client.py:157`
+InputQueue.enqueue) and Arrow IPC (reference:
+`serving/serialization/ArrowDeserializer.scala` — the binary tensor
+format of the Flink serving data plane)."""
 
 from __future__ import annotations
 
 import base64
-from typing import Any, Dict
+from typing import Any, Dict, List, Sequence
 
 import numpy as np
+
+ARROW_CONTENT_TYPE = "application/vnd.apache.arrow.stream"
 
 
 def encode_ndarray(a: np.ndarray) -> Dict[str, Any]:
@@ -22,3 +27,37 @@ def decode_ndarray(enc: Any) -> np.ndarray:
         return a.reshape(enc["shape"]).copy()
     # plain nested lists are accepted too
     return np.asarray(enc)
+
+
+def encode_arrow_tensors(arrays: Sequence[np.ndarray]) -> bytes:
+    """Tensors -> one Arrow IPC stream: a RecordBatch with (dtype,
+    shape, raw-bytes) per tensor.  ~25% smaller on the wire than
+    base64-JSON and zero-copy decodable."""
+    import pyarrow as pa
+
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    batch = pa.record_batch({
+        "dtype": pa.array([str(a.dtype) for a in arrays]),
+        "shape": pa.array([list(a.shape) for a in arrays],
+                          type=pa.list_(pa.int64())),
+        "data": pa.array([a.tobytes() for a in arrays],
+                         type=pa.large_binary()),
+    })
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, batch.schema) as w:
+        w.write_batch(batch)
+    return sink.getvalue().to_pybytes()
+
+
+def decode_arrow_tensors(blob: bytes) -> List[np.ndarray]:
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(pa.BufferReader(blob)) as r:
+        table = r.read_all()
+    out = []
+    for dtype, shape, data in zip(table["dtype"].to_pylist(),
+                                  table["shape"].to_pylist(),
+                                  table["data"].to_pylist()):
+        a = np.frombuffer(data, dtype=np.dtype(dtype))
+        out.append(a.reshape(shape).copy())
+    return out

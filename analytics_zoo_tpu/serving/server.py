@@ -32,7 +32,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from analytics_zoo_tpu.serving.codec import decode_ndarray, encode_ndarray
+from analytics_zoo_tpu.serving.codec import (
+    ARROW_CONTENT_TYPE,
+    decode_arrow_tensors,
+    decode_ndarray,
+    encode_arrow_tensors,
+    encode_ndarray,
+)
 from analytics_zoo_tpu.serving.inference_model import InferenceModel
 
 
@@ -114,23 +120,46 @@ class ServingServer:
 
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
-                try:
-                    req = json.loads(self.rfile.read(length))
-                except Exception as e:
-                    self._json(400, {"error": f"bad json: {e}"})
-                    return
-                try:
-                    inputs = tuple(decode_ndarray(x)
-                                   for x in req.get("inputs", []))
-                    if not inputs:
-                        raise ValueError("no inputs")
-                except Exception as e:
-                    self._json(400, {"error": str(e)})
-                    return
+                body = self.rfile.read(length)
+                arrow = (self.headers.get("Content-Type", "")
+                         .startswith(ARROW_CONTENT_TYPE))
+                if arrow:
+                    # binary tensor path (reference ArrowDeserializer)
+                    req = {}
+                    try:
+                        inputs = tuple(decode_arrow_tensors(body))
+                        if not inputs:
+                            raise ValueError("no inputs")
+                    except Exception as e:
+                        self._json(400, {"error": f"bad arrow: {e}"})
+                        return
+                else:
+                    try:
+                        req = json.loads(body)
+                    except Exception as e:
+                        self._json(400, {"error": f"bad json: {e}"})
+                        return
+                    try:
+                        inputs = tuple(decode_ndarray(x)
+                                       for x in req.get("inputs", []))
+                        if not inputs:
+                            raise ValueError("no inputs")
+                    except Exception as e:
+                        self._json(400, {"error": str(e)})
+                        return
                 if self.path == "/predict":
                     out, err = server._submit(inputs)
                     if err:
                         self._json(500, {"error": err})
+                    elif arrow:
+                        blob = encode_arrow_tensors(list(out))
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         ARROW_CONTENT_TYPE)
+                        self.send_header("Content-Length",
+                                         str(len(blob)))
+                        self.end_headers()
+                        self.wfile.write(blob)
                     else:
                         self._json(200, {"outputs": [
                             encode_ndarray(o) for o in out]})

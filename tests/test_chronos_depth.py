@@ -266,3 +266,25 @@ def test_forecaster_streams_xshards_tsdataset():
     # horizon-0 roll: every series contributes n - lookback + 1 windows,
     # INCLUDING the newest (the forecast past the observed end)
     assert preds.shape[0] == 2 * (240 - 24 + 1)
+
+
+def test_predict_does_not_poison_roll_state():
+    import pandas as pd
+    from analytics_zoo_tpu.chronos.data.experimental import (
+        XShardsTSDataset)
+    from analytics_zoo_tpu.chronos.forecaster import LSTMForecaster
+
+    n = 120
+    t = pd.date_range("2020-01-01", periods=n, freq="h")
+    df = pd.DataFrame({"dt": t, "value": np.sin(np.arange(n) / 6)})
+    ds = XShardsTSDataset.from_pandas(df, dt_col="dt",
+                                      target_col="value", num_shards=2)
+    ds.roll(24, 4)
+    f = LSTMForecaster(past_seq_len=24, future_seq_len=4,
+                       input_feature_num=1, output_feature_num=1)
+    f.fit(ds, epochs=1, batch_size=16)
+    f.predict(ds)
+    # the user's roll state survives predict's internal horizon-0 roll
+    assert (ds.lookback, ds.horizon) == (24, 4)
+    blocks = ds.to_xshards().collect()
+    assert all("y" in b for b in blocks)

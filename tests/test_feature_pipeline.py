@@ -487,3 +487,56 @@ def test_knrm_ranker_ndcg_map():
     m = model.evaluate_map(grouped.to_dataset())
     assert ndcg > 0.8, ndcg
     assert m > 0.8, m
+
+
+def test_image3d_crops():
+    from analytics_zoo_tpu.feature.image3d import (CenterCrop3D, Crop3D,
+                                                   RandomCrop3D)
+    vol = np.arange(6 * 8 * 10, dtype=np.float32).reshape(6, 8, 10)
+    out = Crop3D(start=[1, 2, 3], patch_size=[2, 3, 4]).apply_image(vol)
+    assert out.shape == (2, 3, 4)
+    np.testing.assert_array_equal(out, vol[1:3, 2:5, 3:7])
+    with pytest.raises(ValueError, match="exceeds"):
+        Crop3D([5, 0, 0], [4, 2, 2]).apply_image(vol)
+    with pytest.raises(ValueError, match="exceeds"):
+        Crop3D([-2, 0, 0], [2, 2, 2]).apply_image(vol)
+
+    c = CenterCrop3D(2, 4, 6).apply_image(vol)
+    np.testing.assert_array_equal(c, vol[2:4, 2:6, 2:8])
+
+    r = RandomCrop3D(3, 3, 3, seed=1)
+    a = r.apply_image(vol, np.random.default_rng(0))
+    assert a.shape == (3, 3, 3)
+
+
+def test_image3d_rotate_and_affine():
+    from analytics_zoo_tpu.feature.image3d import (AffineTransform3D,
+                                                   Rotate3D)
+    vol = np.zeros((8, 8, 8), np.float32)
+    vol[2:6, 2:6, 2:6] = 1.0
+    # full-turn rotation is identity (up to interpolation)
+    r = Rotate3D([2 * np.pi, 0, 0]).apply_image(vol)
+    np.testing.assert_allclose(r, vol, atol=1e-4)
+    # identity affine is exact
+    a = AffineTransform3D(np.eye(3)).apply_image(vol)
+    np.testing.assert_allclose(a, vol, atol=1e-6)
+    # 90-degree rotation about depth axis permutes h/w
+    rot90 = np.array([[1, 0, 0], [0, 0, -1], [0, 1, 0]], np.float64)
+    b = AffineTransform3D(rot90).apply_image(vol)
+    assert b.shape == vol.shape and np.isfinite(b).all()
+    # channels preserved
+    vol4 = np.stack([vol, vol * 2], axis=-1)
+    c = AffineTransform3D(np.eye(3)).apply_image(vol4)
+    assert c.shape == vol4.shape
+    with pytest.raises(ValueError, match="clamp_mode"):
+        AffineTransform3D(np.eye(3), clamp_mode="wrap")
+
+
+def test_image3d_chains_with_preprocessing():
+    from analytics_zoo_tpu.feature.common import ChainedPreprocessing
+    from analytics_zoo_tpu.feature.image3d import CenterCrop3D, Rotate3D
+    vol = np.random.default_rng(0).random((8, 8, 8)).astype(np.float32)
+    chain = ChainedPreprocessing([Rotate3D([0.0, 0.0, 0.0]),
+                                  CenterCrop3D(4, 4, 4)])
+    out = chain({"image": vol, "uri": "v1"})
+    assert out["image"].shape == (4, 4, 4)

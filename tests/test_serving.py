@@ -219,3 +219,39 @@ def test_grpc_frontend_predict_and_errors():
     finally:
         grpc_srv.stop()
         srv.stop()
+
+
+def test_arrow_codec_roundtrip_and_http():
+    from analytics_zoo_tpu.serving.codec import (decode_arrow_tensors,
+                                                 encode_arrow_tensors)
+    rng = np.random.default_rng(0)
+    arrays = [rng.normal(size=(4, 8)).astype(np.float32),
+              rng.integers(0, 100, (4,)).astype(np.int32)]
+    back = decode_arrow_tensors(encode_arrow_tensors(arrays))
+    for a, b in zip(arrays, back):
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == b.dtype
+
+    # end-to-end over HTTP with codec="arrow"
+    import flax.linen as nn
+    import jax
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(x)
+
+    m = M()
+    x = rng.normal(size=(8, 8)).astype(np.float32)
+    params = jax.device_get(m.init(jax.random.PRNGKey(0), x))["params"]
+    im = InferenceModel().load_flax(m, params)
+    srv = ServingServer(im, port=0).start()
+    try:
+        arrow_client = InputQueue(srv.host, srv.port, codec="arrow")
+        json_client = InputQueue(srv.host, srv.port)
+        pa_out = arrow_client.predict(x, batched=True)
+        js_out = json_client.predict(x, batched=True)
+        np.testing.assert_allclose(np.asarray(pa_out),
+                                   np.asarray(js_out), atol=1e-6)
+    finally:
+        srv.stop()
