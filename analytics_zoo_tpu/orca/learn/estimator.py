@@ -185,16 +185,34 @@ class Estimator:
             shuffle: bool = True,
             nan_policy: str = "warn",
             max_failures: Optional[int] = None,
-            profile: bool = False) -> "Estimator":
+            profile: bool = False,
+            profiler_dir: Optional[str] = None) -> "Estimator":
         """Train for `epochs`.  On a training failure the latest checkpoint
         under `model_dir` is restored and training resumes, up to
         `max_failures` times (default `OrcaContext.failure_retry_times`) —
         the reference's DP-1 retry loop (Topology.scala:1255-1310,
         `bigdl.failure.retryTimes`).  Steps with non-finite loss/gradients
         are skipped on-device; `nan_policy` is "warn" (log and continue)
-        or "raise" (abort the fit with NaNLossError)."""
+        or "raise" (abort the fit with NaNLossError).
+
+        `profile=True` records host-side per-step wall times
+        (`est.profile_stats`, reference torch_runner profile=True);
+        `profiler_dir=` additionally captures a device trace with
+        `jax.profiler` viewable in TensorBoard/Perfetto — the deep
+        tracing tier the reference's Metrics/TimerCollection lacked."""
         if nan_policy not in ("warn", "raise"):
             raise ValueError("nan_policy must be 'warn' or 'raise'")
+        if profiler_dir is not None:
+            import jax
+
+            kwargs = dict(locals())
+            for drop in ("self", "data", "jax", "profiler_dir"):
+                kwargs.pop(drop)
+            with jax.profiler.trace(profiler_dir):
+                # re-enter with the SAME kwargs minus profiler_dir —
+                # built from locals() so a future fit() parameter can't
+                # be silently dropped by a stale forwarding list
+                return self.fit(data, **kwargs)
         ds = HostDataset.from_data(data, feature_cols, label_cols)
         val_ds = (HostDataset.from_data(validation_data, feature_cols,
                                         label_cols)

@@ -62,3 +62,29 @@ def test_estimator_tensorboard_and_profile(tmp_path):
     # per-step profile captured: 3 epochs x 3 steps
     assert len(est.profile_stats) == 9
     assert all(p["step_time_s"] > 0 for p in est.profile_stats)
+
+
+def test_profiler_dir_writes_trace(tmp_path):
+    import os
+    import flax.linen as nn
+    import numpy as np
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    est = Estimator.from_flax(M(), loss="sparse_categorical_crossentropy",
+                              optimizer="sgd", learning_rate=0.1)
+    out = est.fit({"x": x, "y": y}, epochs=1, batch_size=32,
+                  profiler_dir=str(tmp_path / "trace"))
+    assert out is est
+    # jax.profiler writes plugins/profile/<run>/ under the dir
+    found = []
+    for root, _, files in os.walk(tmp_path):
+        found.extend(files)
+    assert found, "no profiler trace files written"
