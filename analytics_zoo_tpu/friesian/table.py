@@ -561,6 +561,78 @@ class FeatureTable(Table):
             return df
         return self._map(f)
 
+    def mask(self, mask_cols, seq_len: int = 100) -> "FeatureTable":
+        """Standalone 0/1 mask columns for list-valued columns
+        (reference table.py:1309)."""
+        mask_cols = _as_list(mask_cols)
+
+        def f(df):
+            df = df.copy()
+            for c in mask_cols:
+                df[f"{c}_mask"] = [
+                    [1] * min(len(v), seq_len)
+                    + [0] * max(0, seq_len - len(v))
+                    for v in df[c]]
+            return df
+        return self._map(f)
+
+    def add_neg_hist_seq(self, item_size: int, item_history_col: str,
+                         neg_num: int) -> "FeatureTable":
+        """Per row, a list of `neg_num` negative items per history
+        position, avoiding the positive at that position (reference
+        table.py:1295; items indexed from 1)."""
+        if item_size < 2:
+            raise ValueError(
+                "add_neg_hist_seq needs item_size >= 2 (with one item "
+                "no negative different from the positive exists)")
+        seeds = np.random.SeedSequence(1).spawn(
+            self.shards.num_partitions())
+
+        def f(i, df):
+            rng = np.random.default_rng(seeds[i])
+            df = df.copy()
+            out = []
+            for hist in df[item_history_col]:
+                negs = []
+                for item in hist:
+                    draws = rng.integers(1, item_size + 1, neg_num)
+                    for j in range(neg_num):
+                        while draws[j] == item:
+                            draws[j] = rng.integers(1, item_size + 1)
+                    negs.append(draws.tolist())
+                out.append(negs)
+            df[f"neg_{item_history_col}"] = out
+            return df
+        return FeatureTable(self.shards.transform_shard_with_index(f))
+
+    def add_value_features(self, columns, dict_tbl: "Table", key: str,
+                           value: str) -> "FeatureTable":
+        """Map id columns through a (key -> value) lookup table
+        (reference table.py:1386).  The lookup collects to a dict and
+        broadcasts into every shard (the reference broadcasts the
+        dict-table the same way)."""
+        columns = _as_list(columns)
+        lookup = {}
+        for df in dict_tbl.shards.collect():
+            lookup.update(dict(zip(df[key], df[value])))
+
+        def f(df):
+            df = df.copy()
+            for c in columns:
+                df[f"{c}_{value}"] = df[c].map(lookup)
+            return df
+        return self._map(f)
+
+    def sort(self, *cols, ascending: bool = True) -> "FeatureTable":
+        """Global sort (reference table.py:663).  NOTE: materializes the
+        whole table on this host to order across shards — use on
+        aggregates/lookup tables, not the raw event log."""
+        cols = [c for group in cols for c in _as_list(group)]
+        df = self.to_pandas().sort_values(
+            cols, ascending=ascending).reset_index(drop=True)
+        return FeatureTable(_shard_dataframe(
+            df, self.shards.num_partitions()))
+
     # -- joins / grouping ----------------------------------------------
 
     def join(self, other: "Table", on=None, how: str = "inner"
@@ -677,6 +749,8 @@ class FeatureTable(Table):
         (reference table.py:1527).  Per-shard RNG streams are spawned from
         `seed` (SeedSequence), so the split is reproducible across
         processes and the two halves are exact complements."""
+        if not 0.0 < ratio < 1.0:
+            raise ValueError(f"ratio must be in (0, 1), got {ratio}")
         seeds = np.random.SeedSequence(seed or 0).spawn(
             self.shards.num_partitions())
 

@@ -256,3 +256,64 @@ def test_wide_and_deep_pipeline_end_to_end():
     est.fit({"x": x, "y": y}, epochs=8, batch_size=64)
     stats = est.evaluate({"x": x, "y": y}, batch_size=64)
     assert stats["accuracy"] > 0.7, stats
+
+
+def test_mask_and_neg_hist_seq():
+    import pandas as pd
+    from analytics_zoo_tpu.friesian import FeatureTable
+
+    df = pd.DataFrame({"user": [1, 2],
+                       "item_hist": [[3, 5], [7, 2, 9]]})
+    t = FeatureTable.from_pandas(df, num_shards=2)
+    m = t.mask(["item_hist"], seq_len=4).to_pandas()
+    assert m["item_hist_mask"].tolist() == [[1, 1, 0, 0], [1, 1, 1, 0]]
+
+    n = t.add_neg_hist_seq(item_size=10, item_history_col="item_hist",
+                           neg_num=2).to_pandas()
+    for hist, negs in zip(n["item_hist"], n["neg_item_hist"]):
+        assert len(negs) == len(hist)
+        for pos, draw in zip(hist, negs):
+            assert len(draw) == 2 and pos not in draw
+            assert all(1 <= d <= 10 for d in draw)
+
+
+def test_add_value_features_sort_split():
+    import pandas as pd
+    from analytics_zoo_tpu.friesian import FeatureTable
+
+    df = pd.DataFrame({"item": [1, 2, 3, 1], "clicks": [9, 3, 7, 1]})
+    t = FeatureTable.from_pandas(df, num_shards=2)
+    cat = FeatureTable.from_pandas(
+        pd.DataFrame({"item": [1, 2, 3], "cat": ["a", "b", "c"]}))
+    joined = t.add_value_features(["item"], cat, key="item",
+                                  value="cat").to_pandas()
+    assert joined["item_cat"].tolist() == ["a", "b", "c", "a"]
+
+    s = t.sort("clicks", ascending=False).to_pandas()
+    assert s["clicks"].tolist() == [9, 7, 3, 1]
+
+    big = FeatureTable.from_pandas(
+        pd.DataFrame({"x": np.arange(1000)}), num_shards=4)
+    a, b = big.split(0.8, seed=7)
+    na, nb = len(a), len(b)
+    assert na + nb == 1000 and 700 < na < 900
+    # complementary: no row in both
+    xs = set(a.to_pandas()["x"]) & set(b.to_pandas()["x"])
+    assert not xs
+    import pytest as _pt
+    with _pt.raises(ValueError, match="ratio"):
+        big.split(1.5)
+
+
+def test_sort_accepts_list_and_neg_hist_guard():
+    import pandas as pd
+    from analytics_zoo_tpu.friesian import FeatureTable
+    t = FeatureTable.from_pandas(
+        pd.DataFrame({"u": [2, 1, 2], "t": [1, 5, 0]}), num_shards=2)
+    s = t.sort(["u", "t"]).to_pandas()
+    assert s[["u", "t"]].values.tolist() == [[1, 5], [2, 0], [2, 1]]
+    import pytest as _pt
+    with _pt.raises(ValueError, match="item_size"):
+        FeatureTable.from_pandas(
+            pd.DataFrame({"h": [[1]]})).add_neg_hist_seq(
+                item_size=1, item_history_col="h", neg_num=1)
