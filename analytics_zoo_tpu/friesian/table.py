@@ -577,15 +577,18 @@ class FeatureTable(Table):
         return self._map(f)
 
     def add_neg_hist_seq(self, item_size: int, item_history_col: str,
-                         neg_num: int) -> "FeatureTable":
+                         neg_num: int, seed: Optional[int] = None
+                         ) -> "FeatureTable":
         """Per row, a list of `neg_num` negative items per history
         position, avoiding the positive at that position (reference
-        table.py:1295; items indexed from 1)."""
+        table.py:1295; items indexed from 1).  `seed=None` draws fresh
+        negatives per call (the reference resamples per call); pass a
+        seed for reproducibility."""
         if item_size < 2:
             raise ValueError(
                 "add_neg_hist_seq needs item_size >= 2 (with one item "
                 "no negative different from the positive exists)")
-        seeds = np.random.SeedSequence(1).spawn(
+        seeds = np.random.SeedSequence(seed).spawn(
             self.shards.num_partitions())
 
         def f(i, df):
@@ -608,18 +611,25 @@ class FeatureTable(Table):
     def add_value_features(self, columns, dict_tbl: "Table", key: str,
                            value: str) -> "FeatureTable":
         """Map id columns through a (key -> value) lookup table
-        (reference table.py:1386).  The lookup collects to a dict and
-        broadcasts into every shard (the reference broadcasts the
-        dict-table the same way)."""
+        (reference table.py:1386; scala Utils.addValueSingleCol).  The
+        lookup collects to a dict and broadcasts into every shard.
+        Scalar, list, and list-of-list cells map elementwise; missing
+        keys map to 0 (reference getOrElse(x, 0)); output columns are
+        named `col.replace(key, value)` like the reference."""
         columns = _as_list(columns)
         lookup = {}
         for df in dict_tbl.shards.collect():
             lookup.update(dict(zip(df[key], df[value])))
 
+        def map_cell(v):
+            if isinstance(v, (list, tuple, np.ndarray)):
+                return [map_cell(x) for x in v]
+            return lookup.get(v, 0)
+
         def f(df):
             df = df.copy()
             for c in columns:
-                df[f"{c}_{value}"] = df[c].map(lookup)
+                df[c.replace(key, value)] = df[c].map(map_cell)
             return df
         return self._map(f)
 
@@ -628,6 +638,10 @@ class FeatureTable(Table):
         whole table on this host to order across shards — use on
         aggregates/lookup tables, not the raw event log."""
         cols = [c for group in cols for c in _as_list(group)]
+        if not cols:
+            raise ValueError(
+                "sort needs at least one column (reference: 'cols "
+                "should be str or a list of str')")
         df = self.to_pandas().sort_values(
             cols, ascending=ascending).reset_index(drop=True)
         return FeatureTable(_shard_dataframe(

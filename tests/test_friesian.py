@@ -287,7 +287,8 @@ def test_add_value_features_sort_split():
         pd.DataFrame({"item": [1, 2, 3], "cat": ["a", "b", "c"]}))
     joined = t.add_value_features(["item"], cat, key="item",
                                   value="cat").to_pandas()
-    assert joined["item_cat"].tolist() == ["a", "b", "c", "a"]
+    # reference naming: col.replace(key, value)
+    assert joined["cat"].tolist() == ["a", "b", "c", "a"]
 
     s = t.sort("clicks", ascending=False).to_pandas()
     assert s["clicks"].tolist() == [9, 7, 3, 1]
@@ -317,3 +318,29 @@ def test_sort_accepts_list_and_neg_hist_guard():
         FeatureTable.from_pandas(
             pd.DataFrame({"h": [[1]]})).add_neg_hist_seq(
                 item_size=1, item_history_col="h", neg_num=1)
+
+
+def test_add_value_features_lists_and_missing_keys():
+    import pandas as pd
+    from analytics_zoo_tpu.friesian import FeatureTable
+    t = FeatureTable.from_pandas(pd.DataFrame({
+        "item": [1, 99],
+        "item_hist": [[1, 2], [2, 99]]}))
+    cat = FeatureTable.from_pandas(
+        pd.DataFrame({"item": [1, 2], "cat": [10, 20]}))
+    out = t.add_value_features(["item", "item_hist"], cat,
+                               key="item", value="cat").to_pandas()
+    assert out["cat"].tolist() == [10, 0]        # missing key -> 0
+    assert out["cat_hist"].tolist() == [[10, 20], [20, 0]]
+
+    import pytest as _pt
+    with _pt.raises(ValueError, match="at least one column"):
+        t.sort()
+    # unseeded add_neg_hist_seq varies between calls
+    a = t.add_neg_hist_seq(50, "item_hist", 3).to_pandas()
+    b = t.add_neg_hist_seq(50, "item_hist", 3).to_pandas()
+    assert (a["neg_item_hist"].tolist() != b["neg_item_hist"].tolist()
+            or True)  # may rarely collide; seeded path must be stable
+    s1 = t.add_neg_hist_seq(50, "item_hist", 3, seed=5).to_pandas()
+    s2 = t.add_neg_hist_seq(50, "item_hist", 3, seed=5).to_pandas()
+    assert s1["neg_item_hist"].tolist() == s2["neg_item_hist"].tolist()
