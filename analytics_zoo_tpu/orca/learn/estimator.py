@@ -287,7 +287,7 @@ class Estimator:
         self._epoch += 1
         if trigger is not None and hasattr(trigger, "last_loss"):
             trigger.last_loss = stats.get("loss")
-        step = int(np.asarray(eng.state.step))
+        step = eng.host_step
         stats.update(epoch=self._epoch, step=step,
                      wall_s=time.time() - t0,
                      samples_per_s=ds.n / max(time.time() - t0, 1e-9))
@@ -379,7 +379,12 @@ class Estimator:
         try:
             ckpt = find_latest_checkpoint(self.model_dir)
         except (FileNotFoundError, OSError):
-            return  # nothing written yet: retry from current state
+            # nothing written yet: retry from current state — but a
+            # failed epoch may have advanced the device step past the
+            # host mirror (the mirror only commits at epoch end), so
+            # resync or step numbers repeat
+            self._engine.sync_host_step()
+            return
         self.load(ckpt)
         epoch = start_epoch
         try:
@@ -461,6 +466,7 @@ class Estimator:
             return self
         from analytics_zoo_tpu.orca.learn.checkpoint import load_checkpoint
         self._engine.state = load_checkpoint(path, self._engine.state)
+        self._engine.sync_host_step()
         return self
 
     def save_checkpoint(self) -> str:
@@ -470,7 +476,7 @@ class Estimator:
         restores resume the correct epoch."""
         import json
         self._require_engine()
-        step = int(np.asarray(self._engine.state.step))
+        step = self._engine.host_step
         path = os.path.join(self.model_dir, f"ckpt-{step}")
         self.save(path)
         with open(path + ".meta.json", "w") as f:

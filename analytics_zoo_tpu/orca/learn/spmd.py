@@ -119,6 +119,11 @@ class SPMDEngine:
             opt_state=opt_state,
             rng=jax.random.PRNGKey(seed),
             model_state=model_state)
+        #: host mirror of state.step — reading the device scalar costs a
+        #: full round trip (~10-350ms on tunneled/pod setups); callers
+        #: that just logged the step number were paying it every epoch.
+        #: Resync via sync_host_step() after restoring external state.
+        self.host_step = 0
 
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
         self._eval_step = jax.jit(self._eval_step_impl)
@@ -318,11 +323,12 @@ class SPMDEngine:
             if train:
                 self.state, totals = self._train_epoch_scan(self.state,
                                                             data)
+                self.host_step += dds.steps
             else:
                 totals = self._eval_epoch_scan(self.state, data)
             return self._finalize_totals(jax.device_get(totals))
         totals = None
-        step = int(np.asarray(self.state.step)) if train else 0
+        step = self.host_step if train else 0
         self.last_profile = []
         step_fn = (self._train_step_cached if train
                    else self._eval_step_cached)
@@ -343,6 +349,8 @@ class SPMDEngine:
             totals = self._accum(totals, stats)
             if train and on_step is not None:
                 on_step(step)
+        if train:
+            self.host_step = step
         if totals is None:
             return {}
         return self._finalize_totals(jax.device_get(totals))
@@ -382,7 +390,7 @@ class SPMDEngine:
         totals = None
         # host-side step mirror: avoids a device sync per step just to
         # know the step number
-        step = int(np.asarray(self.state.step)) if train else 0
+        step = self.host_step if train else 0
         self.last_profile = []
         for batch in self._prefetch(batch_iter):
             t0 = time.perf_counter() if profile else 0.0
@@ -404,6 +412,8 @@ class SPMDEngine:
             totals = self._accum(totals, stats)
             if train and on_step is not None:
                 on_step(step)
+        if train:
+            self.host_step = step
         if totals is None:
             return {}
         return self._finalize_totals(jax.device_get(totals))
@@ -452,6 +462,12 @@ class SPMDEngine:
     # ------------------------------------------------------------------
     def pad_multiple(self) -> int:
         return data_parallelism(self.mesh)
+
+    def sync_host_step(self) -> int:
+        """Re-read the authoritative device step (one round trip); call
+        after externally replacing self.state (checkpoint restore)."""
+        self.host_step = int(np.asarray(self.state.step))
+        return self.host_step
 
     def get_params(self):
         return jax.device_get(self.state.params)

@@ -118,3 +118,31 @@ def test_no_retry_without_budget(tmp_path):
     with pytest.raises(RuntimeError, match="injected"):
         est.fit(XShards(shards), epochs=2, batch_size=32, max_failures=0)
     _PoisonShard.armed = False
+
+
+def test_host_step_resyncs_after_failed_epoch_without_checkpoint(tmp_path):
+    """An epoch that dies mid-run before any checkpoint exists must not
+    leave the host step mirror behind the device step (steps would
+    repeat in trigger/checkpoint/TB numbering)."""
+    import flax.linen as nn
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(96, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+
+    est = Estimator.from_flax(M(), loss="sparse_categorical_crossentropy",
+                              optimizer="sgd", learning_rate=0.1,
+                              model_dir=str(tmp_path))
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=32)
+    eng = est._engine
+    assert eng.host_step == int(np.asarray(eng.state.step))
+    # simulate mid-epoch drift: device ahead of mirror, no checkpoint
+    eng.host_step -= 1
+    est._restore_latest(0, 10)   # no checkpoint written yet
+    assert eng.host_step == int(np.asarray(eng.state.step))
