@@ -143,3 +143,51 @@ def test_device_store_matches_host_path_non_divisible_batch():
     h = [s["loss"] for s in e_host.train_summary]
     d = [s["loss"] for s in e_dev.train_summary]
     np.testing.assert_allclose(d, h, rtol=1e-5)
+
+
+def test_epoch_scan_on_dp_tp_mesh():
+    """The one-dispatch epoch scan must be multichip-correct with
+    tensor-parallel params (dp x tp mesh).  NOTE: ring attention (sp)
+    inside lax.scan is exercised separately per step — combining
+    ppermute rings with the epoch scan flakily deadlocks XLA:CPU's
+    thread-rendezvous collective emulation (not a TPU code path), so
+    this test pins sp=1."""
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    from analytics_zoo_tpu.models.bert import (BERT_SHARD_RULES,
+                                               BERTClassifier)
+    from analytics_zoo_tpu.orca.learn.flax_adapter import (flax_apply_fn,
+                                                           init_flax)
+    from analytics_zoo_tpu.orca.learn.losses import (
+        sparse_categorical_crossentropy)
+    from analytics_zoo_tpu.orca.learn.spmd import SPMDEngine
+
+    devices = jax.devices("cpu")[:8]
+    mesh = Mesh(np.asarray(devices).reshape(4, 2), ("dp", "tp"))
+    model = BERTClassifier(num_classes=2, vocab=64, hidden_size=32,
+                           n_block=2, n_head=4, intermediate_size=64,
+                           max_position_len=8, hidden_drop=0.0,
+                           attn_drop=0.0, attn_impl="einsum")
+    rng = np.random.default_rng(0)
+    n = 32
+    ids = rng.integers(0, 64, (n, 8)).astype(np.int32)
+    seg = np.zeros((n, 8), np.int32)
+    msk = np.ones((n, 8), np.int32)
+    y = rng.integers(0, 2, n).astype(np.int32)
+    params, model_state = init_flax(model, (ids[:1], seg[:1], msk[:1]))
+    eng = SPMDEngine(apply_fn=flax_apply_fn(model), params=params,
+                     optimizer=optax.adam(1e-4),
+                     loss_fn=sparse_categorical_crossentropy,
+                     metric_fns={}, model_state=model_state, mesh=mesh,
+                     shard_rules=dict(BERT_SHARD_RULES))
+    # tp-sharded params on the 2-way tp axis
+    qkv = eng.state.params["bert"]["blocks"]["attn"]["qkv"]["kernel"]
+    assert "tp" in str(qkv.sharding.spec)
+    dds = eng.cache_dataset((ids, seg, msk), (y,), batch_size=8)
+    stats = eng.run_epoch_device(dds, train=True, shuffle=True, seed=0,
+                                 epoch=0)
+    assert np.isfinite(stats["loss"])
+    assert eng.host_step == dds.steps
