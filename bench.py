@@ -94,20 +94,26 @@ def ncf_estimator_throughput(batch: int, steps: int) -> float:
         est = Estimator.from_flax(
             _ncf_model(), loss="sparse_categorical_crossentropy",
             optimizer="adam", learning_rate=1e-3)
-        # 2 warmup epochs: epoch 0 compiles the epoch-scan program and
-        # pins the dataset in HBM; epoch 1 absorbs the one recompile
-        # triggered by the donated state's post-scan shardings; epoch 2+
-        # is steady state
-        est.fit({"x": [u, i], "y": y}, epochs=2, batch_size=batch,
+        # 3 warmup epochs: epoch 0 compiles the epoch-scan program and
+        # pins the dataset in HBM; epochs 1-2 absorb residual
+        # first-steady-call overhead (round-2's driver capture timed
+        # exactly the first post-compile call and recorded 2.6x under
+        # steady state); epoch 3+ is steady
+        est.fit({"x": [u, i], "y": y}, epochs=3, batch_size=batch,
                 shuffle=False)
-        t0 = time.perf_counter()
-        est.fit({"x": [u, i], "y": y}, epochs=1, batch_size=batch,
-                shuffle=False)
-        dt = time.perf_counter() - t0
+        # best of 3 timed windows: the tunnel's dispatch-stream jitter
+        # swings single-window numbers ~20%; best-of-N on BOTH this and
+        # the raw ceiling (same policy) keeps the ratio honest
+        epochs, dt = 3, float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            est.fit({"x": [u, i], "y": y}, epochs=epochs,
+                    batch_size=batch, shuffle=False)
+            dt = min(dt, time.perf_counter() - t0)
     finally:
         OrcaContext.train_data_store = prev_store
         OrcaContext.device_cache_bytes = prev_cap
-    return batch * steps / dt
+    return epochs * batch * steps / dt
 
 
 def ncf_raw_throughput(platform: str, batch: int, steps: int,
@@ -143,16 +149,26 @@ def ncf_raw_throughput(platform: str, batch: int, steps: int,
         batches = [tuple(jax.device_put(a[s * batch:(s + 1) * batch], dev)
                          for a in (u, i, y))
                    for s in range(steps)]
+        # sync via a VALUE fetch, not block_until_ready: on the tunneled
+        # TPU backend block_until_ready can return before the queued
+        # dispatches execute (measured: 30 steps "complete" in 4ms, then
+        # the value fetch waits 4s), which would overstate the ceiling
+        # ~50x.  float(loss) of the LAST step is an unambiguous barrier
+        # because the steps chain through params.
         for k in range(warmup):
             ub, ib, yb = batches[k % steps]
             params, opt_state, loss = step(params, opt_state, ub, ib, yb)
-        jax.block_until_ready(loss)
-        t0 = time.perf_counter()
-        for k in range(steps):
-            ub, ib, yb = batches[k]
-            params, opt_state, loss = step(params, opt_state, ub, ib, yb)
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
+        float(loss)
+        # best of 3 timed windows (same policy as the estimator path)
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for k in range(steps):
+                ub, ib, yb = batches[k]
+                params, opt_state, loss = step(params, opt_state,
+                                               ub, ib, yb)
+            float(loss)
+            dt = min(dt, time.perf_counter() - t0)
     return batch * steps / dt
 
 
@@ -165,8 +181,6 @@ def bert_finetune_metrics(batch: int = 256, seq: int = 128,
     Config: batch 256 with scan-over-remat (activation checkpointing per
     block) + the DEVICE data store — measured fastest on v5e-1 (batch 32
     no-remat: 81k tok/s; 64: 101k; 256+remat: 112k; 512+remat: 109k)."""
-    import jax
-
     from analytics_zoo_tpu.common.context import OrcaContext
     from analytics_zoo_tpu.models.bert import BERTClassifier
     from analytics_zoo_tpu.orca.learn.estimator import Estimator
@@ -188,21 +202,20 @@ def bert_finetune_metrics(batch: int = 256, seq: int = 128,
         est = Estimator.from_flax(model,
                                   loss="sparse_categorical_crossentropy",
                                   optimizer="adam", learning_rate=2e-5)
-        # 2 warmup epochs (compile + the one post-donation recompile),
-        # then steady state
-        est.fit({"x": [ids, seg, msk], "y": y}, epochs=2,
+        # 3 warmup epochs (compile + residual first-steady-call
+        # overhead), then 2 timed epochs
+        est.fit({"x": [ids, seg, msk], "y": y}, epochs=3,
                 batch_size=batch, shuffle=False)
+        epochs = 2
         t0 = time.perf_counter()
-        est.fit({"x": [ids, seg, msk], "y": y}, epochs=1,
+        est.fit({"x": [ids, seg, msk], "y": y}, epochs=epochs,
                 batch_size=batch, shuffle=False)
         dt = time.perf_counter() - t0
     finally:
         OrcaContext.train_data_store = prev_store
 
-    tokens_per_s = n * seq / dt
-    n_params = sum(int(np.prod(np.shape(p)))
-                   for p in jax.tree_util.tree_leaves(
-                       est._engine.state.params))
+    tokens_per_s = epochs * n * seq / dt
+    n_params = est._engine.param_count
     # fwd+bwd ~ 6 FLOPs/param/token + attention 12*L*h*t FLOPs/token
     flops_per_token = 6 * n_params + 12 * 12 * 768 * seq
     mfu = flops_per_token * tokens_per_s / V5E_PEAK_FLOPS
@@ -235,12 +248,19 @@ def longctx_flash_ms(t: int = 16384) -> float:
                                kv_mask=mask).astype(jnp.float32).sum()
 
     fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def sync(out):
+        # value-fetch barrier (block_until_ready is unreliable through
+        # the tunnel — see ncf_raw_throughput); summing to a scalar
+        # device-side keeps the fetch tiny
+        return float(jnp.sum(out[0][0, 0, 0]))
+
     out = fn(q, k, v)
-    jax.block_until_ready(out)
+    sync(out)
     t0 = time.perf_counter()
     for _ in range(3):
         out = fn(q, k, v)
-    jax.block_until_ready(out)
+    sync(out)
     return (time.perf_counter() - t0) / 3 * 1e3
 
 

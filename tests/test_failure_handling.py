@@ -43,6 +43,43 @@ def test_nan_steps_skipped_and_training_still_converges():
     assert est.evaluate(clean, batch_size=32)["loss"] < 1e-2
 
 
+def test_nan_skip_device_store_replay_matches_guarded_run():
+    """DEVICE-store epochs run an UNGUARDED fast scan and replay the
+    epoch with the guarded program when a non-finite step is detected
+    (spmd.py epoch-program comment).  The replayed trajectory must match
+    the host-streaming guarded path exactly: same nan_steps, same
+    params."""
+    from analytics_zoo_tpu.common.context import OrcaContext
+
+    init_orca_context(cluster_mode="local")
+    x, y = _reg_data(n=256, poison_first=32)  # first batch all-inf
+
+    def run(store):
+        prev = OrcaContext.train_data_store
+        OrcaContext.train_data_store = store
+        try:
+            est = Estimator.from_flax(_Reg(), loss="mse", optimizer="sgd",
+                                      learning_rate=0.1)
+            est.fit({"x": x, "y": y}, epochs=3, batch_size=32,
+                    shuffle=False)
+        finally:
+            OrcaContext.train_data_store = prev
+        return est
+
+    dev = run("DEVICE")
+    host = run("DRAM")
+    assert dev.train_summary[0]["nan_steps"] >= 1
+    assert dev.train_summary[0]["nan_steps"] == \
+        host.train_summary[0]["nan_steps"]
+    dp = dev._engine.get_params()
+    hp = host._engine.get_params()
+    for a, b in zip(np.asarray(dp["Dense_0"]["kernel"]).ravel(),
+                    np.asarray(hp["Dense_0"]["kernel"]).ravel()):
+        assert abs(a - b) < 1e-6
+    assert dev.evaluate({"x": x[32:], "y": y[32:]},
+                        batch_size=32)["loss"] < 1e-2
+
+
 def test_nan_policy_raise():
     init_orca_context(cluster_mode="local")
     x, y = _reg_data(n=64, poison_first=64)
