@@ -261,10 +261,12 @@ class Estimator:
         mult = eng.pad_multiple()
 
         def on_step(step):
-            # step-granular triggers (SeveralIteration) fire mid-epoch
+            # step-granular triggers (SeveralIteration) fire mid-epoch;
+            # pass the loop-local step — engine.host_step only commits
+            # at epoch end
             if trigger and self.model_dir and trigger(
                     epoch=self._epoch, step=step, epoch_end=False):
-                self.save_checkpoint()
+                self.save_checkpoint(step=step)
 
         t0 = time.time()
         if dds is not None:
@@ -338,6 +340,11 @@ class Estimator:
             np.asarray(a).dtype.itemsize
             * int(np.prod(np.asarray(a).shape[1:], dtype=np.int64))
             for a in arrays) + 4  # + float32 mask
+        # NOTE: this admission check runs BEFORE the cache-hit return
+        # below, and the footprint doubles when this fit shuffles (the
+        # device-side permutation materializes a second copy) — so a
+        # dataset admitted by a shuffle=False fit is re-checked at 2x
+        # when a later shuffle=True fit reuses it
         nbytes = steps * b * row_bytes * (2 if shuffle else 1)
         if nbytes > OrcaContext.device_cache_bytes:
             logger.warning(
@@ -469,14 +476,21 @@ class Estimator:
         self._engine.sync_host_step()
         return self
 
-    def save_checkpoint(self) -> str:
+    def save_checkpoint(self, step: Optional[int] = None) -> str:
         """Write a step-versioned checkpoint under model_dir (reference
         checkpoint_trigger semantics, orca/learn/trigger.py + tf/estimator.py
         save path).  A sidecar records the epoch cursor so failure
-        restores resume the correct epoch."""
+        restores resume the correct epoch.
+
+        `step`: the global step to version the file with.  Mid-epoch
+        callers (SeveralIteration triggers) MUST pass the loop-local
+        step: the engine's host_step mirror only commits at epoch end,
+        so reading it mid-epoch would stamp every checkpoint of the
+        epoch with the same stale number (overwriting one another)."""
         import json
         self._require_engine()
-        step = self._engine.host_step
+        if step is None:
+            step = self._engine.host_step
         path = os.path.join(self.model_dir, f"ckpt-{step}")
         self.save(path)
         with open(path + ".meta.json", "w") as f:

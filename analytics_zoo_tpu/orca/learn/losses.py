@@ -143,12 +143,18 @@ def log_cosh(preds, labels):
             - jnp.log(2.0)).mean(axis=-1)
 
 
-def rank_hinge(preds, labels, margin: float = 1.0):
+def rank_hinge(preds, labels, margin: float = 1.0, mask=None):
     """Pairwise ranking hinge over (positive, negative) consecutive row
     pairs — the text-matching objective (reference objectives.py
     RankHinge:269; rows must alternate pos, neg like the reference's
     pairwise TextSet relations).  Returns one loss per PAIR, repeated
-    per row so the engine's per-example weighting stays valid."""
+    per row so the engine's per-example weighting stays valid.
+
+    `mask` (auto-threaded by the engine — it passes the batch padding
+    mask to any loss declaring the parameter): a pair with a padded
+    member contributes zero.  Without it, a ragged tail batch whose last
+    real (positive) row pairs with a padding row would repeat that
+    bogus margin loss onto the real row."""
     p = _first(preds)
     if p.shape[0] % 2:
         raise ValueError(
@@ -159,6 +165,9 @@ def rank_hinge(preds, labels, margin: float = 1.0):
     pos = p[0::2]
     neg = p[1::2]
     pair = jnp.maximum(0.0, margin - pos + neg)
+    if mask is not None:
+        m = mask.reshape(mask.shape[0], -1)[:, 0] if mask.ndim > 1 else mask
+        pair = pair * m[0::2] * m[1::2]
     return jnp.repeat(pair, 2)
 
 

@@ -100,6 +100,17 @@ class SPMDEngine:
         self.apply_fn = apply_fn
         self.tx = optimizer
         self.loss_fn = loss_fn
+        # pairwise losses (rank_hinge) need the padding mask INSIDE the
+        # loss — a padded member must zero its pair — so the engine
+        # threads it to any loss that declares a `mask` parameter
+        self._loss_takes_mask = False
+        if loss_fn is not None:
+            try:
+                import inspect
+                self._loss_takes_mask = (
+                    "mask" in inspect.signature(loss_fn).parameters)
+            except (TypeError, ValueError):
+                pass
         self.metric_fns = dict(metric_fns or {})
         self.shard_rules = shard_rules or {}
         self._data_sharding = batch_sharding(self.mesh)
@@ -178,24 +189,23 @@ class SPMDEngine:
             totals, _ = jax.lax.scan(body, totals, rest, unroll=unroll)
             return totals
 
-        # Two train-epoch programs (NaN-guard strategy, measured on NCF
-        # through the TPU tunnel): the per-step skip guard's scalar
-        # predicate serializes every params/opt-state write behind a
-        # global grad reduction and forces the old state to stay live —
-        # ~2ms/step, 20% of NCF's step time.  The FAST program drops the
-        # guard (detection stats are free — they fuse into the backward
-        # pass) and does NOT donate its input state, so the epoch-start
-        # state survives; if the fetched stats report any non-finite
-        # step, the epoch is REPLAYED from that state with the guarded
-        # program — bad steps skipped exactly as before.  Net effect:
+        # Train-epoch NaN-guard strategy (measured on NCF through the
+        # TPU tunnel): the per-step skip guard's scalar predicate
+        # serializes every params/opt-state write behind a global grad
+        # reduction and forces the old state to stay live — ~2ms/step,
+        # 20% of NCF's step time.  The epoch fast path therefore runs
+        # guard=False (detection stats are free — they fuse into the
+        # backward pass); if the fetched stats report any non-finite
+        # step, the epoch is REPLAYED from its start state with
+        # guard=True — bad steps skipped exactly as before.  Net effect:
         # identical final state, zero steady-state cost, one extra epoch
-        # of work only when a NaN actually occurs (plus one transient
-        # extra state copy in HBM during the epoch).
+        # of work only when a NaN actually occurs.  The program does NOT
+        # donate its input state: the epoch-start state must survive as
+        # the replay (and replay-failure) fallback — a donating variant
+        # would invalidate it the moment the executable is invoked.
+        # Cost: one transient extra state copy in HBM during the epoch.
         self._train_epoch_scan = jax.jit(_train_epoch_impl,
-                                         donate_argnums=0,
                                          static_argnums=(2, 3))
-        self._train_epoch_scan_fast = jax.jit(_train_epoch_impl,
-                                              static_argnums=(2, 3))
         self._eval_epoch_scan = jax.jit(_eval_epoch_impl,
                                         static_argnums=2)
         self.param_count = sum(
@@ -232,13 +242,19 @@ class SPMDEngine:
     def _forward(self, params, model_state, features, rng, training):
         return self.apply_fn(params, model_state, features, rng, training)
 
+    def _per_example_loss(self, preds, labels, mask):
+        if self._loss_takes_mask:
+            return self.loss_fn(preds, labels, mask=mask)
+        return self.loss_fn(preds, labels)
+
     def _train_step_impl(self, state: TrainState, batch, guard=True):
         rng = jax.random.fold_in(state.rng, state.step)
 
         def loss_of(params):
             preds, new_ms = self._forward(
                 params, state.model_state, batch["features"], rng, True)
-            per_ex = self.loss_fn(preds, batch["labels"])
+            per_ex = self._per_example_loss(preds, batch["labels"],
+                                            batch["mask"])
             loss = masked_mean(per_ex, batch["mask"])
             return loss, (preds, new_ms)
 
@@ -284,7 +300,8 @@ class SPMDEngine:
         stats = {}
         if batch["labels"]:  # metrics/loss need labels; label-less eval
             if self.loss_fn is not None:
-                per_ex = self.loss_fn(preds, batch["labels"])
+                per_ex = self._per_example_loss(preds, batch["labels"],
+                                                batch["mask"])
                 stats["loss"] = masked_mean(per_ex, batch["mask"])
             for name, fn in self.metric_fns.items():
                 stats[name] = masked_mean(fn(preds, batch["labels"]),
@@ -364,14 +381,16 @@ class SPMDEngine:
             unroll = self._epoch_unroll(dds.steps)
             if train:
                 start_state = self.state
-                self.state, totals = self._train_epoch_scan_fast(
+                self.state, totals = self._train_epoch_scan(
                     start_state, data, unroll, False)
                 self.host_step += dds.steps
                 out = self._fetch_totals(totals)
                 if out.get("nan_steps"):
                     # restore first: if the replay itself fails (compile
                     # error, RPC loss), self.state must not be left on
-                    # the NaN-poisoned fast-run result
+                    # the NaN-poisoned fast-run result — and the epoch
+                    # program never donates, so start_state stays valid
+                    # through a mid-execution replay failure too
                     self.state = start_state
                     self.state, totals = self._train_epoch_scan(
                         start_state, data, unroll, True)

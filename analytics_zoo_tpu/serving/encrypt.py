@@ -2,12 +2,16 @@
 `zoo/src/main/scala/.../pipeline/inference/EncryptSupportive.scala` —
 AES-encrypted model files loaded by InferenceModel).
 
-Stdlib-only authenticated stream cipher: PBKDF2-HMAC-SHA256 key
+Preferred construction (when the `cryptography` package is importable):
+AES-256-GCM with a PBKDF2-HMAC-SHA256-derived key —
+``b"AZTE3" | salt(16) | nonce(12) | ct||gcmtag``.
+
+Stdlib fallback (no external crypto dependency): PBKDF2-HMAC-SHA256 key
 derivation into domain-separated (k_enc, k_mac), a SHAKE-256 XOF
 keystream keyed by k_enc||nonce, and an encrypt-then-MAC HMAC-SHA256
-integrity tag under k_mac.  No external crypto dependency is available
-in the image; keyed-XOF stream + EtM is a standard construction.
+integrity tag under k_mac (a standard keyed-XOF-stream + EtM build).
 Layout: ``b"AZTE2" | salt(16) | nonce(16) | tag(32) | ciphertext``.
+Decryption reads all three formats regardless of what is installed.
 """
 
 from __future__ import annotations
@@ -18,7 +22,13 @@ import os
 
 import numpy as np
 
-_MAGIC = b"AZTE2"
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except Exception:  # pragma: no cover - crypto lib absent in some envs
+    AESGCM = None
+
+_MAGIC_V3 = b"AZTE3"   # AES-256-GCM (cryptography package)
+_MAGIC = b"AZTE2"      # stdlib SHAKE-256 stream + HMAC EtM
 _MAGIC_V1 = b"AZTE1"   # legacy HMAC-CTR format: still decryptable
 _ITERS = 100_000
 
@@ -47,6 +57,12 @@ def _xor(data: bytes, ks: bytes) -> bytes:
 
 
 def encrypt_bytes(data: bytes, key: str) -> bytes:
+    if AESGCM is not None:
+        salt = os.urandom(16)
+        nonce = os.urandom(12)
+        k_enc, _ = _derive(key, salt)
+        ct = AESGCM(k_enc).encrypt(nonce, data, _MAGIC_V3)
+        return _MAGIC_V3 + salt + nonce + ct
     salt = os.urandom(16)
     nonce = os.urandom(16)
     k_enc, k_mac = _derive(key, salt)
@@ -56,7 +72,7 @@ def encrypt_bytes(data: bytes, key: str) -> bytes:
 
 
 def is_encrypted(blob: bytes) -> bool:
-    return blob[:5] in (_MAGIC, _MAGIC_V1)
+    return blob[:5] in (_MAGIC_V3, _MAGIC, _MAGIC_V1)
 
 
 def _legacy_v1_keystream(k: bytes, nonce: bytes, n: int) -> bytes:
@@ -70,6 +86,19 @@ def _legacy_v1_keystream(k: bytes, nonce: bytes, n: int) -> bytes:
 def decrypt_bytes(blob: bytes, key: str) -> bytes:
     if not is_encrypted(blob):
         raise ValueError("not an AZTE-encrypted blob")
+    if blob[:5] == _MAGIC_V3:
+        if AESGCM is None:
+            raise ValueError(
+                "blob is AES-GCM encrypted (AZTE3) but the "
+                "'cryptography' package is not installed")
+        salt = blob[5:21]
+        nonce = blob[21:33]
+        k_enc, _ = _derive(key, salt)
+        try:
+            return AESGCM(k_enc).decrypt(nonce, blob[33:], _MAGIC_V3)
+        except Exception:
+            raise ValueError("decryption failed: wrong key or corrupted "
+                             "file (integrity tag mismatch)")
     v1 = blob[:5] == _MAGIC_V1
     salt = blob[5:21]
     nonce = blob[21:37]

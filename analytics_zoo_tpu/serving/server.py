@@ -184,8 +184,13 @@ class ServingServer:
             request_queue_size = 128
             daemon_threads = True
 
-        self._httpd = _Server((host, port), Handler)
-        self.host, self.port = self._httpd.server_address[:2]
+        # listener creation is deferred to start(http=True): a
+        # batcher-only server (protocol=grpc) must not hold a bound,
+        # never-accepted socket where clients hang in the backlog
+        self._server_cls, self._handler_cls = _Server, Handler
+        self._requested_addr = (host, port)
+        self._httpd = None
+        self.host, self.port = host, port
         self._threads: List[threading.Thread] = []
 
     # ------------------------------------------------------------------
@@ -283,6 +288,10 @@ class ServingServer:
         self._threads = [t1]
         self._http_started = http
         if http:
+            if self._httpd is None:
+                self._httpd = self._server_cls(self._requested_addr,
+                                               self._handler_cls)
+                self.host, self.port = self._httpd.server_address[:2]
             t2 = threading.Thread(target=self._httpd.serve_forever,
                                   daemon=True)
             t2.start()
@@ -296,10 +305,11 @@ class ServingServer:
     def stop(self):
         self._stop.set()
         # shutdown() blocks on the serve_forever loop — only valid when
-        # that loop actually ran (http=False starts batcher-only)
-        if getattr(self, "_http_started", True):
-            self._httpd.shutdown()
-        self._httpd.server_close()
+        # that loop actually ran (http=False never builds the listener)
+        if self._httpd is not None:
+            if getattr(self, "_http_started", True):
+                self._httpd.shutdown()
+            self._httpd.server_close()
         # wake requests still queued behind the (now stopped) batcher:
         # their handler threads block on event.wait() with no timeout
         try:

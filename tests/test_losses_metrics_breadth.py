@@ -32,6 +32,76 @@ def test_new_losses_resolve_and_compute():
     np.testing.assert_allclose(rh, 0.0)
 
 
+def test_rank_hinge_mask_zeroes_padded_pairs():
+    """A pair whose member is a padding row contributes zero (the engine
+    threads the batch mask to losses declaring a `mask` parameter), so a
+    ragged tail batch can't contaminate the real orphan row."""
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.orca.learn import losses
+
+    # rows: (pos, neg), (pos, PAD) — second pair must be 0 with mask
+    p = jnp.asarray([2.0, 1.0, -3.0, 0.0])
+    mask = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+    unmasked = np.asarray(losses.rank_hinge(p, None))
+    assert unmasked[2] > 0  # the contamination the mask removes
+    masked = np.asarray(losses.rank_hinge(p, None, mask=mask))
+    np.testing.assert_allclose(masked[2:], 0.0)
+    np.testing.assert_allclose(masked[:2], unmasked[:2])
+    # engine-side detection: rank_hinge declares mask, mse does not
+    import inspect
+    assert "mask" in inspect.signature(losses.resolve("rank_hinge")).parameters
+    assert "mask" not in inspect.signature(losses.resolve("mse")).parameters
+
+
+def test_mid_epoch_checkpoints_get_distinct_steps(tmp_path):
+    """SeveralIteration checkpoints within one epoch must be stamped
+    with the loop-local step, not the epoch-start host_step mirror
+    (which only commits at epoch end)."""
+    import flax.linen as nn
+    import os
+    from analytics_zoo_tpu.orca.learn import Estimator
+    from analytics_zoo_tpu.orca.learn.trigger import SeveralIteration
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    est = Estimator.from_flax(M(), loss="sparse_categorical_crossentropy",
+                              optimizer="sgd", learning_rate=0.1,
+                              model_dir=str(tmp_path))
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=16, shuffle=False,
+            checkpoint_trigger=SeveralIteration(3))
+    cks = sorted(f for f in os.listdir(tmp_path)
+                 if f.startswith("ckpt-") and not f.endswith(".json"))
+    # 8 steps -> triggers at steps 3 and 6: two DISTINCT paths
+    assert "ckpt-3" in cks and "ckpt-6" in cks, cks
+
+
+def test_stdlib_encrypt_format_roundtrip(monkeypatch):
+    """The stdlib (AZTE2) construction still encrypts/decrypts when the
+    cryptography package is unavailable, and AES-GCM installs can read
+    blobs written by stdlib-only hosts."""
+    from analytics_zoo_tpu.serving import encrypt
+
+    data = b"model bytes" * 1000
+    monkeypatch.setattr(encrypt, "AESGCM", None)
+    blob = encrypt.encrypt_bytes(data, "pw")
+    assert blob[:5] == b"AZTE2"
+    assert encrypt.decrypt_bytes(blob, "pw") == data
+    monkeypatch.undo()
+    if encrypt.AESGCM is not None:
+        # cross-format: GCM-capable host reads the stdlib blob...
+        assert encrypt.decrypt_bytes(blob, "pw") == data
+        # ...and writes AZTE3
+        blob3 = encrypt.encrypt_bytes(data, "pw")
+        assert blob3[:5] == b"AZTE3"
+        assert encrypt.decrypt_bytes(blob3, "pw") == data
+
+
 def test_topk_metric_names():
     import jax.numpy as jnp
     from analytics_zoo_tpu.orca.learn import metrics
