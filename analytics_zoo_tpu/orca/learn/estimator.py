@@ -67,7 +67,11 @@ class Estimator:
         self._seed = seed
         self.model_dir = model_dir
         self._engine: Optional[SPMDEngine] = None
-        self._pending_ckpt: Optional[str] = None
+        #: load()/set_params() calls made before the engine exists are
+        #: queued and replayed IN CALL ORDER at engine build, so the
+        #: deferred path has the same last-call-wins semantics as the
+        #: live path
+        self._deferred_ops: list = []
         self.train_summary: List[Dict[str, Any]] = []
         self.val_summary: List[Dict[str, Any]] = []
         self._epoch = 0
@@ -169,9 +173,12 @@ class Estimator:
             model_state=self._model_state,
             shard_rules=self._shard_rules,
             seed=self._seed)
-        if self._pending_ckpt is not None:
-            path, self._pending_ckpt = self._pending_ckpt, None
-            self.load(path)
+        ops, self._deferred_ops = self._deferred_ops, []
+        for kind, value in ops:
+            if kind == "load":
+                self.load(value)
+            else:
+                self.set_params(value)
 
     # ------------------------------------------------------------------
     # public API
@@ -469,11 +476,36 @@ class Estimator:
         just `from_flax(...).load_orca_checkpoint(dir)` (reference:
         tf/estimator.py:271)."""
         if self._engine is None:
-            self._pending_ckpt = path
+            self._deferred_ops.append(("load", path))
             return self
         from analytics_zoo_tpu.orca.learn.checkpoint import load_checkpoint
         self._engine.state = load_checkpoint(path, self._engine.state)
         self._engine.sync_host_step()
+        return self
+
+    def set_params(self, params) -> "Estimator":
+        """Replace the model parameters.  `params` is a pytree, or a
+        callable mapping the current params to new ones — e.g. a
+        pretrained-weight loader::
+
+            est.set_params(lambda p: load_bert_pretrained(p, ckpt_path))
+
+        On a fresh estimator (engine not yet built) the replacement is
+        deferred until the first fit/evaluate/predict, mirroring
+        `load()`; deferred load/set_params calls replay in call order.
+        The new tree is re-sharded per the estimator's shard rules, so
+        TP/FSDP layouts survive the swap (reference analog: fine-tuning
+        from `init_checkpoint`, tfpark bert_base.py:45-48)."""
+        if self._engine is None:
+            self._deferred_ops.append(("params", params))
+            if not callable(params):
+                # visible to get_model() pre-build, and used as the
+                # engine's initial tree (a later deferred op still wins)
+                self._params = params
+            return self
+        if callable(params):
+            params = params(self._engine.get_params())
+        self._engine.set_params(params)
         return self
 
     def save_checkpoint(self, step: Optional[int] = None) -> str:
