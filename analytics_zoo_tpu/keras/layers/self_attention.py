@@ -49,7 +49,8 @@ class MultiHeadAttention(nn.Module):
 
         b, t, d = x.shape
         h = self.n_head
-        qkv = nn.Dense(3 * self.hidden_size, name="qkv")(x)
+        qkv = nn.Dense(3 * self.hidden_size, dtype=self.compute_dtype,
+                       name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(a):
@@ -100,10 +101,20 @@ class MultiHeadAttention(nn.Module):
                 dropout_rate=dropout, dropout_rng=drop_rng,
                 compute_dtype=self.compute_dtype)
         out = out.reshape(b, t, self.hidden_size)
-        return nn.Dense(self.hidden_size, name="proj")(out)
+        return nn.Dense(self.hidden_size, dtype=self.compute_dtype,
+                        name="proj")(out)
 
 
 class TransformerBlock(nn.Module):
+    """compute_dtype=bf16 makes the block's activations (and the four
+    dense matmul outputs — qkv, proj, fc1, fc2) bfloat16.  The matmul
+    RATE is unchanged — XLA:TPU already executes f32-typed dots at
+    default (bf16) MXU precision — the win is HALVED activation memory,
+    which is what lets the save-the-matmuls remat policies (and bigger
+    batches) fit in HBM (measured: full remat 0.42 MFU -> dots_all
+    0.46).  Params stay f32 (flax param_dtype default); LayerNorms and
+    residual adds stay f32 for numerics (post-LN re-normalizes each
+    sublayer, the standard mixed-precision recipe)."""
     hidden_size: int
     n_head: int
     intermediate_size: int
@@ -112,6 +123,7 @@ class TransformerBlock(nn.Module):
     causal: bool = False
     activation: str = "gelu"
     attn_impl: str = "auto"
+    compute_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, mask=None, training: bool = False):
@@ -119,15 +131,18 @@ class TransformerBlock(nn.Module):
 
         a = MultiHeadAttention(self.hidden_size, self.n_head,
                                self.attn_dropout, self.causal,
+                               compute_dtype=self.compute_dtype,
                                attn_impl=self.attn_impl,
                                name="attn")(x, mask, training)
         a = nn.Dropout(self.residual_dropout)(a, deterministic=not training)
-        x = nn.LayerNorm(name="ln1")(x + a)
-        f = nn.Dense(self.intermediate_size, name="fc1")(x)
+        x = nn.LayerNorm(name="ln1")(x + a.astype(x.dtype))
+        f = nn.Dense(self.intermediate_size, dtype=self.compute_dtype,
+                     name="fc1")(x)
         f = get_activation(self.activation)(f)
-        f = nn.Dense(self.hidden_size, name="fc2")(f)
+        f = nn.Dense(self.hidden_size, dtype=self.compute_dtype,
+                     name="fc2")(f)
         f = nn.Dropout(self.residual_dropout)(f, deterministic=not training)
-        return nn.LayerNorm(name="ln2")(x + f)
+        return nn.LayerNorm(name="ln2")(x + f.astype(x.dtype))
 
 
 class TransformerEncoder(nn.Module):
@@ -153,12 +168,19 @@ class TransformerEncoder(nn.Module):
     causal: bool = False
     with_pooler: bool = False
     attn_impl: str = "auto"
+    compute_dtype: jnp.dtype = jnp.bfloat16
     scan_layers: bool = True
     #: rematerialize each block's activations in the backward pass
     #: (jax.checkpoint): ~n_block-fold cut in saved activations for
     #: ~1/3 more FLOPs — the standard TPU trade that unlocks large
     #: batch/sequence training (SURVEY.md: HBM is the usual bottleneck)
     remat: bool = False
+    #: with remat, what the checkpoint SAVES instead of recomputing:
+    #: None = recompute everything (max memory savings, +2 FLOPs/param/
+    #: token); "dots" = save matmul outputs, recompute only the cheap
+    #: elementwise ops (jax.checkpoint_policies.dots_with_no_batch_dims_
+    #: saveable) — near-no-remat speed at a fraction of no-remat memory
+    remat_policy: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids, segment_ids=None, position_ids=None,
@@ -184,6 +206,14 @@ class TransformerEncoder(nn.Module):
         # pass the raw [b, t] key-validity mask down: each attention impl
         # (einsum/flash/ring) lowers it appropriately
         mask = attention_mask
+        if self.remat_policy not in (None, "dots", "dots_all"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; "
+                "use None, 'dots' or 'dots_all'")
+        if self.remat_policy is not None and not self.remat:
+            raise ValueError(
+                "remat_policy is set but remat=False — the policy "
+                "would be silently ignored; enable remat or drop it")
         block_cls = TransformerBlock
         if self.remat:
             # scan-over-remat: checkpoint each block's boundary so the
@@ -194,8 +224,20 @@ class TransformerEncoder(nn.Module):
             # structure already blocks CSE); the unrolled path keeps the
             # default, else XLA could CSE the recomputation back into
             # the saved forward and quietly forfeit the memory savings
+            policy = None
+            if self.remat_policy == "dots":
+                # save dense-matmul outputs (qkv/proj/fc1/fc2);
+                # attention einsums carry batch dims and are recomputed
+                import jax
+                policy = (jax.checkpoint_policies
+                          .dots_with_no_batch_dims_saveable)
+            elif self.remat_policy == "dots_all":
+                # save EVERY matmul output incl. attention scores —
+                # near-zero recompute, highest memory of the policies
+                import jax
+                policy = jax.checkpoint_policies.dots_saveable
             block_cls = nn.remat(
-                TransformerBlock, static_argnums=(3,),
+                TransformerBlock, static_argnums=(3,), policy=policy,
                 prevent_cse=not (self.scan_layers and self.n_block > 0))
         if self.scan_layers and self.n_block > 0:
             def body(block, carry, _):
@@ -211,7 +253,8 @@ class TransformerEncoder(nn.Module):
                     self.hidden_size, self.n_head,
                     self.intermediate_size, self.attn_dropout,
                     self.residual_dropout, self.causal,
-                    attn_impl=self.attn_impl, name="blocks"),
+                    attn_impl=self.attn_impl,
+                    compute_dtype=self.compute_dtype, name="blocks"),
                 x, None)
         else:
             for i in range(self.n_block):
@@ -219,6 +262,7 @@ class TransformerEncoder(nn.Module):
                     self.hidden_size, self.n_head, self.intermediate_size,
                     self.attn_dropout, self.residual_dropout, self.causal,
                     attn_impl=self.attn_impl,
+                    compute_dtype=self.compute_dtype,
                     name=f"block_{i}")(x, mask, training)
 
         if self.with_pooler:

@@ -44,6 +44,7 @@ class BERTClassifier(nn.Module, ZooModel):
     attn_drop: float = 0.1
     attn_impl: str = "auto"
     remat: bool = False
+    remat_policy: str = None
 
     default_loss = "sparse_categorical_crossentropy"
     default_metrics = ("accuracy",)
@@ -60,7 +61,7 @@ class BERTClassifier(nn.Module, ZooModel):
             attn_dropout=self.attn_drop,
             residual_dropout=self.hidden_drop,
             causal=False, with_pooler=True, attn_impl=self.attn_impl,
-            remat=self.remat,
+            remat=self.remat, remat_policy=self.remat_policy,
             name="bert")(input_ids, segment_ids, None, attention_mask,
                          training)
         pooled = nn.Dropout(self.hidden_drop)(pooled,
@@ -85,6 +86,7 @@ class BERTNER(nn.Module, ZooModel):
     hidden_drop: float = 0.1
     attn_impl: str = "auto"
     remat: bool = False
+    remat_policy: str = None
 
     default_loss = "sparse_categorical_crossentropy"
     default_metrics = ("accuracy",)
@@ -101,7 +103,7 @@ class BERTNER(nn.Module, ZooModel):
             attn_dropout=self.hidden_drop,
             residual_dropout=self.hidden_drop,
             causal=False, with_pooler=False, attn_impl=self.attn_impl,
-            remat=self.remat,
+            remat=self.remat, remat_policy=self.remat_policy,
             name="bert")(input_ids, segment_ids, None, attention_mask,
                          training)
         seq = nn.Dropout(self.hidden_drop)(seq, deterministic=not training)
@@ -125,6 +127,7 @@ class BERTSQuAD(nn.Module, ZooModel):
     hidden_drop: float = 0.1
     attn_impl: str = "auto"
     remat: bool = False
+    remat_policy: str = None
 
     default_loss = "sparse_categorical_crossentropy"
     default_metrics = ()
@@ -141,7 +144,7 @@ class BERTSQuAD(nn.Module, ZooModel):
             attn_dropout=self.hidden_drop,
             residual_dropout=self.hidden_drop,
             causal=False, with_pooler=False, attn_impl=self.attn_impl,
-            remat=self.remat,
+            remat=self.remat, remat_policy=self.remat_policy,
             name="bert")(input_ids, segment_ids, None, attention_mask,
                          training)
         logits = nn.Dense(2, name="span_head")(seq)     # [b, t, 2]

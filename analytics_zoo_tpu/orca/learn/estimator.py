@@ -445,8 +445,13 @@ class Estimator:
         """Return current parameters as host numpy (reference estimators
         return the trained model object).  Works on a loaded-but-not-yet-run
         estimator by returning the staged parameters."""
-        if self._engine is None and self._params is not None:
-            return self._params
+        if self._engine is None:
+            # newest deferred plain-tree set_params wins pre-build
+            for kind, value in reversed(self._deferred_ops):
+                if kind == "params" and not callable(value):
+                    return value
+            if self._params is not None:
+                return self._params
         self._require_engine()
         return self._engine.get_params()
 
@@ -497,11 +502,10 @@ class Estimator:
         TP/FSDP layouts survive the swap (reference analog: fine-tuning
         from `init_checkpoint`, tfpark bert_base.py:45-48)."""
         if self._engine is None:
+            # queued only — NOT written into self._params: that would
+            # make _ensure_engine skip init_flax and lose model_state
+            # (BatchNorm stats) for flax modules
             self._deferred_ops.append(("params", params))
-            if not callable(params):
-                # visible to get_model() pre-build, and used as the
-                # engine's initial tree (a later deferred op still wins)
-                self._params = params
             return self
         if callable(params):
             params = params(self._engine.get_params())

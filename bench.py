@@ -178,9 +178,13 @@ def bert_finetune_metrics(batch: int = 256, seq: int = 128,
     (BASELINE.md north-star #2; reference config #5,
     pyzoo/zoo/tfpark/text/estimator/bert_classifier.py).
 
-    Config: batch 256 with scan-over-remat (activation checkpointing per
-    block) + the DEVICE data store — measured fastest on v5e-1 (batch 32
-    no-remat: 81k tok/s; 64: 101k; 256+remat: 112k; 512+remat: 109k)."""
+    Config: batch 256, scan-over-remat with the "dots_all" policy
+    (matmul outputs incl. attention scores saved; only elementwise ops
+    recompute) + the DEVICE data store.  Round-3 sweep on v5e-1 (best of
+    3 windows each): full remat 124k tok/s / 0.42 MFU; dots 133k / 0.451;
+    dots_all 135k / 0.459; batch 384 dots 131k; batch 512 compile OOM;
+    no-remat OOMs even at batch 128 — see
+    docs/parallelism-and-performance.md for the frontier analysis."""
     from analytics_zoo_tpu.common.context import OrcaContext
     from analytics_zoo_tpu.models.bert import BERTClassifier
     from analytics_zoo_tpu.orca.learn.estimator import Estimator
@@ -188,7 +192,8 @@ def bert_finetune_metrics(batch: int = 256, seq: int = 128,
     model = BERTClassifier(num_classes=2, vocab=30522, hidden_size=768,
                            n_block=12, n_head=12, intermediate_size=3072,
                            max_position_len=seq, hidden_drop=0.0,
-                           attn_drop=0.0, remat=True)
+                           attn_drop=0.0, remat=True,
+                           remat_policy="dots_all")
     n = batch * steps
     rng = np.random.default_rng(0)
     ids = rng.integers(0, 30522, (n, seq)).astype(np.int32)
