@@ -98,6 +98,22 @@ class InputQueue:
             outs = [o[0] for o in outs]
         return outs[0] if len(outs) == 1 else tuple(outs)
 
+    def predict_image(self, image, resize=None):
+        """Predict on ONE image given as a file path or raw JPEG/PNG
+        bytes — the reference's base64-image payload
+        (pyzoo/zoo/serving/client.py:157, decoded server-side like
+        PreProcessing.decodeImage).  The server sees a float32
+        [1, H, W, C] pixel array (0-255); `resize` [H, W] resizes
+        server-side before the model."""
+        from analytics_zoo_tpu.serving.codec import encode_image
+
+        resp = _post(f"{self.base}/predict",
+                     {"inputs": [encode_image(image, resize=resize)]})
+        if "error" in resp:
+            raise RuntimeError(f"serving error: {resp['error']}")
+        outs = [decode_ndarray(o)[0] for o in resp["outputs"]]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
     def enqueue(self, uri: str, **inputs) -> str:
         """Async enqueue of one record (reference InputQueue.enqueue);
         fetch via OutputQueue.dequeue(uri)."""

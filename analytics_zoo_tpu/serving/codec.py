@@ -21,12 +21,45 @@ def encode_ndarray(a: np.ndarray) -> Dict[str, Any]:
 
 
 def decode_ndarray(enc: Any) -> np.ndarray:
+    if isinstance(enc, dict) and "image_b64" in enc:
+        return decode_image(enc)
     if isinstance(enc, dict) and "b64" in enc:
         a = np.frombuffer(base64.b64decode(enc["b64"]),
                           dtype=np.dtype(enc["dtype"]))
         return a.reshape(enc["shape"]).copy()
     # plain nested lists are accepted too
     return np.asarray(enc)
+
+
+def encode_image(data, resize=None) -> Dict[str, Any]:
+    """Wrap raw JPEG/PNG bytes (or a file path) as an image payload —
+    the reference's base64-image enqueue (serving/client.py:157;
+    decoded server-side by PreProcessing.decodeImage,
+    serving/preprocessing/PreProcessing.scala:107)."""
+    if isinstance(data, str):
+        with open(data, "rb") as f:
+            data = f.read()
+    enc: Dict[str, Any] = {
+        "image_b64": base64.b64encode(data).decode("ascii")}
+    if resize is not None:
+        enc["resize"] = list(resize)
+    return enc
+
+
+def decode_image(enc: Dict[str, Any]) -> np.ndarray:
+    """image payload -> float32 [1, H, W, C] pixel array (0-255).  An
+    optional ``resize`` [H, W] resizes server-side, matching the
+    reference's serving-side OpenCV resize."""
+    from io import BytesIO
+
+    from PIL import Image
+
+    img = Image.open(BytesIO(base64.b64decode(enc["image_b64"])))
+    img = img.convert("RGB")
+    if enc.get("resize"):
+        h, w = enc["resize"]
+        img = img.resize((int(w), int(h)))
+    return np.asarray(img, np.float32)[None]
 
 
 def encode_arrow_tensors(arrays: Sequence[np.ndarray]) -> bytes:

@@ -36,6 +36,10 @@ _DEFAULTS = {
     # name of the ENV VAR holding the decrypt key for encrypted-at-rest
     # models (the key itself never belongs in a config file)
     "decryptKeyEnv": None,
+    # >1 starts that many replica worker PROCESSES behind the batcher
+    # (the reference's Flink modelParallelism scale-out,
+    # ClusterServing.scala:57-70); 1 = serve from the in-process model
+    "replicas": 1,
 }
 
 _KNOWN = set(_DEFAULTS) | {"modelPath"}
@@ -67,6 +71,9 @@ class ServingConfig:
         self.quantize = bool(merged["quantize"])
         self.model_class = merged["modelClass"]
         self.decrypt_key_env = merged["decryptKeyEnv"]
+        self.replicas = int(merged["replicas"])
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
 
     @staticmethod
     def load(path: str) -> "ServingConfig":
@@ -87,7 +94,8 @@ class ServingConfig:
                 "batchTimeoutMs": self.batch_timeout_ms,
                 "quantize": self.quantize,
                 "modelClass": self.model_class,
-                "decryptKeyEnv": self.decrypt_key_env}
+                "decryptKeyEnv": self.decrypt_key_env,
+                "replicas": self.replicas}
 
 
 def start_serving(config: "ServingConfig | str", block: bool = False,
@@ -113,11 +121,28 @@ def start_serving(config: "ServingConfig | str", block: bool = False,
             raise ValueError(
                 f"config names decryptKeyEnv={config.decrypt_key_env!r} "
                 "but that environment variable is unset")
-    model = InferenceModel(
-        supported_concurrent_num=config.model_parallelism,
-        max_batch_size=config.max_batch_size)
-    model.load_model(config.model_path, model_cls=cls,
-                     quantize=config.quantize, decrypt_key=decrypt_key)
+    pool = model = None
+    if config.replicas > 1:
+        # multi-replica scale-out: N worker processes each load their
+        # own model copy (Flink modelParallelism analog); the parent
+        # holds no model of its own.  A caller-supplied model_cls is
+        # forwarded BY NAME (workers resolve it from the zoo registry,
+        # same as config.modelClass).
+        from analytics_zoo_tpu.serving.worker_pool import WorkerPool
+        cls_name = config.model_class
+        if cls is not None:
+            cls_name = getattr(cls, "__name__", str(cls))
+        pool = WorkerPool(config.model_path, n_workers=config.replicas,
+                          model_cls=cls_name,
+                          quantize=config.quantize,
+                          decrypt_key_env=config.decrypt_key_env)
+    else:
+        model = InferenceModel(
+            supported_concurrent_num=config.model_parallelism,
+            max_batch_size=config.max_batch_size)
+        model.load_model(config.model_path, model_cls=cls,
+                         quantize=config.quantize,
+                         decrypt_key=decrypt_key)
 
     # the ServingServer owns the dynamic batcher; frontends are ingress
     # into the same batcher (reference: REST and gRPC frontends share
@@ -128,9 +153,12 @@ def start_serving(config: "ServingConfig | str", block: bool = False,
     srv = ServingServer(model, host=config.host,
                         port=config.port if serve_http else 0,
                         max_batch_size=config.max_batch_size,
-                        batch_timeout_ms=config.batch_timeout_ms)
+                        batch_timeout_ms=config.batch_timeout_ms,
+                        worker_pool=pool)
     srv.start(block=False, http=serve_http)
     out: Dict[str, Any] = {"model": model}
+    if pool is not None:
+        out["pool"] = pool
     if serve_http:
         out["http"] = srv
     else:
@@ -159,7 +187,7 @@ def start_serving(config: "ServingConfig | str", block: bool = False,
 
 
 def stop_serving(servers: Dict[str, Any]) -> None:
-    for key in ("http", "grpc", "_batcher"):
+    for key in ("http", "grpc", "_batcher", "pool"):
         srv = servers.get(key)
         if srv is not None:
             srv.stop()

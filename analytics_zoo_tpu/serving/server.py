@@ -55,11 +55,21 @@ class _Pending:
 class ServingServer:
     """start() serves until stop(); thread-safe for concurrent clients."""
 
-    def __init__(self, model: InferenceModel, host: str = "127.0.0.1",
+    def __init__(self, model: InferenceModel = None,
+                 host: str = "127.0.0.1",
                  port: int = 0, max_batch_size: int = 32,
                  batch_timeout_ms: float = 5.0,
-                 result_ttl_s: float = 600.0, max_results: int = 10_000):
+                 result_ttl_s: float = 600.0, max_results: int = 10_000,
+                 worker_pool=None):
+        if model is None and worker_pool is None:
+            raise ValueError("need a model or a worker_pool")
         self.model = model
+        #: multi-replica scale-out (serving/worker_pool.py — the Flink
+        #: modelParallelism analog): batches dispatch to N replica
+        #: processes concurrently instead of the in-process model
+        self.worker_pool = worker_pool
+        self._predict = (worker_pool.predict if worker_pool is not None
+                         else model.predict)
         self.max_batch_size = max_batch_size
         self.batch_timeout_s = batch_timeout_ms / 1e3
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
@@ -97,7 +107,9 @@ class ServingServer:
                 if self.path == "/healthz":
                     self._json(200, {
                         "status": "ok",
-                        "records_served": server.model.records_served,
+                        "records_served": server.records_served,
+                        "replicas": (server.worker_pool.n_workers
+                                     if server.worker_pool else 1),
                         "batches_run": server._batches_run})
                     return
                 if self.path == "/metrics":
@@ -224,25 +236,44 @@ class ServingServer:
             self._expired.pop(uri, None)
             self._results[uri] = (now, payload)
 
+    @property
+    def records_served(self) -> int:
+        return (self.worker_pool.records_served if self.worker_pool
+                else self.model.records_served)
+
     def _batcher(self):
         """Drain the queue into device-batches (the FlinkInference.map
-        analog)."""
-        while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            batch = [first]
-            deadline = time.monotonic() + self.batch_timeout_s
-            while len(batch) < self.max_batch_size:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
+        analog).  With a worker pool, assembled batches dispatch to
+        replicas CONCURRENTLY (the pool's checkout queue is the
+        backpressure); single-model servers run them inline."""
+        executor = None
+        if self.worker_pool is not None:
+            from concurrent.futures import ThreadPoolExecutor
+            executor = ThreadPoolExecutor(
+                max_workers=self.worker_pool.n_workers)
+        try:
+            while not self._stop.is_set():
                 try:
-                    batch.append(self._queue.get(timeout=remaining))
+                    first = self._queue.get(timeout=0.05)
                 except queue.Empty:
-                    break
-            self._run_batch(batch)
+                    continue
+                batch = [first]
+                deadline = time.monotonic() + self.batch_timeout_s
+                while len(batch) < self.max_batch_size:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(self._queue.get(timeout=remaining))
+                    except queue.Empty:
+                        break
+                if executor is not None:
+                    executor.submit(self._run_batch, batch)
+                else:
+                    self._run_batch(batch)
+        finally:
+            if executor is not None:
+                executor.shutdown(wait=False)
 
     def _run_batch(self, batch: List[_Pending]):
         try:
@@ -256,7 +287,7 @@ class ServingServer:
                 np.concatenate([p.inputs[i] for p in batch])
                 for i in range(len(batch[0].inputs)))
             t1 = time.perf_counter()
-            outs = self.model.predict(*stacked)
+            outs = self._predict(*stacked)
             self.timer.record("batch_assemble", t1 - t0, sum(sizes))
             self.timer.record("predict", time.perf_counter() - t1,
                               sum(sizes))
