@@ -1,13 +1,17 @@
 """TCMF — Temporal Convolutional Matrix Factorization for forecasting
-many (thousands+) related series jointly.
+many (thousands+) related series jointly, with the DeepGLO hybrid.
 
 Reference: `pyzoo/zoo/chronos/model/tcmf/DeepGLO.py` (+
 `forecaster/tcmf_forecaster.py`, 4647 LoC): factorize the series matrix
 Y[n, T] ≈ F[n, k] · X[k, T], model the k temporal basis rows with a TCN,
-forecast the basis forward, and recombine; trained distributed over Ray
-actors.
+forecast the basis forward, and recombine; then a HYBRID per-series
+local network (`train_Yseq`) consumes the global reconstruction as a
+covariate alongside time/user covariates (`create_Ycov`,
+`get_time_covs`) to model what the low-rank global factorization cannot
+(per-series idiosyncrasies); `fit_incremental`/`append_new_y` roll the
+model forward as new columns arrive.
 
-TPU-native re-design (this is NOT a port of DeepGLO's alternating loop):
+TPU-native re-design (NOT a port of DeepGLO's alternating loop):
 
 1. Factorization runs ON THE ENGINE as an embedding model — F is an
    `nn.Embed` table over series ids (sharded over "tp" via shard_rules
@@ -18,8 +22,16 @@ TPU-native re-design (this is NOT a port of DeepGLO's alternating loop):
 2. The basis X (k series, length T) is then rolled into windows and fit
    by the existing TCNForecaster — reusing the framework's TCN rather
    than a second private TCN implementation.
-3. predict(horizon) autoregressively rolls the TCN over X and returns
-   F · X_future.
+3. The hybrid local model is a second shared TCN over per-series
+   windows whose input channels are [y, global reconstruction,
+   covariates...] — one network for all series (the reference's Yseq),
+   conditioned per-series through the reconstruction channel.
+4. predict(horizon) rolls the basis TCN forward, recombines through F,
+   and (hybrid) rolls the local TCN autoregressively with the global
+   forecast + future covariates as channels.
+5. fit_incremental(x_incr) appends the new columns, extends the basis
+   X with a warm start from the trained params (`Estimator.set_params`)
+   and refits briefly — the reference's rolling-retrain capability.
 """
 
 from __future__ import annotations
@@ -49,61 +61,131 @@ class _Factorization(nn.Module):
         return f_rows @ x_basis
 
 
+def _time_covariates(T: int, dti=None, t0: int = 0,
+                     ramp_scale: Optional[int] = None) -> np.ndarray:
+    """[c_t, T] default time covariates (reference get_time_covs,
+    DeepGLO.py:653): calendar features from a DatetimeIndex, or a
+    normalized time ramp when none is given.  The ramp is ABSOLUTE —
+    `t0` is the global index of the first column and `ramp_scale` the
+    denominator fixed at first fit — so predict/fit_incremental windows
+    continue the training ramp instead of restarting at 0 (which would
+    feed the local net out-of-distribution covariates)."""
+    if dti is not None:
+        import pandas as pd
+        dti = pd.DatetimeIndex(dti)
+        return np.stack([
+            dti.hour.to_numpy() / 23.0,
+            dti.dayofweek.to_numpy() / 6.0,
+            (dti.day.to_numpy() - 1) / 30.0,
+            (dti.month.to_numpy() - 1) / 11.0,
+        ]).astype(np.float32)
+    scale = max((ramp_scale if ramp_scale is not None else T) - 1, 1)
+    return (np.arange(t0, t0 + T, dtype=np.float32) / scale)[None]
+
+
 class TCMFForecaster:
     """fit on Y [n_series, T]; predict(horizon) -> [n_series, horizon].
 
-    `vbsize`/`hbsize`/`num_channels_X` keep reference naming
-    (tcmf_forecaster.py ctor)."""
+    `vbsize`/`num_channels_X`/`num_channels_Y`/`use_time` keep reference
+    naming (tcmf_forecaster.py ctor).  `hybrid=True` (default, the
+    DeepGLO behavior) trains the local per-series network on top of the
+    global factorization."""
 
     def __init__(self, vbsize: int = 128, rank: int = 16,
                  tcn_lookback: int = 16,
                  num_channels_X: tuple = (32, 32),
+                 num_channels_Y: tuple = (16, 16),
+                 use_time: bool = True,
+                 hybrid: bool = True,
+                 max_local_samples: int = 20_000,
                  lr: float = 5e-3, seed: int = 0):
         self.vbsize = vbsize          # vertical (series) batch size
         self.rank = rank
         self.tcn_lookback = tcn_lookback
         self.num_channels_X = tuple(num_channels_X)
+        self.num_channels_Y = tuple(num_channels_Y)
+        self.use_time = use_time
+        self.hybrid = hybrid
+        self.max_local_samples = max_local_samples
         self.lr = lr
         self.seed = seed
         self._est = None              # factorization estimator
         self._tcn = None              # basis forecaster
+        self._local = None            # hybrid per-series forecaster
         self.n = self.T = None
+        self._cov = None              # [c, T] stacked covariates
+
+    # -- covariates ------------------------------------------------------
+
+    def _stack_covariates(self, T, covariates, dti, t0: int = 0):
+        parts = []
+        if self.use_time:
+            parts.append(_time_covariates(
+                T, dti, t0=t0, ramp_scale=getattr(self, "_ramp_scale",
+                                                  None)))
+        if covariates is not None:
+            cov = np.asarray(covariates, np.float32)
+            if cov.ndim != 2 or cov.shape[1] != T:
+                raise ValueError(
+                    f"covariates must be [r, T={T}], got {cov.shape}")
+            parts.append(cov)
+        if not parts:
+            return np.zeros((0, T), np.float32)
+        return np.concatenate(parts, axis=0)
 
     # -- stage 1: factorization on the SPMD engine ----------------------
 
     def fit(self, x, val_len: int = 0, epochs: int = 20,
-            batch_size: Optional[int] = None):
+            batch_size: Optional[int] = None,
+            covariates=None, dti=None):
         """`x` is {"y": [n, T]} (reference input convention) or a bare
-        [n, T] ndarray."""
-        from analytics_zoo_tpu.chronos.forecaster import TCNForecaster
-        from analytics_zoo_tpu.orca.learn.estimator import Estimator
-
+        [n, T] ndarray.  `covariates` [r, T] are global for all series;
+        with `use_time` the default time covariates are stacked on top
+        (reference fit(..., covariates, dti))."""
         y = np.asarray(x["y"] if isinstance(x, dict) else x, np.float32)
         if y.ndim != 2:
             raise ValueError(f"TCMF expects [n_series, T], got {y.shape}")
         self.n, self.T = y.shape
         self._y_mean = y.mean(axis=1, keepdims=True)
         self._y_std = y.std(axis=1, keepdims=True) + 1e-6
-        yn = (y - self._y_mean) / self._y_std
+        self._yn = (y - self._y_mean) / self._y_std
+        self._ramp_scale = self.T
+        self._cov = self._stack_covariates(self.T, covariates, dti)
+
+        self._fit_factorization(epochs, batch_size)
+        self._fit_basis_tcn(epochs)
+        if self.hybrid:
+            self._fit_local(epochs)
+        return self
+
+    def _fit_factorization(self, epochs, batch_size,
+                           warm_params=None):
+        from analytics_zoo_tpu.orca.learn.estimator import Estimator
 
         self._est = Estimator.from_flax(
             _Factorization(self.n, self.rank, self.T),
             loss="mse", optimizer="adam", learning_rate=self.lr,
             shard_rules={"embed": "tp"}, seed=self.seed)
+        if warm_params is not None:
+            self._est.set_params(warm_params)
         ids = np.arange(self.n, dtype=np.int32)
         # small n would mean one optimizer step per epoch and pure
         # host-loop overhead; tile the id set so each epoch carries
         # several hundred rows of work
         reps = max(1, min(16, 512 // max(self.n, 1)))
         ids_t = np.tile(ids, reps)
-        self._est.fit({"x": ids_t, "y": np.tile(yn, (reps, 1))},
+        self._est.fit({"x": ids_t, "y": np.tile(self._yn, (reps, 1))},
                       epochs=epochs,
                       batch_size=batch_size or min(self.vbsize, self.n))
-
-        # -- stage 2: TCN over the learned temporal basis --------------
         params = self._est.get_model()
-        self._X = np.asarray(params["x_basis"])          # [k, T]
+        self._X = np.asarray(params["x_basis"])               # [k, T]
         self._F = np.asarray(params["embed_f"]["embedding"])  # [n, k]
+
+    # -- stage 2: TCN over the learned temporal basis --------------------
+
+    def _fit_basis_tcn(self, epochs):
+        from analytics_zoo_tpu.chronos.forecaster import TCNForecaster
+
         lb = min(self.tcn_lookback, self.T - 1)
         self._tcn = TCNForecaster(
             past_seq_len=lb, future_seq_len=1, input_feature_num=1,
@@ -119,28 +201,154 @@ class TCMFForecaster:
                        "y": np.asarray(ys, np.float32)[:, None, None]},
                       epochs=max(2, min(20, epochs // 2)),
                       batch_size=min(256, len(xs)))
-        return self
 
-    def predict(self, horizon: int = 1) -> np.ndarray:
-        """Roll the basis TCN `horizon` steps ahead autoregressively and
-        recombine through F (reference DeepGLO predict path)."""
-        if self._tcn is None:
-            raise RuntimeError("call fit first")
+    # -- stage 3: DeepGLO hybrid local network ---------------------------
+
+    def _local_channels(self):
+        return 2 + self._cov.shape[0]   # y, global recon, covariates
+
+    def _fit_local(self, epochs):
+        """Train the shared per-series network on [y, recon, cov...]
+        windows (reference train_Yseq with Ycov = global prediction,
+        DeepGLO.py:421,464)."""
+        from analytics_zoo_tpu.chronos.forecaster import TCNForecaster
+
+        lb = min(self.tcn_lookback, self.T - 1)
+        recon = (self._F @ self._X)                 # [n, T] normalized
+        # subsample (series, offset) INDEX pairs before materializing
+        # windows: at the module's "thousands+ series" scale the full
+        # n*(T-lb) window set would not fit in host memory
+        n_win = self.n * (self.T - lb)
+        if n_win > self.max_local_samples:
+            flat = np.random.default_rng(self.seed).choice(
+                n_win, self.max_local_samples, replace=False)
+        else:
+            flat = np.arange(n_win)
+        xs = np.empty((len(flat), lb, self._local_channels()),
+                      np.float32)
+        ys = np.empty((len(flat), 1, 1), np.float32)
+        for j, idx in enumerate(flat):
+            i, t0 = divmod(int(idx), self.T - lb)
+            xs[j, :, 0] = self._yn[i, t0:t0 + lb]
+            xs[j, :, 1] = recon[i, t0:t0 + lb]
+            for c in range(self._cov.shape[0]):
+                xs[j, :, 2 + c] = self._cov[c, t0:t0 + lb]
+            ys[j, 0, 0] = self._yn[i, t0 + lb]
+        self._local = TCNForecaster(
+            past_seq_len=lb, future_seq_len=1,
+            input_feature_num=self._local_channels(),
+            output_feature_num=1, num_channels=self.num_channels_Y,
+            lr=self.lr, seed=self.seed)
+        self._local.fit({"x": xs, "y": ys},
+                        epochs=max(2, min(20, epochs // 2)),
+                        batch_size=min(256, len(xs)))
+
+    # -- prediction ------------------------------------------------------
+
+    def _roll_basis(self, horizon):
         lb = min(self.tcn_lookback, self.T - 1)
         X = self._X.copy()
         for _ in range(horizon):
             window = X[:, -lb:][..., None].astype(np.float32)
             nxt = self._tcn.predict({"x": window})  # [k, 1, 1]
             X = np.concatenate([X, nxt[:, :, 0]], axis=1)
-        x_future = X[:, self.T:]                     # [k, horizon]
-        out = self._F @ x_future                     # [n, horizon]
+        return X[:, self.T:]                         # [k, horizon]
+
+    def predict(self, horizon: int = 1, future_covariates=None,
+                future_dti=None) -> np.ndarray:
+        """Global path: roll the basis TCN `horizon` steps ahead and
+        recombine through F.  Hybrid: the local network then rolls each
+        series forward with [its own history, the global forecast,
+        future covariates] as channels (reference predict_horizon,
+        DeepGLO.py:690)."""
+        if self._tcn is None:
+            raise RuntimeError("call fit first")
+        x_future = self._roll_basis(horizon)
+        global_n = self._F @ x_future                # [n, horizon], norm
+        if not self.hybrid or self._local is None:
+            return global_n * self._y_std + self._y_mean
+
+        cov_future = self._stack_covariates(
+            horizon, future_covariates, future_dti, t0=self.T) \
+            if (self.use_time or future_covariates is not None) else \
+            np.zeros((0, horizon), np.float32)
+        if cov_future.shape[0] != self._cov.shape[0]:
+            raise ValueError(
+                f"future covariates give {cov_future.shape[0]} channels "
+                f"but the model was fit with {self._cov.shape[0]}; pass "
+                "the same covariate rows to predict")
+        lb = min(self.tcn_lookback, self.T - 1)
+        recon = self._F @ self._X                    # [n, T]
+        # rolling buffers: [n, T+h] histories of y / recon / covariates
+        y_hist = self._yn.copy()
+        r_hist = np.concatenate([recon, global_n], axis=1)
+        c_hist = np.concatenate([self._cov, cov_future], axis=1)
+        for h in range(horizon):
+            t = self.T + h
+            chans = [y_hist[:, t - lb:t], r_hist[:, t - lb:t]]
+            chans += [np.broadcast_to(c_hist[j, t - lb:t],
+                                      (self.n, lb))
+                      for j in range(c_hist.shape[0])]
+            window = np.stack(chans, axis=-1).astype(np.float32)
+            nxt = self._local.predict({"x": window})[:, 0, 0]  # [n]
+            y_hist = np.concatenate([y_hist, nxt[:, None]], axis=1)
+        out = y_hist[:, self.T:]
         return out * self._y_std + self._y_mean
 
-    def evaluate(self, target_value, metric=("mse",)) -> dict:
+    # -- rolling retrain -------------------------------------------------
+
+    def fit_incremental(self, x_incr, covariates_incr=None,
+                        dti_incr=None, epochs: int = 5):
+        """Append new time columns and retrain briefly from a warm start
+        (reference fit_incremental / append_new_y + rolling retrain,
+        DeepGLO.py:608,817).  The basis X is extended with its last
+        value as the init for the new columns; F and the trained X
+        prefix warm-start the factorization via Estimator.set_params."""
+        if getattr(self, "_X", None) is None:
+            # _X (not _est) is the gate: load() restores all warm-start
+            # state, so a loaded model can roll forward too
+            raise RuntimeError("call fit before fit_incremental")
+        y_incr = np.asarray(
+            x_incr["y"] if isinstance(x_incr, dict) else x_incr,
+            np.float32)
+        if y_incr.shape[0] != self.n:
+            raise ValueError(
+                f"fit_incremental needs the same {self.n} series, got "
+                f"{y_incr.shape[0]}")
+        t_new = y_incr.shape[1]
+        yn_incr = (y_incr - self._y_mean) / self._y_std
+        self._yn = np.concatenate([self._yn, yn_incr], axis=1)
+        cov_incr = self._stack_covariates(t_new, covariates_incr,
+                                          dti_incr, t0=self.T)
+        if cov_incr.shape[0] != self._cov.shape[0]:
+            raise ValueError(
+                f"incremental covariates give {cov_incr.shape[0]} "
+                f"channels, model has {self._cov.shape[0]}")
+        self._cov = np.concatenate([self._cov, cov_incr], axis=1)
+        self.T += t_new
+
+        warm = {
+            "embed_f": {"embedding": self._F},
+            "x_basis": np.concatenate(
+                [self._X,
+                 np.repeat(self._X[:, -1:], t_new, axis=1)], axis=1),
+        }
+        self._fit_factorization(epochs, None, warm_params=warm)
+        self._fit_basis_tcn(epochs)
+        if self.hybrid:
+            self._fit_local(epochs)
+        return self
+
+    # -- evaluation ------------------------------------------------------
+
+    def evaluate(self, target_value, metric=("mse",),
+                 future_covariates=None, future_dti=None) -> dict:
         y_true = np.asarray(
             target_value["y"] if isinstance(target_value, dict)
             else target_value, np.float32)
-        pred = self.predict(horizon=y_true.shape[1])
+        pred = self.predict(horizon=y_true.shape[1],
+                            future_covariates=future_covariates,
+                            future_dti=future_dti)
         out = {}
         for m in metric:
             if m == "mse":
@@ -159,14 +367,23 @@ class TCMFForecaster:
                 "config": dict(vbsize=self.vbsize, rank=self.rank,
                                tcn_lookback=self.tcn_lookback,
                                num_channels_X=self.num_channels_X,
+                               num_channels_Y=self.num_channels_Y,
+                               use_time=self.use_time,
+                               hybrid=self.hybrid,
+                               max_local_samples=self.max_local_samples,
                                lr=self.lr, seed=self.seed),
                 "n": self.n, "T": self.T,
+                "ramp_scale": getattr(self, "_ramp_scale", None),
                 "F": getattr(self, "_F", None),
                 "X": getattr(self, "_X", None),
+                "yn": getattr(self, "_yn", None),
+                "cov": getattr(self, "_cov", None),
                 "y_mean": getattr(self, "_y_mean", None),
                 "y_std": getattr(self, "_y_std", None),
                 "tcn_params": (self._tcn._estimator().get_model()
                                if self._tcn is not None else None),
+                "local_params": (self._local._estimator().get_model()
+                                 if self._local is not None else None),
             }, f, protocol=pickle.HIGHEST_PROTOCOL)
         return path
 
@@ -177,13 +394,24 @@ class TCMFForecaster:
             d = pickle.load(f)
         self = cls(**d["config"])
         self.n, self.T = d["n"], d["T"]
+        if d.get("ramp_scale") is not None:
+            self._ramp_scale = d["ramp_scale"]
         self._F, self._X = d["F"], d["X"]
+        self._yn = d.get("yn")
+        self._cov = d.get("cov")
         self._y_mean, self._y_std = d["y_mean"], d["y_std"]
+        lb = min(self.tcn_lookback, self.T - 1)
         if d["tcn_params"] is not None:
-            lb = min(self.tcn_lookback, self.T - 1)
             self._tcn = TCNForecaster(
                 past_seq_len=lb, future_seq_len=1, input_feature_num=1,
                 output_feature_num=1,
                 num_channels=self.num_channels_X, lr=self.lr)
             self._tcn._estimator()._params = d["tcn_params"]
+        if d.get("local_params") is not None:
+            self._local = TCNForecaster(
+                past_seq_len=lb, future_seq_len=1,
+                input_feature_num=self._local_channels(),
+                output_feature_num=1,
+                num_channels=self.num_channels_Y, lr=self.lr)
+            self._local._estimator()._params = d["local_params"]
         return self

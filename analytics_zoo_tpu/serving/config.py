@@ -135,7 +135,9 @@ def start_serving(config: "ServingConfig | str", block: bool = False,
         pool = WorkerPool(config.model_path, n_workers=config.replicas,
                           model_cls=cls_name,
                           quantize=config.quantize,
-                          decrypt_key_env=config.decrypt_key_env)
+                          decrypt_key_env=config.decrypt_key_env,
+                          max_batch_size=config.max_batch_size,
+                          model_parallelism=config.model_parallelism)
     else:
         model = InferenceModel(
             supported_concurrent_num=config.model_parallelism,
@@ -150,12 +152,19 @@ def start_serving(config: "ServingConfig | str", block: bool = False,
     # no HTTP port is bound or served
     from analytics_zoo_tpu.serving.server import ServingServer
     serve_http = config.protocol in ("http", "both")
-    srv = ServingServer(model, host=config.host,
-                        port=config.port if serve_http else 0,
-                        max_batch_size=config.max_batch_size,
-                        batch_timeout_ms=config.batch_timeout_ms,
-                        worker_pool=pool)
-    srv.start(block=False, http=serve_http)
+    try:
+        srv = ServingServer(model, host=config.host,
+                            port=config.port if serve_http else 0,
+                            max_batch_size=config.max_batch_size,
+                            batch_timeout_ms=config.batch_timeout_ms,
+                            worker_pool=pool)
+        srv.start(block=False, http=serve_http)
+    except Exception:
+        # don't leak N live replica processes when the server can't
+        # come up (e.g. port already bound)
+        if pool is not None:
+            pool.stop()
+        raise
     out: Dict[str, Any] = {"model": model}
     if pool is not None:
         out["pool"] = pool

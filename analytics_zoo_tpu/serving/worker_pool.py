@@ -73,7 +73,9 @@ class _Worker:
 
     def __init__(self, model_path: str, model_cls: Optional[str],
                  quantize: bool, decrypt_key_env: Optional[str],
-                 env: Optional[Dict[str, str]]):
+                 env: Optional[Dict[str, str]],
+                 max_batch_size: int = 256,
+                 model_parallelism: int = 1):
         code = (
             "import os, sys\n"
             "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
@@ -90,7 +92,9 @@ class _Worker:
         self.served = 0   # records served by THIS replica
         _send(self.proc.stdin, {
             "model_path": model_path, "model_cls": model_cls,
-            "quantize": quantize, "decrypt_key_env": decrypt_key_env})
+            "quantize": quantize, "decrypt_key_env": decrypt_key_env,
+            "max_batch_size": max_batch_size,
+            "model_parallelism": model_parallelism})
 
     def wait_ready(self) -> None:
         ack = _recv(self.proc.stdout)
@@ -107,10 +111,13 @@ class _Worker:
         return payload
 
     def stop(self):
-        try:
-            _send(self.proc.stdin, ("exit", None))
-        except Exception:
-            pass
+        # take the frame lock so an in-flight predict's write cannot
+        # interleave with the exit frame (frames exceed PIPE_BUF)
+        with self.lock:
+            try:
+                _send(self.proc.stdin, ("exit", None))
+            except Exception:
+                pass
         try:
             self.proc.wait(timeout=5)
         except Exception:
@@ -125,12 +132,16 @@ class WorkerPool:
                  model_cls: Optional[str] = None,
                  quantize: bool = False,
                  decrypt_key_env: Optional[str] = None,
-                 worker_env: Optional[Dict[str, str]] = None):
+                 worker_env: Optional[Dict[str, str]] = None,
+                 max_batch_size: int = 256,
+                 model_parallelism: int = 1):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
         self.n_workers = n_workers
+        self._stopping = False
         self._spawn_args = (model_path, model_cls, quantize,
-                            decrypt_key_env, worker_env)
+                            decrypt_key_env, worker_env,
+                            max_batch_size, model_parallelism)
         self._workers = []
         try:
             # spawn all first (configs already sent), then collect the
@@ -166,8 +177,12 @@ class WorkerPool:
             # the replica process died: REPLACE it so the pool heals
             # instead of handing the corpse to 1/N of future batches.
             # Only a live worker goes back in the checkout queue; if the
-            # respawn fails too, the pool shrinks by one.
+            # pool is shutting down (or the respawn fails) it shrinks
+            # by one instead of leaking a fresh orphan process.
             w.stop()
+            if self._stopping:
+                raise RuntimeError(
+                    f"serving replica stopped ({e})") from e
             try:
                 repl = _Worker(*self._spawn_args)
                 repl.wait_ready()
@@ -191,7 +206,8 @@ class WorkerPool:
         return [w.served for w in self._workers]
 
     def stop(self):
-        for w in self._workers:
+        self._stopping = True
+        for w in list(self._workers):
             w.stop()
 
 
@@ -213,7 +229,9 @@ def worker_main():  # pragma: no cover - runs in the child process
             decrypt_key = os.environ.get(cfg["decrypt_key_env"])
         cls = (_find_zoo_model_class(cfg["model_cls"])
                if cfg.get("model_cls") else None)
-        model = InferenceModel()
+        model = InferenceModel(
+            supported_concurrent_num=cfg.get("model_parallelism", 1),
+            max_batch_size=cfg.get("max_batch_size", 256))
         model.load_model(cfg["model_path"], model_cls=cls,
                          quantize=cfg.get("quantize", False),
                          decrypt_key=decrypt_key)
