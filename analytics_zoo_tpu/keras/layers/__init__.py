@@ -119,4 +119,8 @@ from analytics_zoo_tpu.keras.layers.pooling import (  # noqa: F401
     GlobalAveragePooling3D,
     GlobalMaxPooling3D,
 )
-from analytics_zoo_tpu.keras.layers.embeddings import WordEmbedding  # noqa: F401,E501
+from analytics_zoo_tpu.keras.layers.embeddings import (  # noqa: F401,E501
+    WordEmbedding,
+    glove_word_embedding,
+    read_glove_vectors,
+)

@@ -72,3 +72,46 @@ class WordEmbedding(Layer):
 
     def apply_flax(self, m, x, training=False):
         return m(x)
+
+
+def read_glove_vectors(path: str):
+    """Parse a GloVe/word2vec-style text file — one token per line,
+    ``word v1 v2 ... vD`` — into ({word: vector}, dim) (reference
+    WordEmbedding's embedding-file loader,
+    pyzoo/zoo/pipeline/api/keras/layers/embeddings.py:113).  A leading
+    word2vec header line ("<count> <dim>") is skipped."""
+    import numpy as _np
+    vectors = {}
+    dim = None
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f):
+            # split on whitespace runs: hand-edited/word2vec-text files
+            # carry double or trailing spaces
+            parts = line.split()
+            if lineno == 0 and len(parts) == 2:
+                continue  # word2vec header
+            if len(parts) < 2:
+                continue
+            word, vals = parts[0], parts[1:]
+            vec = _np.asarray([float(v) for v in vals], _np.float32)
+            if dim is None:
+                dim = len(vec)
+            elif len(vec) != dim:
+                raise ValueError(
+                    f"{path}:{lineno + 1}: vector for {word!r} has "
+                    f"{len(vec)} dims, expected {dim}")
+            vectors[word] = vec
+    if dim is None:
+        raise ValueError(f"{path}: no vectors found")
+    return vectors, dim
+
+
+def glove_word_embedding(path: str, word_index: dict,
+                         trainable: bool = False,
+                         name: Optional[str] = None) -> WordEmbedding:
+    """WordEmbedding layer straight from a GloVe file + a {word: idx}
+    vocabulary (ids start at 1; row 0 pads; out-of-file words keep zero
+    vectors — the reference's semantics)."""
+    vectors, dim = read_glove_vectors(path)
+    return WordEmbedding.from_word_index(word_index, vectors, dim,
+                                         trainable=trainable, name=name)

@@ -344,18 +344,36 @@ def _gather(mod, node, x, indices):
 
 
 @_op("Pad")
-def _pad(mod, node, x, pads=None, value=None):
+def _pad(mod, node, x, pads=None, value=None, axes=None):
     if pads is None:
         pads = _attr(node, "pads")
     pads = _static_ints(pads, "Pad widths")
     n = x.ndim
+    if axes is not None:                 # opset>=18 per-axis pads
+        ax = [a % n for a in _static_ints(axes, "Pad axes")]
+        full = [0] * (2 * n)
+        for j, a in enumerate(ax):
+            full[a] = pads[j]
+            full[a + n] = pads[j + len(ax)]
+        pads = full
     width = [(pads[i], pads[i + n]) for i in range(n)]
+    # negative pads CROP (ONNX spec): pad the positive parts, slice off
+    # the negative ones
+    pos = [(max(b, 0), max(e, 0)) for b, e in width]
     mode = (_attr(node, "mode", b"constant") or b"constant").decode()
     if mode == "constant":
         cv = float(np.asarray(value)) if value is not None else 0.0
-        return jnp.pad(x, width, constant_values=cv)
-    return jnp.pad(x, width, mode={"reflect": "reflect",
-                                   "edge": "edge"}[mode])
+        x = jnp.pad(x, pos, constant_values=cv)
+    else:
+        x = jnp.pad(x, pos, mode={"reflect": "reflect",
+                                  "edge": "edge"}[mode])
+    if any(b < 0 or e < 0 for b, e in width):
+        idx = tuple(
+            slice(-b if b < 0 else 0,
+                  (e if e < 0 else None))
+            for b, e in width)
+        x = x[idx]
+    return x
 
 
 @_op("Expand")
@@ -374,6 +392,172 @@ def _shape(mod, node, x):
 def _cast(mod, node, x):
     from analytics_zoo_tpu.pipeline.onnx.onnx_proto import DTYPE
     return x.astype(DTYPE[_attr(node, "to")])
+
+
+for _name, _fn in [("Equal", jnp.equal), ("Greater", jnp.greater),
+                   ("Less", jnp.less), ("GreaterOrEqual",
+                                        jnp.greater_equal),
+                   ("LessOrEqual", jnp.less_equal),
+                   ("And", jnp.logical_and), ("Or", jnp.logical_or)]:
+    _OPS[_name] = (lambda fn: lambda mod, node, a, b: fn(a, b))(_fn)
+_OPS["Not"] = lambda mod, node, x: jnp.logical_not(x)
+_OPS["Where"] = lambda mod, node, c, a, b: jnp.where(c, a, b)
+
+
+@_op("Tile")
+def _tile(mod, node, x, repeats):
+    return jnp.tile(x, _static_ints(repeats, "Tile repeats"))
+
+
+@_op("Resize")
+def _resize(mod, node, x, roi=None, scales=None, sizes=None):
+    """Image resize (opset 11+ input layout; opset 10's single `scales`
+    input also lands here).  Modes: nearest / linear.  Exact for the
+    torch-export conventions: nearest+asymmetric+floor via index
+    gather; linear+(pytorch_)half_pixel via jax.image.resize (which
+    uses the half-pixel convention)."""
+    mode = (_attr(node, "mode", b"nearest") or b"nearest").decode()
+    ct = (_attr(node, "coordinate_transformation_mode",
+                b"half_pixel") or b"half_pixel").decode()
+    nearest_mode = (_attr(node, "nearest_mode", b"round_prefer_floor")
+                    or b"round_prefer_floor").decode()
+    if scales is None and sizes is None and roi is not None:
+        # opset-10 layout: the second input IS scales (no roi yet)
+        scales, roi = roi, None
+    if sizes is not None and np.size(np.asarray(sizes)):
+        out_shape = tuple(_static_ints(sizes, "Resize sizes"))
+        scl = [o / i for o, i in zip(out_shape, x.shape)]
+    else:
+        if scales is None or not np.size(np.asarray(scales)):
+            raise NotImplementedError("Resize needs scales or sizes")
+        scl = [float(s) for s in np.asarray(scales).ravel()]
+        out_shape = tuple(int(np.floor(i * s))
+                          for i, s in zip(x.shape, scl))
+    if mode == "nearest":
+        if ct == "asymmetric" and nearest_mode == "floor":
+            # the torch interpolate(mode='nearest') convention — exact
+            out = x
+            for ax, (o, i) in enumerate(zip(out_shape, x.shape)):
+                if o != i:
+                    idx = np.floor(np.arange(o) / scl[ax]).astype(
+                        np.int32).clip(0, i - 1)
+                    out = jnp.take(out, jnp.asarray(idx), axis=ax)
+            return out
+        method = "nearest"
+    elif mode == "linear":
+        if ct not in ("half_pixel", "pytorch_half_pixel"):
+            raise NotImplementedError(
+                f"Resize linear with {ct!r} is not supported (export "
+                "with align_corners=False for half_pixel)")
+        method = "linear"
+    else:
+        raise NotImplementedError(f"Resize mode {mode!r}")
+    return jax.image.resize(x, out_shape, method=method)
+
+
+def _rnn_dirs(node):
+    """Direction handling shared by LSTM/GRU: -> [reverse?] flags, one
+    per ONNX num_direction."""
+    direction = (_attr(node, "direction", b"forward")
+                 or b"forward").decode()
+    if _attr(node, "layout", 0):
+        raise NotImplementedError("RNN layout=1 (batch-first) is not "
+                                  "supported; export with layout=0")
+    return {"forward": [False], "reverse": [True],
+            "bidirectional": [False, True]}[direction]
+
+
+@_op("LSTM")
+def _lstm_op(mod, node, x, w, r, b=None, seq_lens=None,
+             init_h=None, init_c=None, p=None):
+    """ONNX LSTM (gate order i, o, f, c; default activations
+    sigmoid/tanh/tanh).  x [seq, batch, in]; W [D, 4H, in];
+    R [D, 4H, H]; B [D, 8H].  Peepholes are not supported."""
+    if seq_lens is not None:
+        raise NotImplementedError("LSTM sequence_lens is not supported")
+    if p is not None:
+        raise NotImplementedError("LSTM peepholes are not supported")
+    hidden = int(_attr(node, "hidden_size"))
+    dirs = _rnn_dirs(node)
+    seq, batch, _ = x.shape
+
+    def run(rev, d):
+        wd, rd = w[d].T, r[d].T                     # [in,4H], [H,4H]
+        bias = (b[d][:4 * hidden] + b[d][4 * hidden:]
+                if b is not None else 0.0)
+        h0 = (init_h[d] if init_h is not None
+              else jnp.zeros((batch, hidden), x.dtype))
+        c0 = (init_c[d] if init_c is not None
+              else jnp.zeros((batch, hidden), x.dtype))
+        xs = jnp.flip(x, 0) if rev else x
+
+        def step(carry, xt):
+            h, c = carry
+            g = xt @ wd + h @ rd + bias
+            i_, o_, f_, g_ = jnp.split(g, 4, axis=-1)
+            c = jax.nn.sigmoid(f_) * c \
+                + jax.nn.sigmoid(i_) * jnp.tanh(g_)
+            h = jax.nn.sigmoid(o_) * jnp.tanh(c)
+            return (h, c), h
+
+        (h, c), ys = jax.lax.scan(step, (h0, c0), xs)
+        if rev:
+            ys = jnp.flip(ys, 0)
+        return ys, h, c
+
+    per_dir = [run(rev, d) for d, rev in enumerate(dirs)]
+    y = jnp.stack([o[0] for o in per_dir], axis=1)  # [seq, D, b, H]
+    y_h = jnp.stack([o[1] for o in per_dir], axis=0)
+    y_c = jnp.stack([o[2] for o in per_dir], axis=0)
+    return y, y_h, y_c
+
+
+@_op("GRU")
+def _gru_op(mod, node, x, w, r, b=None, seq_lens=None, init_h=None):
+    """ONNX GRU (gate order z, r, h).  `linear_before_reset=1` is the
+    torch-export convention; both variants are implemented."""
+    if seq_lens is not None:
+        raise NotImplementedError("GRU sequence_lens is not supported")
+    hidden = int(_attr(node, "hidden_size"))
+    lbr = int(_attr(node, "linear_before_reset", 0))
+    dirs = _rnn_dirs(node)
+    seq, batch, _ = x.shape
+
+    def run(rev, d):
+        wd, rd = w[d].T, r[d].T                     # [in,3H], [H,3H]
+        wb = b[d][:3 * hidden] if b is not None else jnp.zeros(
+            3 * hidden, x.dtype)
+        rb = b[d][3 * hidden:] if b is not None else jnp.zeros(
+            3 * hidden, x.dtype)
+        h0 = (init_h[d] if init_h is not None
+              else jnp.zeros((batch, hidden), x.dtype))
+        xs = jnp.flip(x, 0) if rev else x
+
+        def step(h, xt):
+            gx = xt @ wd + wb                       # [b, 3H]
+            gh = h @ rd                             # [b, 3H]
+            xz, xr, xh = jnp.split(gx, 3, axis=-1)
+            hz, hr, hh = jnp.split(gh, 3, axis=-1)
+            rbz, rbr, rbh = jnp.split(rb, 3)
+            z = jax.nn.sigmoid(xz + hz + rbz)
+            rt = jax.nn.sigmoid(xr + hr + rbr)
+            if lbr:
+                n = jnp.tanh(xh + rt * (hh + rbh))
+            else:
+                n = jnp.tanh(xh + (rt * h) @ jnp.split(rd, 3, axis=1)[2]
+                             + rbh)
+            h = (1.0 - z) * n + z * h
+            return h, h
+
+        h, ys = jax.lax.scan(step, h0, xs)
+        if rev:
+            ys = jnp.flip(ys, 0)
+        return ys, h
+
+    per_dir = [run(rev, d) for d, rev in enumerate(dirs)]
+    y = jnp.stack([o[0] for o in per_dir], axis=1)
+    y_h = jnp.stack([o[1] for o in per_dir], axis=0)
+    return y, y_h
 
 
 # -- reductions --------------------------------------------------------------
@@ -417,6 +601,7 @@ _WEIGHT_SLOTS = {
     "Conv": (1, 2), "ConvTranspose": (1, 2), "Gemm": (1, 2),
     "MatMul": (1,), "BatchNormalization": (1, 2),
     "InstanceNormalization": (1, 2), "PRelu": (1,),
+    "LSTM": (1, 2, 3), "GRU": (1, 2, 3),
 }
 #: BatchNorm running stats: mutable, not trained by SGD
 _STAT_SLOTS = {"BatchNormalization": (3, 4)}
@@ -464,7 +649,12 @@ class OnnxModule(nn.Module):
                     "batch_stats", safe,
                     lambda a=arr: jnp.asarray(a)).value
             else:
-                env[name] = jnp.asarray(arr)
+                # keep plain constants as NUMPY: under jit, a jnp
+                # conversion would turn them into tracers and break
+                # every shape-like consumer (Reshape/Slice/Resize/...)
+                # that must read them statically; compute ops accept
+                # numpy operands as constants either way
+                env[name] = arr
 
         out_vals = None
         for node in g.nodes:

@@ -111,13 +111,17 @@ class _Worker:
         return payload
 
     def stop(self):
-        # take the frame lock so an in-flight predict's write cannot
-        # interleave with the exit frame (frames exceed PIPE_BUF)
-        with self.lock:
+        # take the frame lock (bounded) so an in-flight predict's write
+        # cannot interleave with the exit frame (frames exceed
+        # PIPE_BUF); a replica wedged mid-predict keeps the lock, in
+        # which case we skip the polite exit and go straight to kill
+        if self.lock.acquire(timeout=5):
             try:
                 _send(self.proc.stdin, ("exit", None))
             except Exception:
                 pass
+            finally:
+                self.lock.release()
         try:
             self.proc.wait(timeout=5)
         except Exception:

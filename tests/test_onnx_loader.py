@@ -172,3 +172,213 @@ def test_onnx_estimator_finetunes():
     est.fit({"x": x, "y": y}, epochs=20, batch_size=64)
     stats = est.evaluate({"x": x, "y": y}, batch_size=64)
     assert stats["accuracy"] > 0.9, stats
+
+
+# -- round-3 breadth: recurrent ops, Resize, crop-Pad (VERDICT r2 #8) -------
+
+def _onnx_lstm_weights(torch_lstm, bidirectional=False):
+    """torch LSTM weights (gate order i,f,g,o) -> ONNX LSTM W/R/B
+    (gate order i,o,f,c), shapes [D, 4H, in]/[D, 4H, H]/[D, 8H]."""
+    import torch
+
+    def reorder(m):
+        h = m.shape[0] // 4
+        i, f, g, o = m[:h], m[h:2*h], m[2*h:3*h], m[3*h:]
+        import numpy as _np
+        return _np.concatenate([i, o, f, g], axis=0)
+
+    Ws, Rs, Bs = [], [], []
+    suffixes = [""] + (["_reverse"] if bidirectional else [])
+    for sfx in suffixes:
+        wi = reorder(getattr(torch_lstm, f"weight_ih_l0{sfx}")
+                     .detach().numpy())
+        wh = reorder(getattr(torch_lstm, f"weight_hh_l0{sfx}")
+                     .detach().numpy())
+        bi = reorder(getattr(torch_lstm, f"bias_ih_l0{sfx}")
+                     .detach().numpy())
+        bh = reorder(getattr(torch_lstm, f"bias_hh_l0{sfx}")
+                     .detach().numpy())
+        Ws.append(wi); Rs.append(wh)
+        Bs.append(np.concatenate([bi, bh]))
+    return (np.stack(Ws).astype(np.float32),
+            np.stack(Rs).astype(np.float32),
+            np.stack(Bs).astype(np.float32))
+
+
+@pytest.mark.parametrize("bidir", [False, True])
+def test_lstm_matches_torch(bidir):
+    """Our ONNX LSTM vs torch.nn.LSTM with the SAME weights (reordered
+    per the spec's i,o,f,c gate layout) — torch is the independent
+    oracle for the recurrence semantics."""
+    import torch
+
+    torch.manual_seed(0)
+    seq, batch, inp, hid = 5, 3, 6, 4
+    tl = torch.nn.LSTM(inp, hid, bidirectional=bidir)
+    x = torch.randn(seq, batch, inp)
+    ref, (ref_h, ref_c) = tl(x)
+
+    W, R, B = _onnx_lstm_weights(tl, bidir)
+    direction = b"bidirectional" if bidir else b"forward"
+    data = encode_model(
+        nodes=[("LSTM", ["x", "W", "R", "B"], ["y", "y_h", "y_c"],
+                {"hidden_size": hid, "direction": direction})],
+        initializers={"W": W, "R": R, "B": B},
+        inputs=[("x", [seq, batch, inp])], outputs=["y", "y_h", "y_c"])
+    module, _ = load_onnx(data)
+    (y, y_h, y_c), _ = _apply(module, None, x.numpy())
+    # ONNX Y is [seq, D, batch, H]; torch concatenates dirs on the last
+    d = 2 if bidir else 1
+    y = np.asarray(y).transpose(0, 2, 1, 3).reshape(seq, batch, d * hid)
+    np.testing.assert_allclose(y, ref.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y_h), ref_h.detach().numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y_c), ref_c.detach().numpy(),
+                               atol=1e-5)
+
+
+def test_gru_matches_torch():
+    """ONNX GRU (gate order z,r,h; linear_before_reset=1 is the torch
+    convention) vs torch.nn.GRU with the same weights."""
+    import torch
+
+    torch.manual_seed(1)
+    seq, batch, inp, hid = 6, 2, 5, 3
+    tg = torch.nn.GRU(inp, hid)
+    x = torch.randn(seq, batch, inp)
+    ref, ref_h = tg(x)
+
+    def reorder(m):  # torch r,z,n -> onnx z,r,h
+        h = m.shape[0] // 3
+        r, z, n = m[:h], m[h:2*h], m[2*h:]
+        return np.concatenate([z, r, n], axis=0)
+
+    W = reorder(tg.weight_ih_l0.detach().numpy())[None]
+    R = reorder(tg.weight_hh_l0.detach().numpy())[None]
+    B = np.concatenate([reorder(tg.bias_ih_l0.detach().numpy()),
+                        reorder(tg.bias_hh_l0.detach().numpy())])[None]
+    data = encode_model(
+        nodes=[("GRU", ["x", "W", "R", "B"], ["y", "y_h"],
+                {"hidden_size": hid, "linear_before_reset": 1})],
+        initializers={"W": W.astype(np.float32),
+                      "R": R.astype(np.float32),
+                      "B": B.astype(np.float32)},
+        inputs=[("x", [seq, batch, inp])], outputs=["y", "y_h"])
+    module, _ = load_onnx(data)
+    (y, y_h), _ = _apply(module, None, x.numpy())
+    np.testing.assert_allclose(np.asarray(y)[:, 0], ref.detach().numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y_h), ref_h.detach().numpy(),
+                               atol=1e-5)
+
+
+def test_resize_matches_torch():
+    import torch
+
+    x = np.arange(2 * 3 * 4 * 4, dtype=np.float32).reshape(2, 3, 4, 4)
+    # nearest, scale 2 — torch convention = asymmetric + floor
+    data = encode_model(
+        nodes=[("Resize", ["x", "", "scales"], ["y"],
+                {"mode": b"nearest",
+                 "coordinate_transformation_mode": b"asymmetric",
+                 "nearest_mode": b"floor"})],
+        initializers={"scales": np.array([1, 1, 2, 2], np.float32)},
+        inputs=[("x", [2, 3, 4, 4])], outputs=["y"])
+    module, _ = load_onnx(data)
+    out, _ = _apply(module, None, x)
+    ref = torch.nn.functional.interpolate(
+        torch.from_numpy(x), scale_factor=2.0, mode="nearest").numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)
+
+    # bilinear half_pixel (align_corners=False)
+    data = encode_model(
+        nodes=[("Resize", ["x", "", "", "sizes"], ["y"],
+                {"mode": b"linear",
+                 "coordinate_transformation_mode": b"half_pixel"})],
+        initializers={"sizes": np.array([2, 3, 8, 8], np.int64)},
+        inputs=[("x", [2, 3, 4, 4])], outputs=["y"])
+    module, _ = load_onnx(data)
+    out, _ = _apply(module, None, x)
+    ref = torch.nn.functional.interpolate(
+        torch.from_numpy(x), size=(8, 8), mode="bilinear",
+        align_corners=False).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+
+def test_pad_negative_crops_and_axes():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    data = encode_model(
+        nodes=[("Pad", ["x", "pads"], ["y"])],
+        initializers={"pads": np.array([0, 1, -1, 0, -1, 1], np.int64)},
+        inputs=[("x", [2, 3, 4])], outputs=["y"])
+    module, _ = load_onnx(data)
+    out, _ = _apply(module, None, x)
+    ref = np.pad(x, [(0, 0), (1, 0), (0, 1)])[:, :-1, 1:]
+    np.testing.assert_allclose(np.asarray(out), ref)
+
+    # opset-18 style per-axis pads
+    data = encode_model(
+        nodes=[("Pad", ["x", "pads", "", "axes"], ["y"])],
+        initializers={"pads": np.array([2, 2], np.int64),
+                      "axes": np.array([2], np.int64)},
+        inputs=[("x", [2, 3, 4])], outputs=["y"])
+    module, _ = load_onnx(data)
+    out, _ = _apply(module, None, x)
+    assert np.asarray(out).shape == (2, 3, 8)
+
+
+def test_recurrent_wire_fixture_predicts_and_finetunes():
+    """A conv+resize+LSTM+head graph over the wire format: imports,
+    predicts, and FINE-TUNES (recurrent weights are trainable params)."""
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(0)
+    hid = 8
+    conv_w = (rng.normal(size=(4, 1, 3, 3)) * 0.3).astype(np.float32)
+    conv_b = np.zeros(4, np.float32)
+    W = (rng.normal(size=(1, 4 * hid, 4)) * 0.3).astype(np.float32)
+    R = (rng.normal(size=(1, 4 * hid, hid)) * 0.3).astype(np.float32)
+    B = np.zeros((1, 8 * hid), np.float32)
+    fc_w = (rng.normal(size=(2, hid)) * 0.3).astype(np.float32)
+    fc_b = np.zeros(2, np.float32)
+    data = encode_model(
+        nodes=[
+            ("Conv", ["x", "conv_w", "conv_b"], ["c"],
+             {"pads": [1, 1, 1, 1], "kernel_shape": [3, 3]}),
+            ("Relu", ["c"], ["cr"]),
+            ("Resize", ["cr", "", "scales"], ["up"],
+             {"mode": b"nearest",
+              "coordinate_transformation_mode": b"asymmetric",
+              "nearest_mode": b"floor"}),
+            ("AveragePool", ["up"], ["pool"],
+             {"kernel_shape": [2, 16], "strides": [2, 16]}),
+            # [b, 4, 8, 1] -> sequence [8, b, 4]
+            ("Squeeze", ["pool", "sq_ax"], ["sq"]),
+            ("Transpose", ["sq"], ["seq"], {"perm": [2, 0, 1]}),
+            ("LSTM", ["seq", "W", "R", "B"], ["y_all", "y_h", "y_c"],
+             {"hidden_size": hid}),
+            ("Squeeze", ["y_h", "sq0"], ["h_last"]),
+            ("Gemm", ["h_last", "fc_w", "fc_b"], ["y"], {"transB": 1}),
+        ],
+        initializers={"conv_w": conv_w, "conv_b": conv_b,
+                      "scales": np.array([1, 1, 2, 2], np.float32),
+                      "sq_ax": np.array([3], np.int64),
+                      "sq0": np.array([0], np.int64),
+                      "W": W, "R": R, "B": B,
+                      "fc_w": fc_w, "fc_b": fc_b},
+        inputs=[("x", [1, 1, 8, 8])], outputs=["y"])
+
+    x = rng.normal(size=(128, 1, 8, 8)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    est = Estimator.from_onnx(
+        data, loss="sparse_categorical_crossentropy", optimizer="adam",
+        learning_rate=3e-2, metrics=["accuracy"])
+    preds = np.asarray(est.predict({"x": x[:4]}, batch_size=4))
+    assert preds.shape == (4, 2)
+    est.fit({"x": x, "y": y}, epochs=15, batch_size=32)
+    stats = est.evaluate({"x": x, "y": y}, batch_size=32)
+    assert stats["accuracy"] > 0.85, stats
+    # the recurrent kernels really are trainable flax params
+    params = est.get_model()
+    assert any("W" in k for k in params), list(params)
