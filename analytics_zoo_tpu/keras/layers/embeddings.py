@@ -88,8 +88,10 @@ def read_glove_vectors(path: str):
             # split on whitespace runs: hand-edited/word2vec-text files
             # carry double or trailing spaces
             parts = line.split()
-            if lineno == 0 and len(parts) == 2:
-                continue  # word2vec header
+            if (lineno == 0 and len(parts) == 2
+                    and all(p.isdigit() for p in parts)):
+                continue  # word2vec header: "<count> <dim>", both ints
+                # (a headerless 1-dim embedding line keeps its word)
             if len(parts) < 2:
                 continue
             word, vals = parts[0], parts[1:]

@@ -424,6 +424,21 @@ def _resize(mod, node, x, roi=None, scales=None, sizes=None):
     if scales is None and sizes is None and roi is not None:
         # opset-10 layout: the second input IS scales (no roi yet)
         scales, roi = roi, None
+    axes = _attr(node, "axes")
+    if axes is not None:
+        # opset-18: scales/sizes cover only these axes — expand to full
+        # rank so the zips below stay aligned
+        axes = [int(a) % x.ndim for a in axes]
+        if sizes is not None and np.size(np.asarray(sizes)):
+            per_axis = dict(zip(axes, _static_ints(sizes,
+                                                   "Resize sizes")))
+            sizes = np.asarray([per_axis.get(d, x.shape[d])
+                                for d in range(x.ndim)], np.int64)
+        elif scales is not None and np.size(np.asarray(scales)):
+            per_axis = dict(zip(axes,
+                                np.asarray(scales).ravel().tolist()))
+            scales = np.asarray([per_axis.get(d, 1.0)
+                                 for d in range(x.ndim)], np.float32)
     if sizes is not None and np.size(np.asarray(sizes)):
         out_shape = tuple(_static_ints(sizes, "Resize sizes"))
         scl = [o / i for o, i in zip(out_shape, x.shape)]
@@ -452,7 +467,12 @@ def _resize(mod, node, x, roi=None, scales=None, sizes=None):
         method = "linear"
     else:
         raise NotImplementedError(f"Resize mode {mode!r}")
-    return jax.image.resize(x, out_shape, method=method)
+    # ONNX Resize defaults antialias=0; jax.image.resize antialiases on
+    # downscale by default, which silently diverges (~3% of range on a
+    # bilinear half-downscale, measured) — honor the attribute
+    antialias = bool(_attr(node, "antialias", 0))
+    return jax.image.resize(x, out_shape, method=method,
+                            antialias=antialias)
 
 
 def _rnn_dirs(node):

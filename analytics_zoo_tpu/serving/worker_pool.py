@@ -114,18 +114,25 @@ class _Worker:
         # take the frame lock (bounded) so an in-flight predict's write
         # cannot interleave with the exit frame (frames exceed
         # PIPE_BUF); a replica wedged mid-predict keeps the lock, in
-        # which case we skip the polite exit and go straight to kill
+        # which case the polite exit is skipped and the process killed
+        # directly (no point waiting for an exit frame never sent)
+        sent_exit = False
         if self.lock.acquire(timeout=5):
             try:
                 _send(self.proc.stdin, ("exit", None))
+                sent_exit = True
             except Exception:
                 pass
             finally:
                 self.lock.release()
         try:
-            self.proc.wait(timeout=5)
+            if sent_exit:
+                self.proc.wait(timeout=5)
+            else:
+                raise TimeoutError
         except Exception:
             self.proc.kill()
+            self.proc.wait()   # reap — no zombie for the parent's life
 
 
 class WorkerPool:

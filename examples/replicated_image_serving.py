@@ -1,0 +1,75 @@
+"""Replicated serving with image payloads (reference: Cluster Serving at
+Flink `modelParallelism`, ClusterServing.scala:57-70, with base64-JPEG
+inputs decoded by PreProcessing.decodeImage).
+
+Trains a small image classifier, saves it, starts serving with
+`replicas: 2` (two worker processes each holding a model copy behind the
+dynamic batcher), and sends both an ndarray request and a raw-JPEG-bytes
+request through the HTTP client.
+"""
+
+import io
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.models.image.imageclassification import (
+    ImageClassifier)
+from analytics_zoo_tpu.serving.client import InputQueue
+from analytics_zoo_tpu.serving.config import (
+    ServingConfig,
+    start_serving,
+    stop_serving,
+)
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+
+    # train + publish a tiny classifier
+    model = ImageClassifier("resnet-18", num_classes=3)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 16, 16, 3)).astype(np.float32)
+    y = (x.mean((1, 2, 3)) > 0).astype(np.int32)
+    model.estimator(learning_rate=1e-3).fit(
+        {"x": x, "y": y}, epochs=1, batch_size=8)
+    path = model.save_model(os.path.join(tempfile.mkdtemp(), "clf"))
+
+    cfg = ServingConfig(modelPath=path, replicas=2, port=0,
+                        batchTimeoutMs=2.0)
+    servers = start_serving(cfg)
+    try:
+        srv = servers["http"]
+        client = InputQueue(srv.host, srv.port)
+
+        out = client.predict(np.ones((16, 16, 3), np.float32))
+        print("ndarray request ->", np.asarray(out).round(3))
+
+        from PIL import Image
+        img = Image.fromarray(
+            (rng.random((64, 64, 3)) * 255).astype(np.uint8))
+        buf = io.BytesIO()
+        img.save(buf, format="JPEG")
+        out = client.predict_image(buf.getvalue(), resize=(16, 16))
+        print("JPEG request    ->", np.asarray(out).round(3))
+
+        health = json.load(urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/healthz"))
+        print("healthz:", health)
+        print("per-replica served:",
+              servers["pool"].per_worker_served())
+    finally:
+        stop_serving(servers)
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()
