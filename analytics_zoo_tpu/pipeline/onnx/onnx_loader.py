@@ -394,6 +394,42 @@ def _cast(mod, node, x):
     return x.astype(DTYPE[_attr(node, "to")])
 
 
+for _name, _fn in [("Sin", jnp.sin), ("Cos", jnp.cos),
+                   ("Reciprocal", jnp.reciprocal),
+                   ("Round", jnp.round)]:
+    _OPS[_name] = (lambda fn: lambda mod, node, x: fn(x))(_fn)
+
+_OPS["Gelu"] = lambda mod, node, x: jax.nn.gelu(
+    x, approximate=(_attr(node, "approximate", b"none") == b"tanh"))
+_OPS["Sum"] = lambda mod, node, *xs: sum(xs[1:], xs[0])
+_OPS["Mean"] = lambda mod, node, *xs: sum(xs[1:], xs[0]) / len(xs)
+
+
+@_op("ConstantOfShape")
+def _constant_of_shape(mod, node, shape):
+    val = _attr(node, "value")
+    val = np.asarray(val) if val is not None else np.zeros(1, np.float32)
+    return jnp.full(tuple(_static_ints(shape, "ConstantOfShape shape")),
+                    val.ravel()[0], dtype=val.dtype)
+
+
+@_op("Range")
+def _range(mod, node, start, limit, delta):
+    # ONNX Range is defined for float tensors too (fractional grids
+    # from torch exports) — keep the native scalar values, no int()
+    def scalar(v, what):
+        try:
+            return np.asarray(v).reshape(()).item()
+        except Exception as e:
+            raise NotImplementedError(
+                f"data-dependent Range {what} is not supported") from e
+
+    s = scalar(start, "start")
+    l = scalar(limit, "limit")
+    d = scalar(delta, "delta")
+    return jnp.arange(s, l, d, dtype=np.asarray(start).dtype)
+
+
 for _name, _fn in [("Equal", jnp.equal), ("Greater", jnp.greater),
                    ("Less", jnp.less), ("GreaterOrEqual",
                                         jnp.greater_equal),
@@ -595,8 +631,24 @@ def _reduce(fn):
 
 for _name, _fn in [("ReduceMean", jnp.mean), ("ReduceSum", jnp.sum),
                    ("ReduceMax", jnp.max), ("ReduceMin", jnp.min),
-                   ("ReduceProd", jnp.prod)]:
+                   ("ReduceProd", jnp.prod),
+                   ("ReduceL1", lambda x, axis=None, keepdims=False:
+                    jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)),
+                   ("ReduceL2", lambda x, axis=None, keepdims=False:
+                    jnp.sqrt(jnp.sum(x * x, axis=axis,
+                                     keepdims=keepdims))),
+                   ("ReduceLogSumExp",
+                    lambda x, axis=None, keepdims=False:
+                    jax.nn.logsumexp(x, axis=axis, keepdims=keepdims))]:
     _OPS[_name] = _reduce(_fn)
+
+
+@_op("ArgMin")
+def _argmin(mod, node, x):
+    axis = _attr(node, "axis", 0)
+    keep = bool(_attr(node, "keepdims", 1))
+    out = jnp.argmin(x, axis=axis)
+    return jnp.expand_dims(out, axis) if keep else out
 
 
 @_op("ArgMax")

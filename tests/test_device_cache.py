@@ -77,6 +77,24 @@ def test_device_cache_reused_across_fits():
     assert est.device_cache_hits == 1
 
 
+def test_device_cache_detects_inplace_mutation():
+    """In-place mutation of the source arrays between fits must
+    re-upload (content fingerprint in the key), not silently train on
+    the stale HBM copy — and the stale entry is evicted, not pinned."""
+    OrcaContext.train_data_store = "DEVICE"
+    x, y = _toy()
+    est = Estimator.from_flax(_mlp(), loss="sparse_categorical_crossentropy",
+                              optimizer="sgd", learning_rate=0.05)
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=32, shuffle=False)
+    x[:8] = -x[:8]          # in-place mutation, same id()
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=32, shuffle=False)
+    assert est.device_cache_hits == 0      # mutation => miss
+    assert len(est._device_cache) == 1     # stale entry evicted
+    # unchanged data still hits
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=32, shuffle=False)
+    assert est.device_cache_hits == 1
+
+
 def test_device_store_cap_falls_back_to_streaming():
     OrcaContext.train_data_store = "DEVICE"
     prev_cap = OrcaContext.device_cache_bytes

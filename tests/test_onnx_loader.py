@@ -382,3 +382,56 @@ def test_recurrent_wire_fixture_predicts_and_finetunes():
     # the recurrent kernels really are trainable flax params
     params = est.get_model()
     assert any("W" in k for k in params), list(params)
+
+
+def test_misc_op_breadth():
+    """Sin/Cos/Gelu/Sum/Mean/ConstantOfShape/Range/ReduceL2/ArgMin/
+    Reciprocal/Round — the long tail real exporters hit."""
+    import jax
+
+    x = np.linspace(-2, 2, 12, dtype=np.float32).reshape(3, 4)
+    cases = [
+        (("Sin", ["x"], ["y"]), {}, np.sin(x)),
+        (("Gelu", ["x"], ["y"]), {},
+         np.asarray(jax.nn.gelu(x, approximate=False))),
+        (("ReduceL2", ["x"], ["y"], {"axes": [1], "keepdims": 0}), {},
+         np.sqrt((x * x).sum(1))),
+        (("ArgMin", ["x"], ["y"], {"axis": 1, "keepdims": 0}), {},
+         np.argmin(x, 1)),
+        (("Round", ["x"], ["y"]), {}, np.round(x)),
+    ]
+    for spec, inits, ref in cases:
+        data = encode_model(nodes=[spec], initializers=dict(inits),
+                            inputs=[("x", [3, 4])], outputs=["y"])
+        module, _ = load_onnx(data)
+        out, _ = _apply(module, None, x)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5,
+                                   err_msg=spec[0])
+
+    # variadic Sum / Mean
+    data = encode_model(
+        nodes=[("Sum", ["x", "x", "x"], ["y"])],
+        initializers={}, inputs=[("x", [3, 4])], outputs=["y"])
+    out, _ = _apply(load_onnx(data)[0], None, x)
+    np.testing.assert_allclose(np.asarray(out), 3 * x, atol=1e-6)
+    data = encode_model(
+        nodes=[("Mean", ["x", "x"], ["y"])],
+        initializers={}, inputs=[("x", [3, 4])], outputs=["y"])
+    out, _ = _apply(load_onnx(data)[0], None, x)
+    np.testing.assert_allclose(np.asarray(out), x, atol=1e-6)
+
+    # ConstantOfShape + Range (shape-producing, no graph inputs beyond x)
+    data = encode_model(
+        nodes=[("ConstantOfShape", ["shp"], ["c"],
+                {"value": np.asarray([2.5], np.float32)}),
+               ("Range", ["r0", "r1", "r2"], ["r"]),
+               ("Mul", ["c", "r"], ["m"]),
+               ("Add", ["x", "m"], ["y"])],
+        initializers={"shp": np.array([3, 4], np.int64),
+                      "r0": np.array(0, np.int64),
+                      "r1": np.array(4, np.int64),
+                      "r2": np.array(1, np.int64)},
+        inputs=[("x", [3, 4])], outputs=["y"])
+    out, _ = _apply(load_onnx(data)[0], None, x)
+    np.testing.assert_allclose(np.asarray(out),
+                               x + 2.5 * np.arange(4), atol=1e-5)
