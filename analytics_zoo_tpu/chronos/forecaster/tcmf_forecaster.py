@@ -339,6 +339,53 @@ class TCMFForecaster:
             self._fit_local(epochs)
         return self
 
+    def rolling_validation(self, x, tau: int = 24, n: int = 4,
+                           epochs: int = 20, epochs_incr: int = 5,
+                           metric=("mse",),
+                           covariates=None, dti=None) -> dict:
+        """Walk-forward evaluation with retraining (reference
+        DeepGLO.rolling_validation, DeepGLO.py:817): fit on the first
+        T - n*tau columns, then n rounds of (forecast tau ahead, score
+        against the observed window, fold the window in via
+        fit_incremental).  Returns per-metric means over the rounds
+        plus the per-round scores."""
+        y = np.asarray(x["y"] if isinstance(x, dict) else x, np.float32)
+        if y.ndim != 2:
+            raise ValueError(f"TCMF expects [n_series, T], got {y.shape}")
+        T = y.shape[1]
+        t0 = T - n * tau
+        if t0 <= self.tcn_lookback:
+            raise ValueError(
+                f"rolling_validation needs T - n*tau > tcn_lookback; "
+                f"got T={T}, n={n}, tau={tau}")
+        cov = (np.asarray(covariates, np.float32)
+               if covariates is not None else None)
+
+        def cov_slice(lo, hi):
+            return cov[:, lo:hi] if cov is not None else None
+
+        def dti_slice(lo, hi):
+            return dti[lo:hi] if dti is not None else None
+
+        self.fit({"y": y[:, :t0]}, epochs=epochs,
+                 covariates=cov_slice(0, t0), dti=dti_slice(0, t0))
+        rounds = []
+        for r in range(n):
+            lo, hi = t0 + r * tau, t0 + (r + 1) * tau
+            truth = y[:, lo:hi]
+            rounds.append(self.evaluate(
+                {"y": truth}, metric=metric,
+                future_covariates=cov_slice(lo, hi),
+                future_dti=dti_slice(lo, hi)))
+            self.fit_incremental({"y": truth},
+                                 covariates_incr=cov_slice(lo, hi),
+                                 dti_incr=dti_slice(lo, hi),
+                                 epochs=epochs_incr)
+        out = {m: float(np.mean([r[m] for r in rounds]))
+               for m in metric}
+        out["rounds"] = rounds
+        return out
+
     # -- evaluation ------------------------------------------------------
 
     def evaluate(self, target_value, metric=("mse",),

@@ -101,11 +101,11 @@ def ncf_estimator_throughput(batch: int, steps: int) -> float:
         # steady state); epoch 3+ is steady
         est.fit({"x": [u, i], "y": y}, epochs=3, batch_size=batch,
                 shuffle=False)
-        # best of 3 timed windows: the tunnel's dispatch-stream jitter
+        # best of 5 timed windows: the tunnel's dispatch-stream jitter
         # swings single-window numbers ~20%; best-of-N on BOTH this and
         # the raw ceiling (same policy) keeps the ratio honest
         epochs, dt = 3, float("inf")
-        for _ in range(3):
+        for _ in range(5):
             t0 = time.perf_counter()
             est.fit({"x": [u, i], "y": y}, epochs=epochs,
                     batch_size=batch, shuffle=False)
@@ -159,9 +159,9 @@ def ncf_raw_throughput(platform: str, batch: int, steps: int,
             ub, ib, yb = batches[k % steps]
             params, opt_state, loss = step(params, opt_state, ub, ib, yb)
         float(loss)
-        # best of 3 timed windows (same policy as the estimator path)
+        # best of 5 timed windows (same policy as the estimator path)
         dt = float("inf")
-        for _ in range(3):
+        for _ in range(5):
             t0 = time.perf_counter()
             for k in range(steps):
                 ub, ib, yb = batches[k]

@@ -368,3 +368,31 @@ def test_predict_does_not_poison_roll_state():
     assert (ds.lookback, ds.horizon) == (24, 4)
     blocks = ds.to_xshards().collect()
     assert all("y" in b for b in blocks)
+
+
+def test_tcmf_rolling_validation():
+    """Walk-forward retraining evaluation (reference
+    DeepGLO.rolling_validation): per-round scores + means, model rolled
+    forward by n*tau columns at the end."""
+    from analytics_zoo_tpu.chronos.forecaster import TCMFForecaster
+
+    init_orca_context(cluster_mode="local")
+    rng = np.random.default_rng(2)
+    n_series, T = 10, 72
+    t = np.arange(T)
+    y = (rng.normal(size=(n_series, 1))
+         * np.sin(0.3 * t)[None] + 0.05
+         * rng.normal(size=(n_series, T))).astype(np.float32)
+
+    fc = TCMFForecaster(rank=3, tcn_lookback=8, num_channels_X=(8,),
+                        num_channels_Y=(8,), lr=1e-2, seed=0)
+    out = fc.rolling_validation({"y": y}, tau=8, n=2, epochs=25,
+                                epochs_incr=5, metric=("mse", "mae"))
+    assert set(out) == {"mse", "mae", "rounds"} and len(out["rounds"]) == 2
+    assert fc.T == T                       # all windows folded in
+    naive = float(np.mean(
+        (y[:, :T - 16].mean(axis=1, keepdims=True) - y[:, T - 16:]) ** 2))
+    assert out["mse"] < naive, (out, naive)
+    with pytest.raises(ValueError, match="tcn_lookback"):
+        TCMFForecaster(tcn_lookback=8).rolling_validation(
+            {"y": y[:, :20]}, tau=8, n=2)
