@@ -6,3 +6,11 @@ from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
     logical_to_sharding,
     infer_param_shardings,
 )
+from analytics_zoo_tpu.parallel.moe import (  # noqa: F401
+    MOE_SHARD_RULES,
+    SwitchMoE,
+)
+from analytics_zoo_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_self_attention,
+)

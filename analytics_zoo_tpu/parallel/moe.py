@@ -1,0 +1,210 @@
+"""Switch-style Mixture-of-Experts with expert parallelism over the
+"ep" mesh axis.
+
+The reference has NO expert parallelism (its parallelism inventory is
+data-parallel only, SURVEY.md §2.3); like ring attention this is a
+TPU-native extension.  Design is the Switch/GShard dense-dispatch
+recipe:
+
+* top-1 router with a load-balancing auxiliary loss
+  (mean(fraction_tokens_per_expert * mean_router_prob_per_expert) * E),
+* fixed per-expert CAPACITY (static shapes — XLA needs them); tokens
+  over capacity are dropped (their output is the residual zero),
+* dispatch/combine as one-hot einsums — XLA turns these into gathers/
+  scatters.  Under `shard_map` each "ep" shard builds buckets for its
+  LOCAL experts only (the one-hots select the local expert slice), so
+  the single collective is one `psum` over "ep" combining the output
+  residuals — tokens stay sharded over the data axes throughout.
+
+Expert weights are stacked [E, ...] and shard over "ep" on dim 0
+(`MOE_SHARD_RULES` uses the "ep:0" pinned-dim rule), so each ep shard
+holds E/ep experts and tokens travel to the experts, not the other way
+around.  With no "ep" axis (or size 1) the same module runs the dense
+path — identical math, no collectives.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+#: estimator shard_rules entry for SwitchMoE parameters
+MOE_SHARD_RULES = {"experts_": "ep:0"}
+
+
+def _capacity(n_tokens: int, num_experts: int,
+              capacity_factor: float) -> int:
+    return max(1, int(np.ceil(
+        capacity_factor * n_tokens / num_experts)))
+
+
+def _route(logits, num_experts: int, capacity: int):
+    """Top-1 routing -> (dispatch [n, E, C] one-hot, combine [n, E, C]
+    gate-weighted, aux load-balance loss).  n = flattened tokens."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                     # [n]
+    gate = jnp.take_along_axis(probs, expert[:, None],
+                               axis=-1)[:, 0]               # [n]
+    assigned = jax.nn.one_hot(expert, num_experts,
+                              dtype=jnp.float32)            # [n, E]
+    # Switch aux loss from PRE-drop assignments over ALL n tokens: with
+    # tight capacity the kept counts saturate uniformly and a post-drop
+    # fraction would report "balanced" exactly when the router isn't
+    frac = assigned.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = (frac * mean_prob).sum() * num_experts
+    # position of each token within its expert's bucket
+    pos = (jnp.cumsum(assigned, axis=0) - 1.0) * assigned   # [n, E]
+    keep = pos < capacity
+    onehot = assigned * keep
+    pos_in = jnp.einsum("ne,ne->n", pos, onehot)            # [n]
+    pos_onehot = jax.nn.one_hot(pos_in.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)          # [n, C]
+    dispatch = onehot[:, :, None] * pos_onehot[:, None, :]  # [n, E, C]
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine, aux
+
+
+def _expert_ffn(buckets, w1, b1, w2, b2, activation):
+    """buckets [E_local, C_total, H] through per-expert FFNs (batched
+    einsum keeps the matmuls MXU-shaped: [E, C, H] x [E, H, F])."""
+    h = jnp.einsum("ech,ehf->ecf", buckets, w1) + b1[:, None, :]
+    h = activation(h)
+    return jnp.einsum("ecf,efh->ech", h, w2) + b2[:, None, :]
+
+
+class SwitchMoE(nn.Module):
+    """Drop-in FFN replacement: [..., hidden] -> ([..., hidden], aux).
+
+    `mesh`: optional — defaults to the OrcaContext mesh at call time;
+    expert parallelism activates when it has an "ep" axis of size > 1
+    (pass `shard_rules=dict(MOE_SHARD_RULES)` to the estimator so the
+    stacked expert weights are stored ep-sharded too)."""
+
+    num_experts: int
+    hidden_size: int
+    ffn_size: int
+    capacity_factor: float = 1.25
+    activation: str = "gelu"
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        from analytics_zoo_tpu.common.context import OrcaContext
+        from analytics_zoo_tpu.keras.layers.core import get_activation
+
+        E, H, F = self.num_experts, self.hidden_size, self.ffn_size
+        if x.shape[-1] != H:
+            raise ValueError(f"SwitchMoE expects [..., {H}], "
+                             f"got {x.shape}")
+        lead = x.shape[:-1]
+        n = int(np.prod(lead))
+        xf = x.reshape(n, H)
+
+        rkern = self.param("router_kernel",
+                           nn.initializers.lecun_normal(), (H, E))
+        rbias = self.param("router_bias", nn.initializers.zeros, (E,))
+        w1 = self.param("experts_w1", nn.initializers.lecun_normal(),
+                        (E, H, F))
+        b1 = self.param("experts_b1", nn.initializers.zeros, (E, F))
+        w2 = self.param("experts_w2", nn.initializers.lecun_normal(),
+                        (E, F, H))
+        b2 = self.param("experts_b2", nn.initializers.zeros, (E, H))
+        act = get_activation(self.activation)
+
+        xd = xf.astype(self.compute_dtype)
+        mesh = None
+        try:
+            mesh = OrcaContext.mesh
+        except Exception:
+            pass
+        ep = (mesh.shape["ep"] if (mesh is not None
+                                   and "ep" in mesh.axis_names) else 1)
+        if ep > 1 and E % ep:
+            raise ValueError(
+                f"num_experts={E} must be divisible by the mesh's ep "
+                f"axis ({ep}) for expert parallelism; adjust one of "
+                "them (or drop the ep axis to run dense)")
+
+        if ep <= 1:
+            cap = _capacity(n, E, self.capacity_factor)
+            logits = xf.astype(jnp.float32) @ rkern + rbias
+            dispatch, combine, aux = _route(logits, E, cap)
+            buckets = jnp.einsum("nec,nh->ech", dispatch.astype(
+                self.compute_dtype), xd)                    # [E, C, H]
+            out_b = _expert_ffn(buckets, w1.astype(self.compute_dtype),
+                                b1.astype(self.compute_dtype),
+                                w2.astype(self.compute_dtype),
+                                b2.astype(self.compute_dtype), act)
+            y = jnp.einsum("nec,ech->nh", combine.astype(
+                self.compute_dtype), out_b)
+        else:
+            # GShard grouped routing: each data shard is a routing
+            # GROUP with its own capacity, so routing, dispatch and the
+            # expert FFN all scale with the per-shard token count
+            y, aux = _ep_dispatch(
+                xd, xf, rkern, rbias, E, self.capacity_factor,
+                w1.astype(self.compute_dtype),
+                b1.astype(self.compute_dtype),
+                w2.astype(self.compute_dtype),
+                b2.astype(self.compute_dtype),
+                act, mesh)
+        return y.reshape(*lead, H).astype(x.dtype), aux
+
+
+def _ep_dispatch(xd, xf32, rkern, rbias, num_experts: int,
+                 capacity_factor: float, w1, b1, w2, b2, activation,
+                 mesh: Mesh):
+    """shard_map expert-parallel dispatch with GShard grouped routing:
+    tokens shard over the data axes, experts over "ep" (dim 0).  Each
+    data shard is a routing GROUP — it routes its own tokens with a
+    per-group capacity, builds buckets for the LOCAL expert slice
+    (selected out of the [n_local, E, C] one-hots with the shard's
+    "ep" index), runs its experts, and the combine einsum's `psum` over
+    "ep" reduces the per-expert-shard output residuals — the single
+    collective.  Routing, dispatch and the expert FFN all scale with
+    the per-shard token count, so data parallelism is preserved through
+    the MoE layer.  Returns (y [n, H], aux scalar averaged over
+    groups)."""
+    from analytics_zoo_tpu.parallel.sharding import data_axes
+
+    daxes = data_axes(mesh)
+    tok = daxes if daxes else None        # token dim sharding
+    ep = mesh.shape["ep"]
+    e_local = num_experts // ep
+
+    def local(xd, xf32, rkern, rbias, w1, b1, w2, b2):
+        n_local = xd.shape[0]
+        cap = _capacity(n_local, num_experts, capacity_factor)
+        logits = xf32 @ rkern + rbias
+        dispatch, combine, aux = _route(logits, num_experts, cap)
+        off = jax.lax.axis_index("ep") * e_local
+        disp = jax.lax.dynamic_slice_in_dim(
+            dispatch.astype(xd.dtype), off, e_local, axis=1)
+        comb = jax.lax.dynamic_slice_in_dim(
+            combine.astype(xd.dtype), off, e_local, axis=1)
+        buckets = jnp.einsum("nec,nh->ech", disp, xd)
+        out_b = _expert_ffn(buckets, w1, b1, w2, b2, activation)
+        y_part = jnp.einsum("nec,ech->nh", comb, out_b)
+        # every ep shard contributes its local experts' outputs; tokens
+        # routed elsewhere contribute zero here — sum over the axis
+        y = jax.lax.psum(y_part, "ep")
+        if daxes:                         # mean aux over routing groups
+            aux = jax.lax.pmean(aux, daxes)
+        return y, aux
+
+    espec = P("ep")                       # expert-dim sharded operands
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(tok), P(tok), P(), P(),
+                  espec, espec, espec, espec),
+        out_specs=(P(tok), P()),
+        check_vma=False)
+    return fn(xd, xf32.astype(jnp.float32), rkern, rbias,
+              w1, b1, w2, b2)

@@ -108,21 +108,38 @@ def logical_to_sharding(rules: Dict[str, Optional[str]],
                         shape: Tuple[int, ...],
                         mesh: Mesh) -> NamedSharding:
     """Map a parameter (by its pytree path) to a NamedSharding using
-    substring rules: ``{"kernel": "tp", ...}`` shards the *last* dimension of
-    any param whose joined path contains the key over the named axis."""
+    substring rules: ``{"kernel": "tp", ...}`` shards the *largest
+    divisible* dimension of any param whose joined path contains the key
+    over the named axis.  An explicit dim can be pinned with
+    ``"axis:dim"`` — e.g. ``{"experts": "ep:0"}`` shards the expert
+    dimension (dim 0) over "ep" regardless of size ordering (expert-
+    parallel tables must split on the expert axis, not their largest)."""
     joined = "/".join(str(p) for p in path)
-    for key, axis in rules.items():
-        if key in joined and axis in mesh.axis_names and mesh.shape[axis] > 1:
-            ndim = len(shape)
-            if ndim == 0:
-                continue
-            # shard the largest dim that divides the axis size
-            order = sorted(range(ndim), key=lambda i: -shape[i])
-            for dim in order:
-                if shape[dim] % mesh.shape[axis] == 0:
-                    spec = [None] * ndim
-                    spec[dim] = axis
-                    return NamedSharding(mesh, P(*spec))
+    for key, rule in rules.items():
+        if key not in joined or rule is None:
+            continue
+        axis, _, dim_s = rule.partition(":")
+        if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+            continue
+        ndim = len(shape)
+        if ndim == 0:
+            continue
+        if dim_s:
+            dim = int(dim_s)
+            if dim >= ndim:
+                continue   # rule pins a dim this leaf doesn't have
+            if shape[dim] % mesh.shape[axis] == 0:
+                spec = [None] * ndim
+                spec[dim] = axis
+                return NamedSharding(mesh, P(*spec))
+            continue
+        # shard the largest dim that divides the axis size
+        order = sorted(range(ndim), key=lambda i: -shape[i])
+        for dim in order:
+            if shape[dim] % mesh.shape[axis] == 0:
+                spec = [None] * ndim
+                spec[dim] = axis
+                return NamedSharding(mesh, P(*spec))
     return NamedSharding(mesh, P())
 
 
