@@ -25,9 +25,6 @@ path — identical math, no collectives.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional, Tuple
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -82,10 +79,12 @@ def _expert_ffn(buckets, w1, b1, w2, b2, activation):
 class SwitchMoE(nn.Module):
     """Drop-in FFN replacement: [..., hidden] -> ([..., hidden], aux).
 
-    `mesh`: optional — defaults to the OrcaContext mesh at call time;
-    expert parallelism activates when it has an "ep" axis of size > 1
-    (pass `shard_rules=dict(MOE_SHARD_RULES)` to the estimator so the
-    stacked expert weights are stored ep-sharded too)."""
+    The mesh is read from OrcaContext at call time; expert parallelism
+    activates when it has an "ep" axis of size > 1 (pass
+    `shard_rules=dict(MOE_SHARD_RULES)` to the estimator so the stacked
+    expert weights are stored ep-sharded too).  `training` is accepted
+    for the framework's module convention but routing is deterministic
+    (top-1 argmax, no jitter), so it currently has no effect."""
 
     num_experts: int
     hidden_size: int
