@@ -14,3 +14,8 @@ from analytics_zoo_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_self_attention,
 )
+from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401
+    PIPELINE_SHARD_RULES,
+    pipeline_apply,
+    stack_stage_params,
+)

@@ -1,0 +1,115 @@
+"""GPipe-style pipeline parallelism over the "pp" mesh axis.
+
+The reference has NO pipeline parallelism (its inventory is data-
+parallel only, SURVEY.md §2.3); like ring attention ("sp") and
+Switch-MoE ("ep") this is a TPU-native extension.  Recipe: the model is
+a chain of S identical-signature STAGES whose parameters are stacked on
+a leading [S, ...] axis and sharded over "pp" (one stage per shard);
+the batch is split into M microbatches; under `shard_map`, tick t of
+the schedule runs every stage in parallel on its current microbatch and
+rotates activations one step around the ring with `lax.ppermute` — the
+classic bubble schedule: M + S - 1 ticks, bubble fraction
+(S - 1) / (M + S - 1).
+
+The tick loop is a PYTHON loop (unrolled), not `lax.scan`: ppermute
+inside scan can deadlock XLA:CPU's thread-rendezvous collective
+emulation (the same artifact that keeps ring-in-scan out of the dryrun
+gate), and with small static M + S the unrolled program is compact.
+
+`pipeline_apply` is functional (params in, activations out) so it
+composes with jax.grad / the SPMD engine like any other transform.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+#: estimator shard_rules entry for stacked stage parameters
+PIPELINE_SHARD_RULES = {"stages_": "pp:0"}
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x,
+                   microbatches: int, mesh: Optional[Mesh] = None):
+    """Run `x` [batch, ...] through S pipelined stages.
+
+    stage_fn(params_one_stage, x_micro) -> y_micro (same shape — GPipe
+    stages must be shape-preserving so activations rotate uniformly);
+    stage_params: pytree with leading stage dim [S, ...] (shard over
+    "pp" with PIPELINE_SHARD_RULES); `microbatches` must divide batch.
+    Falls back to a sequential stage loop when the mesh has no "pp"
+    axis (identical math, no collectives)."""
+    from analytics_zoo_tpu.common.context import OrcaContext
+
+    mesh = mesh or OrcaContext.mesh
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    n_stages = leaves[0].shape[0]
+    batch = x.shape[0]
+    if batch % microbatches:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"microbatches={microbatches}")
+    pp = (mesh.shape["pp"] if (mesh is not None
+                               and "pp" in mesh.axis_names) else 1)
+
+    if pp <= 1:
+        # dense fallback: stages applied in order, full batch
+        y = x
+        for s in range(n_stages):
+            p_s = jax.tree_util.tree_map(lambda a: a[s], stage_params)
+            y = stage_fn(p_s, y)
+        return y
+    if n_stages != pp:
+        raise ValueError(
+            f"stage count {n_stages} must equal the pp axis size {pp} "
+            "(one stage per pipeline shard)")
+
+    from analytics_zoo_tpu.parallel.sharding import data_axes
+
+    mb = batch // microbatches
+    xm = x.reshape(microbatches, mb, *x.shape[1:])
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    # microbatch TOKENS shard over the data axes (each dp shard runs
+    # the schedule on its own slice); only the stage chain spans "pp"
+    daxes = data_axes(mesh)
+    tok = daxes if daxes else None
+
+    def local(stage_p, xm):
+        # stage_p arrives with a leading [1, ...] slice — squeeze it
+        p_local = jax.tree_util.tree_map(lambda a: a[0], stage_p)
+        idx = jax.lax.axis_index("pp")
+        is_first = idx == 0
+        is_last = idx == pp - 1
+        state = jnp.zeros_like(xm[0])
+        outs = []
+        for t in range(microbatches + pp - 1):
+            inject = xm[min(t, microbatches - 1)]
+            x_in = jnp.where(is_first & (t < microbatches),
+                             inject, state)
+            y = stage_fn(p_local, x_in)
+            if t >= pp - 1:
+                # the LAST stage's output at tick t is microbatch
+                # t - (pp - 1); other stages contribute zeros
+                outs.append(jnp.where(is_last, y, 0.0))
+            state = jax.lax.ppermute(y, "pp", perm)
+        out = jnp.stack(outs)                 # [M, mb, ...]
+        # replicate the last stage's outputs to every shard
+        return jax.lax.psum(out, "pp")
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("pp"), P(None, tok)),
+        out_specs=P(None, tok),
+        check_vma=False)
+    out = fn(stage_params, xm)
+    return out.reshape(batch, *x.shape[1:])
+
+
+def stack_stage_params(per_stage_params) -> object:
+    """[params_stage0, params_stage1, ...] (identical treedefs) ->
+    one pytree with a leading [S, ...] stage axis, ready for
+    PIPELINE_SHARD_RULES."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
