@@ -347,5 +347,37 @@ if __name__ == "__main__":
             "bert_finetune_tokens_per_sec": round(tps, 1),
             "bert_mfu": round(mfu, 4),
             "bert_params": n_params}))
-    else:
+    elif os.environ.get("_BENCH_ATTEMPT") == "1":
         main()
+    else:
+        # The tunnel very occasionally drops an RPC mid-run (one crash
+        # in ~12 recorded runs); one retry must not cost the round's
+        # benchmark entry.  Each attempt runs in a FRESH subprocess: an
+        # in-process retry would reuse a possibly-poisoned TPU client
+        # and break the BERT child's one-chip-owner invariant, and a
+        # fresh process gets a new tunnel connection.  The retry's
+        # budget is what remains of the original (its compiles are all
+        # warm from attempt 1, so it fits), and partially-warmed stages
+        # (e.g. a completed BERT compile) replay from the persistent
+        # cache in seconds.
+        import subprocess
+        import time as _t
+
+        budget = float(os.environ.get("BENCH_TIME_BUDGET_S", 600))
+        start = _t.monotonic()
+        rc = 0
+        for attempt in (1, 2):
+            remaining = budget - (_t.monotonic() - start)
+            env = dict(os.environ,
+                       _BENCH_ATTEMPT="1",
+                       BENCH_TIME_BUDGET_S=str(max(60.0, remaining)))
+            rc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env).returncode
+            if rc == 0:
+                break
+            print(f"bench attempt {attempt} exited rc={rc}"
+                  + ("; retrying in a fresh process"
+                     if attempt == 1 else ""),
+                  file=sys.stderr)
+        sys.exit(rc)
