@@ -54,6 +54,7 @@ class Estimator:
                  clip_norm: Optional[float] = None,
                  clip_value: Optional[float] = None,
                  learning_rate: Optional[float] = None,
+                 aux_loss_weight: Optional[float] = None,
                  seed: int = 0):
         self._module = module
         self._apply_fn = apply_fn
@@ -64,6 +65,10 @@ class Estimator:
                                      clip_norm, clip_value)
         self._metrics = metrics_mod.resolve_all(metrics)
         self._shard_rules = shard_rules
+        #: non-None = the model returns (predictions, aux_scalar) and
+        #: the train loss adds weight * aux (e.g. Switch-MoE's
+        #: load-balancing loss); metrics/predict see only predictions
+        self._aux_loss_weight = aux_loss_weight
         self._seed = seed
         self.model_dir = model_dir
         self._engine: Optional[SPMDEngine] = None
@@ -91,12 +96,17 @@ class Estimator:
     @staticmethod
     def from_flax(module, *, loss=None, optimizer=None, metrics=None,
                   model_dir=None, shard_rules=None, clip_norm=None,
-                  clip_value=None, learning_rate=None, seed=0) -> "Estimator":
+                  clip_value=None, learning_rate=None,
+                  aux_loss_weight=None, seed=0) -> "Estimator":
+        """`aux_loss_weight`: set when the module's __call__ returns
+        (predictions, aux_scalar) — e.g. `parallel.SwitchMoE`'s
+        load-balancing loss; train loss adds weight * aux, and the
+        per-epoch `aux_loss` appears in train_summary."""
         return Estimator(module=module, loss=loss, optimizer=optimizer,
                          metrics=metrics, model_dir=model_dir,
                          shard_rules=shard_rules, clip_norm=clip_norm,
                          clip_value=clip_value, learning_rate=learning_rate,
-                         seed=seed)
+                         aux_loss_weight=aux_loss_weight, seed=seed)
 
     @staticmethod
     def from_keras(model, *, loss=None, optimizer=None, metrics=None,
@@ -172,6 +182,7 @@ class Estimator:
             metric_fns=self._metrics,
             model_state=self._model_state,
             shard_rules=self._shard_rules,
+            aux_loss_weight=self._aux_loss_weight,
             seed=self._seed)
         ops, self._deferred_ops = self._deferred_ops, []
         for kind, value in ops:

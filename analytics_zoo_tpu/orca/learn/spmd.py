@@ -95,11 +95,20 @@ class SPMDEngine:
                  model_state: Any = None,
                  mesh=None,
                  shard_rules: Optional[Dict[str, str]] = None,
+                 aux_loss_weight: Optional[float] = None,
                  seed: int = 0):
         self.mesh = mesh or OrcaContext.mesh
         self.apply_fn = apply_fn
         self.tx = optimizer
         self.loss_fn = loss_fn
+        #: set when the model returns (predictions, aux_scalar) — e.g.
+        #: a Switch-MoE load-balancing loss; the train loss adds
+        #: weight * aux, metrics see only the predictions.  Caveat: the
+        #: aux is computed by the MODEL, which also sees the zero-
+        #: padded rows of a ragged tail batch (the engine's mask only
+        #: gates the primary loss) — keep batch_size dividing the
+        #: dataset, or accept slight aux noise on the tail batch
+        self.aux_loss_weight = aux_loss_weight
         # pairwise losses (rank_hinge) need the padding mask INSIDE the
         # loss — a padded member must zero its pair — so the engine
         # threads it to any loss that declares a `mask` parameter
@@ -242,6 +251,13 @@ class SPMDEngine:
     def _forward(self, params, model_state, features, rng, training):
         return self.apply_fn(params, model_state, features, rng, training)
 
+    def _split_aux(self, preds):
+        """(predictions, aux_scalar or None) per aux_loss_weight."""
+        if self.aux_loss_weight is None:
+            return preds, None
+        preds, aux = preds
+        return preds, aux
+
     def _per_example_loss(self, preds, labels, mask):
         if self._loss_takes_mask:
             return self.loss_fn(preds, labels, mask=mask)
@@ -253,13 +269,17 @@ class SPMDEngine:
         def loss_of(params):
             preds, new_ms = self._forward(
                 params, state.model_state, batch["features"], rng, True)
+            preds, aux = self._split_aux(preds)
             per_ex = self._per_example_loss(preds, batch["labels"],
                                             batch["mask"])
-            loss = masked_mean(per_ex, batch["mask"])
-            return loss, (preds, new_ms)
+            data_loss = masked_mean(per_ex, batch["mask"])
+            loss = data_loss
+            if aux is not None:
+                loss = loss + self.aux_loss_weight * aux
+            return loss, (data_loss, preds, aux, new_ms)
 
-        (loss, (preds, new_ms)), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(state.params)
+        (loss, (data_loss, preds, aux, new_ms)), grads = \
+            jax.value_and_grad(loss_of, has_aux=True)(state.params)
         # NaN/inf detection (VERDICT r1 weak #9; the reference trains
         # blind): counted in `_nan_steps` so the host can warn, abort, or
         # replay.  Detection alone fuses into the backward pass and is
@@ -286,7 +306,11 @@ class SPMDEngine:
             params=params,
             opt_state=opt_state,
             model_state=new_ms)
-        stats = {"loss": jnp.where(finite, loss, 0.0)}
+        # report the DATA loss so train and eval losses compare 1:1;
+        # the optimized objective is loss + aux_loss_weight * aux_loss
+        stats = {"loss": jnp.where(finite, data_loss, 0.0)}
+        if aux is not None:
+            stats["aux_loss"] = jnp.where(finite, aux, 0.0)
         for name, fn in self.metric_fns.items():
             m = masked_mean(fn(preds, batch["labels"]), batch["mask"])
             stats[name] = jnp.where(finite, m, 0.0)
@@ -297,7 +321,10 @@ class SPMDEngine:
     def _eval_step_impl(self, state: TrainState, batch):
         preds, _ = self._forward(state.params, state.model_state,
                                  batch["features"], state.rng, False)
+        preds, aux = self._split_aux(preds)
         stats = {}
+        if aux is not None:
+            stats["aux_loss"] = aux
         if batch["labels"]:  # metrics/loss need labels; label-less eval
             if self.loss_fn is not None:
                 per_ex = self._per_example_loss(preds, batch["labels"],
@@ -312,6 +339,7 @@ class SPMDEngine:
     def _predict_step_impl(self, state: TrainState, batch):
         preds, _ = self._forward(state.params, state.model_state,
                                  batch["features"], state.rng, False)
+        preds, _aux = self._split_aux(preds)
         return preds
 
     # ------------------------------------------------------------------

@@ -171,10 +171,15 @@ def _ep_dispatch(xd, xf32, rkern, rbias, num_experts: int,
     the per-shard token count, so data parallelism is preserved through
     the MoE layer.  Returns (y [n, H], aux scalar averaged over
     groups)."""
-    from analytics_zoo_tpu.parallel.sharding import data_axes
+    from analytics_zoo_tpu.parallel.sharding import (
+        data_axes, data_parallelism)
 
     daxes = data_axes(mesh)
     tok = daxes if daxes else None        # token dim sharding
+    if tok is not None and xd.shape[0] % data_parallelism(mesh):
+        # token count not divisible by the data axes (e.g. the 1-row
+        # module-init trace): replicate tokens for this call
+        tok = None
     ep = mesh.shape["ep"]
     e_local = num_experts // ep
 

@@ -367,13 +367,19 @@ if __name__ == "__main__":
         start = _t.monotonic()
         rc = 0
         for attempt in (1, 2):
-            remaining = budget - (_t.monotonic() - start)
+            remaining = max(60.0, budget - (_t.monotonic() - start))
             env = dict(os.environ,
                        _BENCH_ATTEMPT="1",
-                       BENCH_TIME_BUDGET_S=str(max(60.0, remaining)))
-            rc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env).returncode
+                       BENCH_TIME_BUDGET_S=str(remaining))
+            try:
+                # hard wall: a stalled tunnel can HANG the client
+                # rather than crash it, and a hung attempt 1 would
+                # otherwise eat the whole budget with no retry
+                rc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=env, timeout=remaining + 30).returncode
+            except subprocess.TimeoutExpired:
+                rc = -1
             if rc == 0:
                 break
             print(f"bench attempt {attempt} exited rc={rc}"

@@ -100,6 +100,38 @@ def test_ep_path_matches_dense(ep_mesh):
                                atol=2e-2)
 
 
+def test_moe_via_estimator_aux_loss(ep_mesh):
+    """SwitchMoE trains through the user-facing Estimator: the model
+    returns (logits, aux) and aux_loss_weight folds the load-balancing
+    loss into training; metrics/predict see only the logits."""
+    import flax.linen as nn
+
+    from analytics_zoo_tpu.orca.learn import Estimator
+
+    class MoEClassifier(nn.Module):
+        @nn.compact
+        def __call__(self, x, training: bool = False):
+            h, aux = SwitchMoE(num_experts=4, hidden_size=8,
+                               ffn_size=32, capacity_factor=2.0)(
+                x, training=training)
+            return nn.Dense(2)(h), aux
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 8)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    est = Estimator.from_flax(
+        MoEClassifier(), loss="sparse_categorical_crossentropy",
+        optimizer="adam", learning_rate=5e-3, metrics=["accuracy"],
+        shard_rules=dict(MOE_SHARD_RULES), aux_loss_weight=0.01)
+    est.fit({"x": x, "y": y}, epochs=12, batch_size=32, shuffle=False)
+    assert "aux_loss" in est.train_summary[-1]
+    assert est.train_summary[-1]["accuracy"] > 0.85, est.train_summary[-1]
+    ev = est.evaluate({"x": x, "y": y}, batch_size=32)
+    assert "aux_loss" in ev and ev["accuracy"] > 0.85
+    preds = np.asarray(est.predict({"x": x[:8]}, batch_size=8))
+    assert preds.shape == (8, 2)   # logits only, no aux leak
+
+
 def test_moe_trains_on_ep_mesh(ep_mesh):
     """Gradients flow through router gates and ep-sharded experts; a
     routing-friendly task (per-cluster output) improves under adam."""
