@@ -22,10 +22,19 @@ import jax.numpy as jnp
 from analytics_zoo_tpu.keras.layers.self_attention import TransformerEncoder
 from analytics_zoo_tpu.models.common.zoo_model import ZooModel
 
-#: estimator shard_rules giving Megatron-style weight sharding over "tp"
+#: estimator shard_rules: Megatron-style weight sharding over "tp",
+#: composed with ZeRO-3-style full parameter sharding over "fsdp" (each
+#: rule applies whichever of its axes the mesh actually has — see
+#: `logical_to_sharding`).  The trailing "kernel" rule catches matrices
+#: the tp rules don't name (pooler, classifier heads) so an fsdp mesh
+#: shards *every* weight matrix.  Biases under the named keys (qkv/proj/
+#: fc1/fc2) are sharded too when divisible — substring rules match the
+#: whole path; only layernorm scales/offsets and unnamed biases stay
+#: replicated.
 BERT_SHARD_RULES = {
-    "qkv": "tp", "proj": "tp", "fc1": "tp", "fc2": "tp",
-    "token_embed": "tp", "position_embed": "tp",
+    "qkv": "tp,fsdp", "proj": "tp,fsdp", "fc1": "tp,fsdp", "fc2": "tp,fsdp",
+    "token_embed": "tp,fsdp", "position_embed": "tp,fsdp",
+    "kernel": "fsdp",
 }
 
 

@@ -139,6 +139,23 @@ class SPMDEngine:
             opt_state=opt_state,
             rng=jax.random.PRNGKey(seed),
             model_state=model_state)
+        # Every state leaf must carry a NamedSharding over THIS mesh:
+        # leaves born outside device_put (the step/rng scalars, optax
+        # counters) default to a committed single-device placement, which
+        # (a) conflicts with the mesh-wide params inside jit once the
+        # state round-trips through an orbax restore, and (b) stamps the
+        # checkpoint with a device-0 layout instead of a mesh-free one.
+        # Replicating them here makes save/restore reshard-safe across
+        # mesh shapes (tests/test_fsdp.py).
+        repl = self._repl
+
+        def _named(x):
+            if isinstance(x, jax.Array) and not isinstance(
+                    x.sharding, jax.sharding.NamedSharding):
+                return jax.device_put(x, repl)
+            return x
+
+        self.state = jax.tree_util.tree_map(_named, self.state)
         #: host mirror of state.step — reading the device scalar costs a
         #: full round trip (~10-350ms on tunneled/pod setups); callers
         #: that just logged the step number were paying it every epoch.

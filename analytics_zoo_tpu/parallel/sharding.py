@@ -113,18 +113,28 @@ def logical_to_sharding(rules: Dict[str, Optional[str]],
     over the named axis.  An explicit dim can be pinned with
     ``"axis:dim"`` — e.g. ``{"experts": "ep:0"}`` shards the expert
     dimension (dim 0) over "ep" regardless of size ordering (expert-
-    parallel tables must split on the expert axis, not their largest)."""
+    parallel tables must split on the expert axis, not their largest).
+
+    A rule may name several axes — ``"tp,fsdp"`` — applied in order,
+    each to the largest still-unsharded divisible dim.  Axes absent from
+    the mesh (or of size 1) are skipped, so one rule table serves every
+    mesh: on a dp×tp mesh the "fsdp" part is a no-op, on a dp×fsdp mesh
+    the "tp" part is, and on dp×fsdp×tp the param is sharded 2-D — the
+    scaling-playbook composition of tensor + fully-sharded layouts."""
     joined = "/".join(str(p) for p in path)
+    ndim = len(shape)
     for key, rule in rules.items():
         if key not in joined or rule is None:
             continue
-        axis, _, dim_s = rule.partition(":")
-        if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
-            continue
-        ndim = len(shape)
         if ndim == 0:
             continue
+        axis_part, _, dim_s = rule.partition(":")
+        axes = [a for a in axis_part.split(",") if a]
         if dim_s:
+            # pinned-dim form (single axis): "ep:0"
+            axis = axes[0]
+            if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+                continue
             dim = int(dim_s)
             if dim >= ndim:
                 continue   # rule pins a dim this leaf doesn't have
@@ -133,13 +143,19 @@ def logical_to_sharding(rules: Dict[str, Optional[str]],
                 spec[dim] = axis
                 return NamedSharding(mesh, P(*spec))
             continue
-        # shard the largest dim that divides the axis size
-        order = sorted(range(ndim), key=lambda i: -shape[i])
-        for dim in order:
-            if shape[dim] % mesh.shape[axis] == 0:
-                spec = [None] * ndim
-                spec[dim] = axis
-                return NamedSharding(mesh, P(*spec))
+        # each axis shards the largest still-unsharded dim it divides
+        spec = [None] * ndim
+        for axis in axes:
+            if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+                continue
+            order = sorted((i for i in range(ndim) if spec[i] is None),
+                           key=lambda i: -shape[i])
+            for dim in order:
+                if shape[dim] % mesh.shape[axis] == 0:
+                    spec[dim] = axis
+                    break
+        if any(a is not None for a in spec):
+            return NamedSharding(mesh, P(*spec))
     return NamedSharding(mesh, P())
 
 
