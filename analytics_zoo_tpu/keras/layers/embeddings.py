@@ -83,6 +83,7 @@ def read_glove_vectors(path: str):
     import numpy as _np
     vectors = {}
     dim = None
+    header = None
     with open(path, encoding="utf-8") as f:
         for lineno, line in enumerate(f):
             # split on whitespace runs: hand-edited/word2vec-text files
@@ -90,8 +91,12 @@ def read_glove_vectors(path: str):
             parts = line.split()
             if (lineno == 0 and len(parts) == 2
                     and all(p.isdigit() for p in parts)):
-                continue  # word2vec header: "<count> <dim>", both ints
-                # (a headerless 1-dim embedding line keeps its word)
+                # CANDIDATE word2vec header "<count> <dim>" — but an
+                # all-digit token with a 1-D vector looks identical, so
+                # the call is deferred until the rest of the file
+                # reveals the true dim (ADVICE r3)
+                header = parts
+                continue
             if len(parts) < 2:
                 continue
             word, vals = parts[0], parts[1:]
@@ -103,6 +108,24 @@ def read_glove_vectors(path: str):
                     f"{path}:{lineno + 1}: vector for {word!r} has "
                     f"{len(vec)} dims, expected {dim}")
             vectors[word] = vec
+    if header is not None:
+        declared = int(header[1])
+        if dim is None:
+            # the candidate was the whole file: a 1-D vector, no header
+            dim = 1
+            vectors[header[0]] = _np.asarray([float(header[1])],
+                                             _np.float32)
+        elif declared == dim:
+            pass  # true header ("<count> <dim>" matches the file) — skip
+        elif dim == 1:
+            # rest of the file is 1-D and the declared dim disagrees:
+            # the first line was a legitimate 1-D vector after all
+            vectors[header[0]] = _np.asarray([float(header[1])],
+                                             _np.float32)
+        else:
+            raise ValueError(
+                f"{path}: first line {' '.join(header)!r} is neither a "
+                f"word2vec header for dim {dim} nor a dim-{dim} vector")
     if dim is None:
         raise ValueError(f"{path}: no vectors found")
     return vectors, dim

@@ -493,10 +493,18 @@ class Estimator:
         return the trained model object).  Works on a loaded-but-not-yet-run
         estimator by returning the staged parameters."""
         if self._engine is None:
-            # newest deferred plain-tree set_params wins pre-build
+            # newest deferred op wins pre-build; a callable set_params
+            # or a load() only runs at engine build, so returning
+            # anything older would hand the caller params the first fit
+            # won't actually train from (ADVICE r3)
             for kind, value in reversed(self._deferred_ops):
-                if kind == "params" and not callable(value):
-                    return value
+                if kind == "load" or callable(value):
+                    raise RuntimeError(
+                        "get_model() before the first fit/evaluate/"
+                        f"predict: the pending {kind} op only runs "
+                        "when the engine is built — run fit/evaluate/"
+                        "predict first (or set a plain parameter tree)")
+                return value
             if self._params is not None:
                 return self._params
         self._require_engine()

@@ -448,10 +448,10 @@ def _tile(mod, node, x, repeats):
 @_op("Resize")
 def _resize(mod, node, x, roi=None, scales=None, sizes=None):
     """Image resize (opset 11+ input layout; opset 10's single `scales`
-    input also lands here).  Modes: nearest / linear.  Exact for the
-    torch-export conventions: nearest+asymmetric+floor via index
-    gather; linear+(pytorch_)half_pixel via jax.image.resize (which
-    uses the half-pixel convention)."""
+    input also lands here).  Modes: nearest / linear.  Nearest is exact
+    for every ONNX coordinate/rounding convention via per-axis index
+    gather; linear+(pytorch_)half_pixel goes through jax.image.resize
+    (which uses the half-pixel convention)."""
     mode = (_attr(node, "mode", b"nearest") or b"nearest").decode()
     ct = (_attr(node, "coordinate_transformation_mode",
                 b"half_pixel") or b"half_pixel").decode()
@@ -485,16 +485,42 @@ def _resize(mod, node, x, roi=None, scales=None, sizes=None):
         out_shape = tuple(int(np.floor(i * s))
                           for i, s in zip(x.shape, scl))
     if mode == "nearest":
-        if ct == "asymmetric" and nearest_mode == "floor":
-            # the torch interpolate(mode='nearest') convention — exact
-            out = x
-            for ax, (o, i) in enumerate(zip(out_shape, x.shape)):
-                if o != i:
-                    idx = np.floor(np.arange(o) / scl[ax]).astype(
-                        np.int32).clip(0, i - 1)
-                    out = jnp.take(out, jnp.asarray(idx), axis=ax)
-            return out
-        method = "nearest"
+        # exact per-axis index gather for every ONNX nearest convention
+        # (jax.image.resize's nearest uses its own convention that can
+        # differ by one index at tie points — ADVICE r3)
+        out = x
+        for ax, (o, i) in enumerate(zip(out_shape, x.shape)):
+            if o == i:
+                continue
+            xo = np.arange(o, dtype=np.float64)
+            s = scl[ax]
+            if ct == "asymmetric":
+                xr = xo / s
+            elif ct in ("half_pixel", "pytorch_half_pixel"):
+                xr = (xo + 0.5) / s - 0.5
+                if ct == "pytorch_half_pixel" and o == 1:
+                    xr = np.zeros_like(xo)
+            elif ct == "align_corners":
+                xr = (xo * ((i - 1) / (o - 1)) if o > 1
+                      else np.zeros_like(xo))
+            else:
+                raise NotImplementedError(
+                    f"Resize nearest with coordinate_transformation_"
+                    f"mode {ct!r} is not supported")
+            if nearest_mode == "floor":
+                idx = np.floor(xr)
+            elif nearest_mode == "ceil":
+                idx = np.ceil(xr)
+            elif nearest_mode == "round_prefer_floor":
+                idx = np.ceil(xr - 0.5)
+            elif nearest_mode == "round_prefer_ceil":
+                idx = np.floor(xr + 0.5)
+            else:
+                raise NotImplementedError(
+                    f"Resize nearest_mode {nearest_mode!r}")
+            idx = idx.astype(np.int32).clip(0, i - 1)
+            out = jnp.take(out, jnp.asarray(idx), axis=ax)
+        return out
     elif mode == "linear":
         if ct not in ("half_pixel", "pytorch_half_pixel"):
             raise NotImplementedError(
@@ -511,16 +537,32 @@ def _resize(mod, node, x, roi=None, scales=None, sizes=None):
                             antialias=antialias)
 
 
-def _rnn_dirs(node):
+def _rnn_dirs(node, default_acts):
     """Direction handling shared by LSTM/GRU: -> [reverse?] flags, one
-    per ONNX num_direction."""
+    per ONNX num_direction.  Also rejects non-default `activations` and
+    `clip` — the step functions below hard-code sigmoid/tanh, so a
+    checkpoint exported with e.g. HardSigmoid would load fine and be
+    silently wrong (ADVICE r3)."""
     direction = (_attr(node, "direction", b"forward")
                  or b"forward").decode()
     if _attr(node, "layout", 0):
         raise NotImplementedError("RNN layout=1 (batch-first) is not "
                                   "supported; export with layout=0")
-    return {"forward": [False], "reverse": [True],
+    if _attr(node, "clip") is not None:
+        raise NotImplementedError("RNN cell clipping (clip attribute) "
+                                  "is not supported")
+    dirs = {"forward": [False], "reverse": [True],
             "bidirectional": [False, True]}[direction]
+    acts = _attr(node, "activations")
+    if acts is not None:
+        got = [a.decode().lower() if isinstance(a, bytes)
+               else str(a).lower() for a in acts]
+        want = [a.lower() for a in default_acts] * len(dirs)
+        if got != want:
+            raise NotImplementedError(
+                f"RNN activations {got} are not supported; only the "
+                f"defaults {want} are implemented")
+    return dirs
 
 
 @_op("LSTM")
@@ -534,7 +576,7 @@ def _lstm_op(mod, node, x, w, r, b=None, seq_lens=None,
     if p is not None:
         raise NotImplementedError("LSTM peepholes are not supported")
     hidden = int(_attr(node, "hidden_size"))
-    dirs = _rnn_dirs(node)
+    dirs = _rnn_dirs(node, ("Sigmoid", "Tanh", "Tanh"))
     seq, batch, _ = x.shape
 
     def run(rev, d):
@@ -576,7 +618,7 @@ def _gru_op(mod, node, x, w, r, b=None, seq_lens=None, init_h=None):
         raise NotImplementedError("GRU sequence_lens is not supported")
     hidden = int(_attr(node, "hidden_size"))
     lbr = int(_attr(node, "linear_before_reset", 0))
-    dirs = _rnn_dirs(node)
+    dirs = _rnn_dirs(node, ("Sigmoid", "Tanh"))
     seq, batch, _ = x.shape
 
     def run(rev, d):

@@ -279,6 +279,11 @@ def _enc_attr(name: str, value) -> bytes:
             for f in value:
                 out += _tag(7, 5) + struct.pack("<f", f)
             out += _tag(20, 0) + _varint(6)
+        elif value and isinstance(value[0], (bytes, str)):
+            for s in value:
+                out += _len_delim(
+                    9, s.encode() if isinstance(s, str) else s)
+            out += _tag(20, 0) + _varint(8)   # AttributeProto.STRINGS
         else:
             for i in value:
                 out += _tag(8, 0) + _varint(int(i) & (2**64 - 1))

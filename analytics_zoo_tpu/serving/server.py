@@ -244,13 +244,18 @@ class ServingServer:
     def _batcher(self):
         """Drain the queue into device-batches (the FlinkInference.map
         analog).  With a worker pool, assembled batches dispatch to
-        replicas CONCURRENTLY (the pool's checkout queue is the
-        backpressure); single-model servers run them inline."""
+        replicas CONCURRENTLY, with a semaphore bounding in-flight
+        batches to 2x the worker count — without it the executor's
+        internal queue grows unboundedly under sustained overload,
+        holding every pending batch's concatenated input arrays
+        (ADVICE r3).  Single-model servers run batches inline."""
         executor = None
+        gate = None
         if self.worker_pool is not None:
             from concurrent.futures import ThreadPoolExecutor
             executor = ThreadPoolExecutor(
                 max_workers=self.worker_pool.n_workers)
+            gate = threading.Semaphore(2 * self.worker_pool.n_workers)
         try:
             while not self._stop.is_set():
                 try:
@@ -268,7 +273,22 @@ class ServingServer:
                     except queue.Empty:
                         break
                 if executor is not None:
-                    executor.submit(self._run_batch, batch)
+                    # blocks the batcher (and, transitively, enqueuers
+                    # once self._queue fills) instead of queueing
+                    # unbounded work; polled so stop() still terminates
+                    # this thread when all slots are held by hung
+                    # workers — the held batch errors out like other
+                    # shutdown-stranded requests
+                    while not self._stop.is_set():
+                        if gate.acquire(timeout=0.05):
+                            fut = executor.submit(self._run_batch, batch)
+                            fut.add_done_callback(
+                                lambda _f: gate.release())
+                            break
+                    else:
+                        for p in batch:
+                            p.error = "server stopped"
+                            p.event.set()
                 else:
                     self._run_batch(batch)
         finally:

@@ -586,3 +586,19 @@ def test_glove_file_loading_frozen_and_trainable(tmp_path):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="dims"):
         read_glove_vectors(str(tmp_path / "bad.txt"))
+
+    # an all-digit token with a 1-D vector is NOT a header when the
+    # declared dim disagrees with the file (ADVICE r3): "7 5" followed
+    # by 1-D vectors keeps token "7"
+    (tmp_path / "digit.txt").write_text("7 5\na 1\nb 2\n")
+    v3, d3 = read_glove_vectors(str(tmp_path / "digit.txt"))
+    assert d3 == 1 and set(v3) == {"7", "a", "b"}
+    np.testing.assert_allclose(v3["7"], [5.0])
+    # …but "2 1" followed by dim-1 vectors IS a word2vec header
+    (tmp_path / "hdr1.txt").write_text("2 1\na 1\nb 2\n")
+    v4, d4 = read_glove_vectors(str(tmp_path / "hdr1.txt"))
+    assert d4 == 1 and set(v4) == {"a", "b"}
+    # a lone digit-pair line is a 1-D vector, not an empty header file
+    (tmp_path / "lone.txt").write_text("3 4\n")
+    v5, d5 = read_glove_vectors(str(tmp_path / "lone.txt"))
+    assert d5 == 1 and set(v5) == {"3"}

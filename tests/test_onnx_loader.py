@@ -305,6 +305,64 @@ def test_resize_matches_torch():
     np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
 
 
+def test_resize_nearest_conventions_exact():
+    """half_pixel tie points distinguish round_prefer_floor from
+    round_prefer_ceil (ADVICE r3: the old jax.image.resize fallthrough
+    collapsed them to one convention); align_corners is exact too."""
+    x = np.arange(4, dtype=np.float32)[None]   # [1, 4]
+
+    def run(ct, nm, sizes):
+        data = encode_model(
+            nodes=[("Resize", ["x", "", "", "sizes"], ["y"],
+                    {"mode": b"nearest",
+                     "coordinate_transformation_mode": ct,
+                     "nearest_mode": nm})],
+            initializers={"sizes": np.array(sizes, np.int64)},
+            inputs=[("x", [1, 4])], outputs=["y"])
+        module, _ = load_onnx(data)
+        out, _ = _apply(module, None, x)
+        return np.asarray(out)[0]
+
+    # i=4 -> o=2, half_pixel: x_orig = [0.5, 2.5] — exact ties
+    np.testing.assert_array_equal(
+        run(b"half_pixel", b"round_prefer_floor", [1, 2]), [0.0, 2.0])
+    np.testing.assert_array_equal(
+        run(b"half_pixel", b"round_prefer_ceil", [1, 2]), [1.0, 3.0])
+    # align_corners i=4 -> o=3: x_orig = [0, 1.5, 3]
+    np.testing.assert_array_equal(
+        run(b"align_corners", b"round_prefer_floor", [1, 3]),
+        [0.0, 1.0, 3.0])
+    np.testing.assert_array_equal(
+        run(b"align_corners", b"ceil", [1, 3]), [0.0, 2.0, 3.0])
+
+
+def test_rnn_nondefault_activations_and_clip_raise():
+    """A checkpoint exported with non-default activations (or clip)
+    must refuse to load instead of running sigmoid/tanh silently
+    (ADVICE r3 medium)."""
+    hid = 3
+    W = np.zeros((1, 4 * hid, 2), np.float32)
+    R = np.zeros((1, 4 * hid, hid), np.float32)
+
+    def lstm_with(attrs):
+        data = encode_model(
+            nodes=[("LSTM", ["x", "W", "R"], ["y", "y_h", "y_c"],
+                    {"hidden_size": hid, **attrs})],
+            initializers={"W": W, "R": R},
+            inputs=[("x", [2, 1, 2])], outputs=["y", "y_h", "y_c"])
+        module, _ = load_onnx(data)
+        return _apply(module, None, np.zeros((2, 1, 2), np.float32))
+
+    with pytest.raises(NotImplementedError, match="activations"):
+        lstm_with({"activations": [b"HardSigmoid", b"Tanh", b"Tanh"]})
+    with pytest.raises(NotImplementedError, match="clip"):
+        lstm_with({"clip": 3.0})
+    # explicitly-default activations still load
+    (y, _, _), _ = lstm_with(
+        {"activations": [b"Sigmoid", b"Tanh", b"Tanh"]})
+    assert np.asarray(y).shape == (2, 1, 1, hid)
+
+
 def test_pad_negative_crops_and_axes():
     x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
     data = encode_model(
