@@ -24,17 +24,20 @@ class MultiHeadAttention(nn.Module):
     """attn_impl selects the attention engine:
       * "einsum" — ops.attention.dot_product_attention (bf16 MXU einsums)
       * "flash"  — ops.pallas.flash_attention (tiled online softmax,
-        O(T) HBM; key-padding masks supported, attention dropout not)
+        O(T) HBM; Pallas forward AND backward; key-padding masks,
+        streamed additive biases, and attention dropout all supported —
+        real training configs can select it)
       * "ring"   — parallel.ring_attention over the mesh "sp" axis
         (sequence parallelism for long context; key-padding masks rotate
-        with K/V; dropout unsupported)
-      * "auto"   — flash when long + no dropout, else einsum
+        with K/V; dropout/additive unsupported)
+      * "auto"   — flash beyond the einsum HBM cliff (t >= 4096), else
+        einsum
 
     `mask` is a [batch, t] key-validity mask (1 = attend, 0 = padding),
-    understood by every impl.  A pre-built additive [b, 1|h, tq, tk] float
-    mask is also accepted for the einsum path only (flash/ring raise —
-    they cannot honor arbitrary additive biases; ADVICE r1: never drop a
-    mask silently).
+    understood by every impl.  A pre-built additive [b, 1|h, tq, tk]
+    float mask is accepted by einsum and flash (flash streams it
+    blockwise and treats it as a constant — a LEARNABLE bias needs
+    einsum); ring raises (ADVICE r1: never drop a mask silently).
     """
     hidden_size: int
     n_head: int
@@ -70,20 +73,25 @@ class MultiHeadAttention(nn.Module):
         if impl == "auto":
             # measured on v5e-1: XLA's fused einsum attention wins up to
             # t=4096 (43 vs 45ms fwd+bwd) but its [t, t] scores blow HBM
-            # beyond that (16k cannot compile); flash keeps O(t*d) HBM
-            impl = ("flash" if (additive_mask is None or key_mask is not None)
-                    and dropout == 0.0 and t >= 4096 else "einsum")
-        if impl in ("flash", "ring"):
+            # beyond that (16k cannot compile); flash keeps O(t*d) HBM.
+            # Since r4 flash handles dropout, so length decides — EXCEPT
+            # for a raw additive bias: flash treats bias as a constant
+            # (zero cotangent), so auto keeps einsum there lest a
+            # LEARNABLE bias silently stop training; explicit
+            # attn_impl="flash" opts into the stop-gradient semantics.
+            impl = ("flash" if t >= 4096
+                    and (additive_mask is None or key_mask is not None)
+                    else "einsum")
+        if impl == "ring":
             if dropout > 0:
                 raise ValueError(
-                    f"attn_impl='{impl}' does not support attention dropout; "
-                    "set attn_dropout=0 or use attn_impl='einsum'")
+                    "attn_impl='ring' does not support attention dropout; "
+                    "set attn_dropout=0 or use attn_impl='einsum'/'flash'")
             if additive_mask is not None and key_mask is None:
                 raise ValueError(
-                    f"attn_impl='{impl}' only supports [batch, t] key-"
+                    "attn_impl='ring' only supports [batch, t] key-"
                     "validity masks, not additive bias masks; pass the raw "
-                    "attention_mask or use attn_impl='einsum'")
-        if impl == "ring":
+                    "attention_mask or use attn_impl='einsum'/'flash'")
             from analytics_zoo_tpu.parallel.ring_attention import (
                 ring_self_attention)
             out = ring_self_attention(q, k, v, causal=self.causal,
@@ -91,8 +99,13 @@ class MultiHeadAttention(nn.Module):
         elif impl == "flash":
             from analytics_zoo_tpu.ops.pallas.flash_attention import (
                 flash_attention)
-            out = flash_attention(q, k, v, causal=self.causal,
-                                  kv_mask=key_mask)
+            drop_rng = (self.make_rng("dropout") if dropout > 0 else None)
+            # prefer the factored [b, t] mask (free) over streaming the
+            # additive form it was derived from
+            out = flash_attention(
+                q, k, v, causal=self.causal, kv_mask=key_mask,
+                bias=(None if key_mask is not None else additive_mask),
+                dropout_rate=dropout, dropout_rng=drop_rng)
         else:
             drop_rng = (self.make_rng("dropout")
                         if training and dropout > 0 else None)
