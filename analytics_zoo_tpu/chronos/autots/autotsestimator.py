@@ -29,7 +29,14 @@ def _default_space(model: str) -> Dict:
         return {"lstm_hidden_dim": hp.choice([16, 32, 64]),
                 "lstm_layer_num": hp.choice([1, 2]),
                 "lr": hp.loguniform(1e-3, 1e-2)}
-    raise ValueError(f"unknown model '{model}'; known: lstm, tcn, seq2seq")
+    if model == "arima":
+        # order grid for the NATIVE seasonal ARIMA (reference preset:
+        # pyzoo/zoo/chronos/autots/model/auto_arima.py:1)
+        return {"p": hp.randint(0, 3), "q": hp.randint(0, 3),
+                "P": hp.randint(0, 2), "Q": hp.randint(0, 2),
+                "seasonal": True, "m": 7}
+    raise ValueError(
+        f"unknown model '{model}'; known: lstm, tcn, seq2seq, arima")
 
 
 class AutoTSEstimator:
@@ -83,6 +90,8 @@ class AutoTSEstimator:
     def fit(self, data, validation_data=None, epochs: int = 5,
             batch_size: int = 32, n_sampling: int = 4,
             grace_epochs: int = 1) -> TSPipeline:
+        if self.model == "arima":
+            return self._fit_arima(data, validation_data, n_sampling)
         scaler = None
         if isinstance(data, TSDataset):
             scaler = data.scaler
@@ -119,6 +128,28 @@ class AutoTSEstimator:
         return TSPipeline(forecaster=self._best.state,
                           best_config=dict(self._best.config),
                           scaler=scaler)
+
+    def _fit_arima(self, data, validation_data, n_sampling: int
+                   ) -> TSPipeline:
+        """Classical-model leg: search ARIMA orders over the raw target
+        series (no windowing) and return an ARIMA-backed TSPipeline."""
+        from analytics_zoo_tpu.chronos.autots.model.auto_arima import (
+            AutoARIMA)
+
+        train = TSPipeline._series(data)
+        val = (TSPipeline._series(validation_data)
+               if validation_data is not None else None)
+        space = dict(self.search_space)
+        auto = AutoARIMA(p=space.get("p"), q=space.get("q"),
+                         seasonal=space.get("seasonal", True),
+                         P=space.get("P"), Q=space.get("Q"),
+                         m=int(space.get("m", 7)), metric=self.metric)
+        auto.fit(train, val, n_sampling=n_sampling)
+        self._best = auto._best
+        self._trials = auto._trials
+        return TSPipeline(forecaster=auto.get_best_model(),
+                          best_config=auto.get_best_config(),
+                          scaler=None)
 
     def get_best_config(self) -> Dict:
         if self._best is None:

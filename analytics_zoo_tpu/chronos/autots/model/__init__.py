@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.chronos.autots.model.auto_arima import AutoARIMA
+from analytics_zoo_tpu.chronos.autots.model.auto_prophet import AutoProphet
+
+__all__ = ["AutoARIMA", "AutoProphet"]

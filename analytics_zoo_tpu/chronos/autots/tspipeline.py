@@ -42,21 +42,52 @@ class TSPipeline:
             return (arr - mins[:n_t]) / scale[:n_t]
         return arr
 
+    def _is_arima(self) -> bool:
+        from analytics_zoo_tpu.chronos.forecaster.arima_forecaster import (
+            ARIMAForecaster)
+        return isinstance(self.forecaster, ARIMAForecaster)
+
+    @staticmethod
+    def _series(data) -> np.ndarray:
+        """1-D target series for the ARIMA path (a TSDataset's first
+        target column, or any array-like).  Scaled TSDatasets are
+        rejected: this path reads df values directly and has no
+        unscale hook, so accepting one would silently forecast in
+        scaled units."""
+        if isinstance(data, TSDataset):
+            if getattr(data, "scaler", None) is not None:
+                raise ValueError(
+                    "the ARIMA pipeline operates on the raw series — "
+                    "don't scale() the TSDataset (classical models fit "
+                    "their own level/variance)")
+            return data.df[data.target_col[0]].to_numpy(np.float64)
+        return np.asarray(data, np.float64).reshape(-1)
+
     def fit(self, data, epochs: int = 1, batch_size: int = 32):
+        if self._is_arima():
+            self.forecaster.fit(self._series(data))
+            return self
         x, y = self._xy(data)
         self.forecaster.fit((x, y), epochs=epochs, batch_size=batch_size)
         return self
 
     def predict(self, data, batch_size: int = 32):
         """Predictions in ORIGINAL units when the training TSDataset was
-        scaled."""
+        scaled.  For an ARIMA pipeline `data` is the horizon (int)."""
+        if self._is_arima():
+            return self.forecaster.predict(int(data))
         x, _ = self._xy(data)
         preds = self.forecaster.predict((x, None), batch_size=batch_size)
         return self._unscale(preds)
 
     def evaluate(self, data, batch_size: int = 32):
         """Metrics in original units (predictions and targets unscaled
-        before comparison)."""
+        before comparison).  For an ARIMA pipeline `data` is the
+        held-out continuation series."""
+        if self._is_arima():
+            mse, mae = self.forecaster.evaluate(self._series(data),
+                                                metrics=["mse", "mae"])
+            return {"mse": mse, "mae": mae}
         x, y = self._xy(data)
         if self.scaler is None:
             return self.forecaster.evaluate((x, y), batch_size=batch_size)

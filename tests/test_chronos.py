@@ -123,13 +123,16 @@ def test_seq2seq_forecaster():
     assert preds.shape[1:] == (3, 1)
 
 
-def test_arima_prophet_gated():
+def test_arima_prophet_native():
+    """Since r4 these are NATIVE implementations (no statsmodels/
+    fbprophet) — construction works and unfitted predict raises the
+    reference's error; full coverage lives in tests/test_arima.py."""
     from analytics_zoo_tpu.chronos.forecaster import (
         ARIMAForecaster, ProphetForecaster)
-    with pytest.raises(ImportError, match="statsmodels"):
-        ARIMAForecaster()
-    with pytest.raises(ImportError, match="prophet"):
-        ProphetForecaster()
+    with pytest.raises(RuntimeError, match="fit or restore"):
+        ARIMAForecaster().predict(3)
+    with pytest.raises(RuntimeError, match="fit or restore"):
+        ProphetForecaster().predict(3)
 
 
 def test_threshold_and_dbscan_detectors():
