@@ -78,11 +78,13 @@ class VAE(nn.Module, ZooModel):
             z = mu                      # posterior mean: deterministic eval
 
         recon = self.decode(z)
-        # KL(q(z|x) || N(0, I)), mean over the batch (summed over latent
-        # dims — the standard ELBO bookkeeping)
+        # KL(q(z|x) || N(0, I)) PER EXAMPLE (summed over latent dims —
+        # the standard ELBO bookkeeping); returned as a [batch] vector
+        # so the engine's aux handling masked-means it and padded rows
+        # of a ragged tail batch never bias the KL term
         kl = 0.5 * jnp.sum(
             jnp.exp(log_var) + mu ** 2 - 1.0 - log_var, axis=-1)
-        return recon, jnp.mean(kl)
+        return recon, kl
 
     def decode(self, z):
         """Latents [b, latent_dim] -> reconstruction logits

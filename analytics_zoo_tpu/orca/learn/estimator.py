@@ -55,6 +55,7 @@ class Estimator:
                  clip_value: Optional[float] = None,
                  learning_rate: Optional[float] = None,
                  aux_loss_weight: Optional[float] = None,
+                 pad_multiple_extra: int = 1,
                  seed: int = 0):
         self._module = module
         self._apply_fn = apply_fn
@@ -69,6 +70,9 @@ class Estimator:
         #: the train loss adds weight * aux (e.g. Switch-MoE's
         #: load-balancing loss); metrics/predict see only predictions
         self._aux_loss_weight = aux_loss_weight
+        #: extra batch-divisibility constraint (e.g. a pipelined model's
+        #: microbatch count) folded into the engine's pad multiple
+        self._pad_multiple_extra = pad_multiple_extra
         self._seed = seed
         self.model_dir = model_dir
         self._engine: Optional[SPMDEngine] = None
@@ -183,6 +187,7 @@ class Estimator:
             model_state=self._model_state,
             shard_rules=self._shard_rules,
             aux_loss_weight=self._aux_loss_weight,
+            pad_multiple_extra=self._pad_multiple_extra,
             seed=self._seed)
         ops, self._deferred_ops = self._deferred_ops, []
         for kind, value in ops:

@@ -96,6 +96,7 @@ class SPMDEngine:
                  mesh=None,
                  shard_rules: Optional[Dict[str, str]] = None,
                  aux_loss_weight: Optional[float] = None,
+                 pad_multiple_extra: int = 1,
                  seed: int = 0):
         self.mesh = mesh or OrcaContext.mesh
         self.apply_fn = apply_fn
@@ -122,6 +123,10 @@ class SPMDEngine:
                 pass
         self.metric_fns = dict(metric_fns or {})
         self.shard_rules = shard_rules or {}
+        #: extra batch-divisibility constraint beyond data parallelism —
+        #: a pipelined model needs batch % (microbatches * dp) == 0 so
+        #: every microbatch still splits over the data axes
+        self._pad_extra = max(1, int(pad_multiple_extra))
         self._data_sharding = batch_sharding(self.mesh)
         self._repl = replicated(self.mesh)
 
@@ -268,11 +273,16 @@ class SPMDEngine:
     def _forward(self, params, model_state, features, rng, training):
         return self.apply_fn(params, model_state, features, rng, training)
 
-    def _split_aux(self, preds):
-        """(predictions, aux_scalar or None) per aux_loss_weight."""
+    def _split_aux(self, preds, mask=None):
+        """(predictions, aux or None) per aux_loss_weight.  A scalar aux
+        is taken as-is (e.g. MoE token-level balance loss); a PER-EXAMPLE
+        [batch] aux is masked-mean'd so padded rows never bias it (e.g. a
+        VAE's KL term — ADVICE-style fix, r4)."""
         if self.aux_loss_weight is None:
             return preds, None
         preds, aux = preds
+        if aux is not None and jnp.ndim(aux) == 1 and mask is not None:
+            aux = masked_mean(aux, mask)
         return preds, aux
 
     def _per_example_loss(self, preds, labels, mask):
@@ -286,7 +296,7 @@ class SPMDEngine:
         def loss_of(params):
             preds, new_ms = self._forward(
                 params, state.model_state, batch["features"], rng, True)
-            preds, aux = self._split_aux(preds)
+            preds, aux = self._split_aux(preds, batch["mask"])
             per_ex = self._per_example_loss(preds, batch["labels"],
                                             batch["mask"])
             data_loss = masked_mean(per_ex, batch["mask"])
@@ -338,7 +348,7 @@ class SPMDEngine:
     def _eval_step_impl(self, state: TrainState, batch):
         preds, _ = self._forward(state.params, state.model_state,
                                  batch["features"], state.rng, False)
-        preds, aux = self._split_aux(preds)
+        preds, aux = self._split_aux(preds, batch["mask"])
         stats = {}
         if aux is not None:
             stats["aux_loss"] = aux
@@ -600,7 +610,7 @@ class SPMDEngine:
 
     # ------------------------------------------------------------------
     def pad_multiple(self) -> int:
-        return data_parallelism(self.mesh)
+        return data_parallelism(self.mesh) * self._pad_extra
 
     def sync_host_step(self) -> int:
         """Re-read the authoritative device step (one round trip); call

@@ -17,5 +17,6 @@ from analytics_zoo_tpu.parallel.ring_attention import (  # noqa: F401
 from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401
     PIPELINE_SHARD_RULES,
     pipeline_apply,
+    pipeline_value_and_grad_1f1b,
     stack_stage_params,
 )

@@ -32,16 +32,30 @@ from jax.sharding import Mesh, PartitionSpec as P
 PIPELINE_SHARD_RULES = {"stages_": "pp:0"}
 
 
-def pipeline_apply(stage_fn: Callable, stage_params, x,
-                   microbatches: int, mesh: Optional[Mesh] = None):
-    """Run `x` [batch, ...] through S pipelined stages.
+def _pp_size(mesh) -> int:
+    return (mesh.shape["pp"] if (mesh is not None
+                                 and "pp" in mesh.axis_names) else 1)
 
-    stage_fn(params_one_stage, x_micro) -> y_micro (same shape — GPipe
-    stages must be shape-preserving so activations rotate uniformly);
-    stage_params: pytree with leading stage dim [S, ...] (shard over
-    "pp" with PIPELINE_SHARD_RULES); `microbatches` must divide batch.
-    Falls back to a sequential stage loop when the mesh has no "pp"
-    axis (identical math, no collectives)."""
+
+def pipeline_apply(stage_fn: Callable, stage_params, x,
+                   microbatches: int, mesh: Optional[Mesh] = None,
+                   extras: tuple = ()):
+    """Run `x` [batch, ...] through S pipelined stages (GPipe schedule).
+
+    stage_fn(params_one_stage, x_micro, *extras_micro) -> y_micro (same
+    shape as x_micro — GPipe stages must be shape-preserving so
+    activations rotate uniformly); stage_params: pytree with leading
+    stage dim [S, ...] (shard over "pp" with PIPELINE_SHARD_RULES);
+    `microbatches` must divide batch.  `extras` are per-example arrays
+    (leading batch dim, e.g. an attention mask) split into microbatches
+    alongside x and handed to every stage.  Falls back to a sequential
+    stage loop when the mesh has no "pp" axis (identical math, no
+    collectives).
+
+    Gradient accumulation over microbatches is implicit: the schedule
+    is differentiable (ppermute transposes to ppermute), so jax.grad of
+    a loss over this output sums each microbatch's contribution into
+    the single stacked stage-parameter gradient."""
     from analytics_zoo_tpu.common.context import OrcaContext
 
     mesh = mesh or OrcaContext.mesh
@@ -51,15 +65,14 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
     if batch % microbatches:
         raise ValueError(f"batch {batch} not divisible by "
                          f"microbatches={microbatches}")
-    pp = (mesh.shape["pp"] if (mesh is not None
-                               and "pp" in mesh.axis_names) else 1)
+    pp = _pp_size(mesh)
 
     if pp <= 1:
         # dense fallback: stages applied in order, full batch
         y = x
         for s in range(n_stages):
             p_s = jax.tree_util.tree_map(lambda a: a[s], stage_params)
-            y = stage_fn(p_s, y)
+            y = stage_fn(p_s, y, *extras)
         return y
     if n_stages != pp:
         raise ValueError(
@@ -70,13 +83,15 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
 
     mb = batch // microbatches
     xm = x.reshape(microbatches, mb, *x.shape[1:])
+    em = tuple(e.reshape(microbatches, mb, *e.shape[1:])
+               for e in extras)
     perm = [(i, (i + 1) % pp) for i in range(pp)]
     # microbatch TOKENS shard over the data axes (each dp shard runs
     # the schedule on its own slice); only the stage chain spans "pp"
     daxes = data_axes(mesh)
     tok = daxes if daxes else None
 
-    def local(stage_p, xm):
+    def local(stage_p, xm, *em):
         # stage_p arrives with a leading [1, ...] slice — squeeze it
         p_local = jax.tree_util.tree_map(lambda a: a[0], stage_p)
         idx = jax.lax.axis_index("pp")
@@ -88,7 +103,13 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
             inject = xm[min(t, microbatches - 1)]
             x_in = jnp.where(is_first & (t < microbatches),
                              inject, state)
-            y = stage_fn(p_local, x_in)
+            # each stage sees microbatch t - idx at tick t; gather the
+            # matching extras slice (dynamic per device, clipped — the
+            # result is only consumed for valid (t, idx) pairs)
+            m_idx = jnp.clip(t - idx, 0, microbatches - 1)
+            e_t = tuple(jax.lax.dynamic_index_in_dim(
+                e, m_idx, 0, keepdims=False) for e in em)
+            y = stage_fn(p_local, x_in, *e_t)
             if t >= pp - 1:
                 # the LAST stage's output at tick t is microbatch
                 # t - (pp - 1); other stages contribute zeros
@@ -98,13 +119,179 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
         # replicate the last stage's outputs to every shard
         return jax.lax.psum(out, "pp")
 
+    espec = tuple(P(None, tok) for _ in em)
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P("pp"), P(None, tok)),
+        in_specs=(P("pp"), P(None, tok)) + espec,
         out_specs=P(None, tok),
         check_vma=False)
-    out = fn(stage_params, xm)
+    out = fn(stage_params, xm, *em)
     return out.reshape(batch, *x.shape[1:])
+
+
+def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
+                                 stage_params, x, labels,
+                                 microbatches: int,
+                                 mesh: Optional[Mesh] = None,
+                                 extras: tuple = ()):
+    """One-fwd-one-bwd (1F1B) pipelined training step.
+
+    Returns (mean_loss, stage_grads, dx) where stage_grads matches
+    stage_params ([S, ...] stacked, sharded over "pp") and dx is the
+    loss gradient w.r.t. x (feed it to an upstream embed).
+
+    Unlike jax.grad over `pipeline_apply` (GPipe: ALL forwards complete
+    before any backward, so every microbatch's stage activations are
+    live at the bubble peak), this interleaves: stage s runs the
+    forward of microbatch m at tick m+s and its backward at tick
+    2S-1-s+m, so at most 2(S-s)-1 activations are in flight per stage —
+    bounded by the STAGE COUNT, not the microbatch count.  The backward
+    recomputes each stage's internals from its saved boundary input
+    (jax.vjp per tick — per-stage rematerialization, the standard 1F1B
+    memory recipe).  Both channels move each tick: activations rotate
+    +1 and gradients rotate -1 around the "pp" ring.
+
+    loss_fn(y_micro, labels_micro) -> per-example loss [mb]; the
+    reported loss and the gradients correspond to the mean over ALL
+    real examples (microbatch losses are summed then divided by batch).
+    """
+    from analytics_zoo_tpu.common.context import OrcaContext
+    from analytics_zoo_tpu.parallel.sharding import data_axes
+
+    mesh = mesh or OrcaContext.mesh
+    pp = _pp_size(mesh)
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    n_stages = leaves[0].shape[0]
+    batch = x.shape[0]
+    if batch % microbatches:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"microbatches={microbatches}")
+
+    if pp <= 1:
+        # sequential reference: same math, no pipeline
+        def total_loss(sp, x):
+            y = x
+            for s in range(n_stages):
+                p_s = jax.tree_util.tree_map(lambda a: a[s], sp)
+                y = stage_fn(p_s, y, *extras)
+            return jnp.sum(loss_fn(y, labels)) / batch
+        lossv, (gsp, gx) = jax.value_and_grad(total_loss, argnums=(0, 1))(
+            stage_params, x)
+        return lossv, gsp, gx
+    if n_stages != pp:
+        raise ValueError(
+            f"stage count {n_stages} must equal the pp axis size {pp}")
+
+    M = microbatches
+    mb = batch // M
+    xm = x.reshape(M, mb, *x.shape[1:])
+    lm = labels.reshape(M, mb, *labels.shape[1:])
+    em = tuple(e.reshape(M, mb, *e.shape[1:]) for e in extras)
+    fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+    bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+    daxes = data_axes(mesh)
+    tok = daxes if daxes else None
+    B = 2 * pp                      # activation/seed buffer slots
+
+    def local(stage_p, xm, lm, *em):
+        p_local = jax.tree_util.tree_map(lambda a: a[0], stage_p)
+        idx = jax.lax.axis_index("pp")
+        is_first = idx == 0
+        is_last = idx == pp - 1
+
+        f_state = jnp.zeros_like(xm[0])          # incoming activation
+        b_state = jnp.zeros_like(xm[0])          # incoming gradient
+        act_buf = jnp.zeros((B,) + xm.shape[1:], xm.dtype)
+        seed_buf = jnp.zeros((B,) + xm.shape[1:], xm.dtype)
+        grads = jax.tree_util.tree_map(jnp.zeros_like, p_local)
+        dx_out = jnp.zeros_like(xm)              # d loss / d x per mb
+        loss_acc = jnp.zeros((), jnp.float32)
+
+        def e_at(m_idx):
+            return tuple(jax.lax.dynamic_index_in_dim(
+                e, jnp.clip(m_idx, 0, M - 1), 0, keepdims=False)
+                for e in em)
+
+        for t in range(2 * (M + pp - 1)):
+            # ---- forward step: stage idx runs microbatch t - idx ----
+            m_f = t - idx
+            f_active = (m_f >= 0) & (m_f < M)
+            inject = xm[min(t, M - 1)]
+            x_in = jnp.where(is_first & (t < M), inject, f_state)
+            e_f = e_at(m_f)
+            y = stage_fn(p_local, x_in, *e_f)
+            slot_f = jnp.mod(m_f, B)
+            act_buf = jnp.where(
+                f_active,
+                jax.lax.dynamic_update_index_in_dim(
+                    act_buf, x_in, slot_f, 0),
+                act_buf)
+            # last stage: microbatch m_f's loss + backward seed, the
+            # moment its forward completes
+            lab = jax.lax.dynamic_index_in_dim(
+                lm, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
+
+            def mb_loss(yy):
+                return jnp.sum(loss_fn(yy, lab)) / batch
+            lval, g_seed = jax.value_and_grad(mb_loss)(y)
+            loss_acc = loss_acc + jnp.where(is_last & f_active,
+                                            lval, 0.0)
+            seed_buf = jnp.where(
+                is_last & f_active,
+                jax.lax.dynamic_update_index_in_dim(
+                    seed_buf, g_seed.astype(xm.dtype), slot_f, 0),
+                seed_buf)
+
+            # ---- backward step: stage idx runs microbatch m_b ----
+            m_b = t - (2 * pp - 1) + idx
+            b_active = (m_b >= 0) & (m_b < M)
+            slot_b = jnp.mod(jnp.clip(m_b, 0, M - 1), B)
+            x_saved = jax.lax.dynamic_index_in_dim(act_buf, slot_b, 0,
+                                                   keepdims=False)
+            g_in = jnp.where(
+                is_last,
+                jax.lax.dynamic_index_in_dim(seed_buf, slot_b, 0,
+                                             keepdims=False),
+                b_state)
+            e_b = e_at(m_b)
+            _, vjp_fn = jax.vjp(
+                lambda p, xx: stage_fn(p, xx, *e_b), p_local, x_saved)
+            dp_m, dx_m = vjp_fn(g_in.astype(x_saved.dtype))
+            grads = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(b_active, g, 0.0),
+                grads, dp_m)
+            # the FIRST stage's dx is d loss / d x for microbatch m_b
+            dx_out = jnp.where(
+                is_first & b_active,
+                jax.lax.dynamic_update_index_in_dim(
+                    dx_out, dx_m, jnp.clip(m_b, 0, M - 1), 0),
+                dx_out)
+
+            # ---- rotate both channels ----
+            f_state = jax.lax.ppermute(y, "pp", fwd_perm)
+            b_state = jax.lax.ppermute(dx_m, "pp", bwd_perm)
+
+        # loss lives on the last stage only; each data shard holds only
+        # its batch slice — reduce over BOTH to report the global mean
+        # (and allreduce the stage grads over the data axes: that's the
+        # dp gradient sync, explicit here because this train step runs
+        # under shard_map rather than the engine's implicit-psum path)
+        loss_total = jax.lax.psum(loss_acc, ("pp",) + daxes)
+        if daxes:
+            grads = jax.lax.psum(grads, daxes)
+        dx_total = jax.lax.psum(dx_out, "pp")
+        # stage grads stay sharded over pp: re-add the leading [1, ...]
+        grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+        return loss_total, grads, dx_total
+
+    espec = tuple(P(None, tok) for _ in em)
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("pp"), P(None, tok), P(None, tok)) + espec,
+        out_specs=(P(), P("pp"), P(None, tok)),
+        check_vma=False)
+    loss, grads, dxm = fn(stage_params, xm, lm, *em)
+    return loss, grads, dxm.reshape(batch, *x.shape[1:])
 
 
 def stack_stage_params(per_stage_params) -> object:
