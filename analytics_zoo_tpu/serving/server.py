@@ -84,6 +84,8 @@ class ServingServer:
         self._results_lock = threading.Lock()
         self._stop = threading.Event()
         self._batches_run = 0
+        # batches may complete on concurrent executor threads
+        self._stats_lock = threading.Lock()
         from analytics_zoo_tpu.serving.timer import Timer
         self.timer = Timer()
 
@@ -243,19 +245,28 @@ class ServingServer:
 
     def _batcher(self):
         """Drain the queue into device-batches (the FlinkInference.map
-        analog).  With a worker pool, assembled batches dispatch to
-        replicas CONCURRENTLY, with a semaphore bounding in-flight
-        batches to 2x the worker count — without it the executor's
-        internal queue grows unboundedly under sustained overload,
-        holding every pending batch's concatenated input arrays
-        (ADVICE r3).  Single-model servers run batches inline."""
+        analog).  Assembled batches dispatch CONCURRENTLY — to worker-
+        pool replicas, or to the in-process model up to its
+        `supported_concurrent_num` (the reference InferenceModel's
+        model-pool concurrency: InferenceModel.scala's blocking queue of
+        N copies).  Overlapping dispatches keeps the device fed while
+        other batches are in host-side assembly or transfer — on a
+        remote/tunneled device it pipelines the round-trip latency.  A
+        semaphore bounds in-flight batches to 2x the concurrency —
+        without it the executor's internal queue grows unboundedly
+        under sustained overload, holding every pending batch's
+        concatenated input arrays (ADVICE r3)."""
         executor = None
         gate = None
-        if self.worker_pool is not None:
+        n_conc = (self.worker_pool.n_workers
+                  if self.worker_pool is not None else
+                  getattr(self.model, "supported_concurrent_num", 1))
+        # any worker pool gets an executor even at n=1: the replica runs
+        # in another process, so assembly/drain overlap is free there
+        if self.worker_pool is not None or n_conc > 1:
             from concurrent.futures import ThreadPoolExecutor
-            executor = ThreadPoolExecutor(
-                max_workers=self.worker_pool.n_workers)
-            gate = threading.Semaphore(2 * self.worker_pool.n_workers)
+            executor = ThreadPoolExecutor(max_workers=n_conc)
+            gate = threading.Semaphore(2 * n_conc)
         try:
             while not self._stop.is_set():
                 try:
@@ -311,7 +322,8 @@ class ServingServer:
             self.timer.record("batch_assemble", t1 - t0, sum(sizes))
             self.timer.record("predict", time.perf_counter() - t1,
                               sum(sizes))
-            self._batches_run += 1
+            with self._stats_lock:
+                self._batches_run += 1
             if not isinstance(outs, tuple):
                 outs = (outs,)
             off = 0
