@@ -1,0 +1,91 @@
+"""Process-wide accelerator lease — the TPU answer to AutoML trial
+placement (SURVEY.md §7 hard parts: "Tune assumes CPU oversubscription;
+TPU cores can't be fractionally shared — need slice-level trial
+placement").
+
+The reference's Ray Tune schedules trials as CPU-fraction actors
+(ray_tune_search_engine.py) — meaningless on a TPU, where one process
+owns the chip and a second process touching it deadlocks or ooms.  The
+TPU-native policy implemented here:
+
+* ONE process holds the TPU client (whoever imported jax first on this
+  host).  Everything that wants the chip runs in THAT process and
+  serializes through this lease — search trials, concurrent serving
+  loads, bench stages.
+* Trials that fit on CPU go to spawned worker processes pinned to
+  JAX_PLATFORMS=cpu (SearchEngine backend="process") — they never
+  touch the chip, so they parallelize freely across host cores.
+* Device-bound trials use SearchEngine backend="device": all trials
+  run in the chip-holding process, one at a time through this lease.
+  Staying in one process is what makes trial N+1 cheap: the in-process
+  jit caches and the persistent XLA compilation cache
+  (JAX_COMPILATION_CACHE_DIR) are shared, so trials whose
+  hyperparameters don't change tensor shapes skip compilation
+  entirely.
+
+The lease is deliberately a plain mutex, not a semaphore: a TPU chip
+has no useful notion of fractional occupancy (two concurrent programs
+contend for the same MXU and HBM), so admission is all or nothing.
+Wake order among waiters is the OS's, not strictly FIFO — callers must
+not depend on arrival order, only on exclusivity.  Holder identity and
+wait-time stats are exposed for tests and observability.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()          # the lease itself (exclusive, not FIFO)
+_state_lock = threading.Lock()    # guards the bookkeeping below
+_current_holder: Optional[str] = None
+_stats: Dict[str, float] = {"acquisitions": 0, "total_wait_s": 0.0,
+                            "total_hold_s": 0.0}
+_history: List[str] = []          # bounded holder log, newest last
+
+
+def current_holder() -> Optional[str]:
+    return _current_holder
+
+
+def stats() -> Dict[str, float]:
+    with _state_lock:
+        return dict(_stats)
+
+
+def history(n: int = 32) -> List[str]:
+    with _state_lock:
+        return _history[-n:]
+
+
+@contextmanager
+def device_lease(name: str = "anonymous", timeout: Optional[float] = None):
+    """Hold the host's accelerator exclusively.
+
+    >>> with device_lease("trial-3"):
+    ...     pass  # jit/compile/execute on the device here
+    """
+    global _current_holder
+    t0 = time.perf_counter()
+    ok = _lock.acquire(timeout=timeout if timeout is not None else -1)
+    if not ok:
+        raise TimeoutError(
+            f"device lease not acquired within {timeout}s "
+            f"(held by {_current_holder!r})")
+    waited = time.perf_counter() - t0
+    with _state_lock:
+        _current_holder = name
+        _stats["acquisitions"] += 1
+        _stats["total_wait_s"] += waited
+        _history.append(name)
+        del _history[:-256]
+    t1 = time.perf_counter()
+    try:
+        yield
+    finally:
+        with _state_lock:
+            _current_holder = None
+            _stats["total_hold_s"] += time.perf_counter() - t1
+        _lock.release()

@@ -21,6 +21,13 @@ trials target the HOST's cores, not the chip:
   `JAX_PLATFORMS=cpu` so they never fight over the TPU.  The trainable
   must be picklable (module-level function/class), the same contract Ray
   Tune puts on trainables.
+* `backend="device"` — for trainables that NEED the accelerator: every
+  trial runs in THIS process (the chip-holding one) and serializes
+  through `common.device_lease` — a chip has no fractional occupancy,
+  so admission is all-or-nothing.  One process means trials share the
+  in-process jit caches and the persistent XLA compilation cache, so a
+  trial whose hyperparameters don't change tensor shapes skips
+  compilation.  `parallelism` is ignored (and logged) here.
 
 A trial whose train call raises is marked NaN and culled at the next rung
 (the reference's Tune marks such trials ERROR); if every trial fails the
@@ -119,8 +126,9 @@ class SearchEngine:
         self.mode = metric_mode
         if metric_mode not in ("min", "max"):
             raise ValueError("metric_mode must be 'min' or 'max'")
-        if backend not in ("thread", "process"):
-            raise ValueError("backend must be 'thread' or 'process'")
+        if backend not in ("thread", "process", "device"):
+            raise ValueError(
+                "backend must be 'thread', 'process' or 'device'")
         if search_algorithm not in ("random", "tpe"):
             raise ValueError(
                 "search_algorithm must be 'random' or 'tpe' (the "
@@ -263,7 +271,16 @@ class SearchEngine:
 
     def run(self) -> Trial:
         self.trials = [Trial(i, c) for i, c in enumerate(self._configs())]
-        if self.parallelism > 1 and self.backend == "process":
+        if self.backend == "device":
+            if self.parallelism > 1:
+                logger.info(
+                    "backend='device': %d-way parallelism requested but "
+                    "a TPU chip cannot be shared — trials serialize "
+                    "through the device lease (compile caches are "
+                    "shared, so repeat shapes are cheap)",
+                    self.parallelism)
+            best = self._run_rungs(self._train_batch_device)
+        elif self.parallelism > 1 and self.backend == "process":
             best = self._run_with_process_pool()
         else:
             train_batch = (self._train_batch_threaded
@@ -352,14 +369,33 @@ class SearchEngine:
 
     # -- executors ------------------------------------------------------
 
-    def _train_batch_serial(self, work: List[Tuple[Trial, int]]):
+    def _train_batch_serial(self, work: List[Tuple[Trial, int]],
+                            trial_cm: Optional[Callable] = None):
+        """One-at-a-time trials; `trial_cm(trial)` (if given) wraps each
+        trainable call — the device backend passes the accelerator
+        lease here so the error-recording protocol lives once."""
+        from contextlib import nullcontext
+
         for t, add in work:
             try:
-                t.state, metric = self.trainable(t.config, t.state, add)
+                with (trial_cm(t) if trial_cm else nullcontext()):
+                    t.state, metric = self.trainable(t.config, t.state,
+                                                     add)
             except Exception as e:
                 self._record(t, add, 0.0, f"{type(e).__name__}: {e}")
             else:
                 self._record(t, add, metric)
+
+    def _train_batch_device(self, work: List[Tuple[Trial, int]]):
+        """Device-bound trials: in-process, one at a time through the
+        host's accelerator lease (SURVEY.md §7 "AutoML trial scheduling
+        on TPU pods").  Other lease users in this process (serving
+        loads, bench stages, a concurrent search) interleave safely at
+        trial boundaries."""
+        from analytics_zoo_tpu.common.device_lease import device_lease
+
+        self._train_batch_serial(
+            work, lambda t: device_lease(f"automl-trial-{t.trial_id}"))
 
     def _train_batch_threaded(self, work: List[Tuple[Trial, int]]):
         """Concurrent trials in-process: XLA compute releases the GIL, so

@@ -1,0 +1,147 @@
+"""Device-bound AutoML trial scheduling (VERDICT r3 next-round #6;
+SURVEY.md §7 hard parts: "AutoML trial scheduling on TPU pods" — a chip
+cannot be oversubscribed, so device trials serialize through the host's
+accelerator lease in the chip-holding process while CPU trials go to
+spawned workers)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.device_lease import (
+    current_holder,
+    device_lease,
+    history,
+    stats,
+)
+from analytics_zoo_tpu.orca.automl import hp
+from analytics_zoo_tpu.orca.automl.search_engine import SearchEngine
+
+
+def test_lease_is_exclusive_and_reports_holder():
+    entered = threading.Event()
+    release = threading.Event()
+
+    def hold():
+        with device_lease("holder-A"):
+            entered.set()
+            release.wait(timeout=10)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    assert entered.wait(timeout=5)
+    assert current_holder() == "holder-A"
+    with pytest.raises(TimeoutError, match="holder-A"):
+        with device_lease("holder-B", timeout=0.05):
+            pass
+    release.set()
+    t.join(timeout=5)
+    with device_lease("holder-C", timeout=5):
+        assert current_holder() == "holder-C"
+    assert current_holder() is None
+
+
+def test_device_backend_serializes_trials_under_contention():
+    """TWO concurrent device-backend searches (4 trials each) share the
+    chip-holding process; across BOTH, device windows must never
+    overlap (all-or-nothing admission).  Two searches on two threads
+    make the lease do real work — one search alone is single-threaded
+    and would serialize trivially."""
+    intervals = []
+    lock = threading.Lock()
+
+    def trainable(config, state, add_epochs):
+        t0 = time.perf_counter()
+        time.sleep(0.03)
+        with lock:
+            intervals.append((t0, time.perf_counter()))
+        return (state or 0) + add_epochs, config["p"]
+
+    space = {"p": hp.grid_search([4.0, 2.0, 3.0, 1.0])}
+    n0 = stats()["acquisitions"]
+    bests = [None, None]
+
+    def run_search(k: int):
+        eng = SearchEngine(trainable, space, epochs=1,
+                           backend="device")
+        bests[k] = eng.run()
+
+    threads = [threading.Thread(target=run_search, args=(k,),
+                                daemon=True) for k in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert bests[0].config["p"] == 1.0 and bests[1].config["p"] == 1.0
+    assert stats()["acquisitions"] - n0 >= 8
+    assert any(h.startswith("automl-trial-") for h in history())
+    intervals.sort()
+    for (_, e0), (s1, _) in zip(intervals, intervals[1:]):
+        assert s1 >= e0, "device trial windows overlapped"
+
+
+def test_device_backend_no_crosstalk_vs_serial():
+    """Same search, device backend vs plain serial: identical trial
+    tables (per-trial state isolated, deterministic order)."""
+
+    def trainable(config, state, add_epochs):
+        # stateful: metric improves with epochs so rungs matter
+        trained = (state or 0) + add_epochs
+        return trained, config["p"] / trained
+
+    space = {"p": hp.grid_search([8.0, 4.0, 6.0, 2.0])}
+    serial = SearchEngine(trainable, space, epochs=4, grace_epochs=1)
+    sbest = serial.run()
+    device = SearchEngine(trainable, space, epochs=4, grace_epochs=1,
+                          backend="device")
+    dbest = device.run()
+    assert dbest.config == sbest.config
+    srows = [(r["config"]["p"], r["metric"], r["epochs"])
+             for r in serial.trial_table()]
+    drows = [(r["config"]["p"], r["metric"], r["epochs"])
+             for r in device.trial_table()]
+    assert srows == drows
+
+
+def test_device_backend_real_estimator_trials():
+    """4 real Estimator trials (jit + device buffers) in one process:
+    each trial's model trains independently and the winner exports."""
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 4)).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = x @ w + 0.01 * rng.normal(size=128).astype(np.float32)
+
+    def trainable(config, state, add_epochs):
+        import flax.linen as nn
+
+        if state is None:
+            class MLP(nn.Module):
+                width: int
+
+                @nn.compact
+                def __call__(self, a, training=False):
+                    h = nn.relu(nn.Dense(self.width)(a))
+                    return nn.Dense(1)(h)[..., 0]
+
+            state = Estimator.from_flax(
+                MLP(width=config["width"]), loss="mse",
+                optimizer="adam", learning_rate=config["lr"])
+        state.fit({"x": x, "y": y}, epochs=add_epochs, batch_size=32)
+        mse = state.evaluate({"x": x, "y": y}, batch_size=64)["loss"]
+        return state, float(mse)
+
+    space = {"width": hp.grid_search([4, 8, 16, 32]),
+             "lr": hp.choice([1e-2])}
+    eng = SearchEngine(trainable, space, metric_mode="min", epochs=4,
+                       grace_epochs=1, backend="device")
+    best = eng.run()
+    # materially below the variance baseline = the winner really trained
+    assert best.best_metric is not None
+    assert best.best_metric < 0.7 * float(np.var(y))
+    # every trial produced an isolated estimator with its own width
+    widths = {r["config"]["width"] for r in eng.trial_table()}
+    assert widths == {4, 8, 16, 32}
