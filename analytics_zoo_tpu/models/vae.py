@@ -30,9 +30,11 @@ from analytics_zoo_tpu.models.common.zoo_model import ZooModel
 class VAE(nn.Module, ZooModel):
     """Conv VAE over [b, H, W, C] images in [0, 1].
 
-    __call__ returns (reconstruction_logits, kl_mean) — train it with
-    `VAE.estimator()` (sigmoid-BCE reconstruction + beta-weighted KL
-    via the engine's aux loss) and labels = the input images."""
+    __call__ returns (reconstruction_logits, kl) with kl a per-example
+    [batch] vector (the engine masked-means it so padded rows never
+    bias the aux loss) — train it with `VAE.estimator()` (sigmoid-BCE
+    reconstruction + beta-weighted KL via the engine's aux loss) and
+    labels = the input images."""
 
     latent_dim: int = 2
     image_shape: Tuple[int, int, int] = (28, 28, 1)

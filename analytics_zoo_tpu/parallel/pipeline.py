@@ -212,7 +212,12 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
                 e, jnp.clip(m_idx, 0, M - 1), 0, keepdims=False)
                 for e in em)
 
-        for t in range(2 * (M + pp - 1)):
+        # drained after M + 2*pp - 1 ticks: the last forward (stage
+        # pp-1, mb M-1) fires at tick M+pp-2 and the last backward
+        # (stage 0, mb M-1) at tick M+2pp-2 — any more ticks would be
+        # fully-gated no-ops that still trace a forward + vjp + two
+        # ppermutes each into the unrolled graph
+        for t in range(M + 2 * pp - 1):
             # ---- forward step: stage idx runs microbatch t - idx ----
             m_f = t - idx
             f_active = (m_f >= 0) & (m_f < M)
