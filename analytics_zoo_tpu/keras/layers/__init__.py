@@ -7,15 +7,23 @@ from analytics_zoo_tpu.keras.layers.core import (  # noqa: F401
     Highway,
     Lambda,
     Permute,
+    SparseDense,
     RepeatVector,
     Reshape,
 )
-from analytics_zoo_tpu.keras.layers.embeddings import Embedding  # noqa: F401
+from analytics_zoo_tpu.keras.layers.embeddings import (  # noqa: F401
+    Embedding,
+    SparseEmbedding,
+)
 from analytics_zoo_tpu.keras.layers.normalization import (  # noqa: F401
+    LRN2D,
     BatchNormalization,
     LayerNormalization,
+    WithinChannelLRN2D,
 )
 from analytics_zoo_tpu.keras.layers.conv import (  # noqa: F401
+    AtrousConvolution1D,
+    AtrousConvolution2D,
     Conv1D,
     Conv2D,
     Conv3D,
@@ -25,6 +33,7 @@ from analytics_zoo_tpu.keras.layers.conv import (  # noqa: F401
     Cropping2D,
     Deconvolution2D,
     SeparableConv2D,
+    ShareConvolution2D,
     UpSampling1D,
     UpSampling2D,
     ZeroPadding1D,
@@ -56,6 +65,7 @@ from analytics_zoo_tpu.keras.layers.merge import (  # noqa: F401
     Dot,
     Maximum,
     Merge,
+    Minimum,
     Multiply,
     merge,
 )
@@ -66,23 +76,29 @@ from analytics_zoo_tpu.keras.layers.self_attention import (  # noqa: F401
 from analytics_zoo_tpu.keras.layers.advanced_activations import (  # noqa: F401,E501
     ELU,
     LeakyReLU,
+    RReLU,
     PReLU,
     SReLU,
     ThresholdedReLU,
 )
 from analytics_zoo_tpu.keras.layers.elementwise import (  # noqa: F401
     AddConstant,
+    BinaryThreshold,
     CAdd,
     CMul,
     Exp,
+    Expand,
     ExpandDim,
+    GetShape,
     GaussianSampler,
     HardShrink,
     HardTanh,
     Identity,
     Log,
     Masking,
+    Max,
     MaxoutDense,
+    Mul,
     MulConstant,
     Narrow,
     Negative,
@@ -90,7 +106,9 @@ from analytics_zoo_tpu.keras.layers.elementwise import (  # noqa: F401
     ResizeBilinear,
     Scale,
     Select,
+    SelectTable,
     SoftShrink,
+    SplitTensor,
     Sqrt,
     Square,
     Squeeze,
@@ -102,6 +120,7 @@ from analytics_zoo_tpu.keras.layers.local import (  # noqa: F401
 )
 from analytics_zoo_tpu.keras.layers.convolutional_recurrent import (  # noqa: F401,E501
     ConvLSTM2D,
+    ConvLSTM3D,
 )
 from analytics_zoo_tpu.keras.layers.noise import (  # noqa: F401
     GaussianDropout,

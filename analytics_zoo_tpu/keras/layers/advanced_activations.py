@@ -83,3 +83,34 @@ class SReLU(Layer):
 
     def apply_flax(self, m, x, training=False):
         return m(x)
+
+
+class _RReLUModule(nn.Module):
+    lower: float
+    upper: float
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        if training:
+            a = jax.random.uniform(self.make_rng("dropout"), x.shape,
+                                   x.dtype, self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (reference RReLU, torch.py:609): training
+    draws the negative-side slope per element from U(lower, upper);
+    eval uses the mean slope (l+u)/2 — a LeakyReLU when l == u."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.lower, self.upper = lower, upper
+
+    def build_flax(self):
+        return _RReLUModule(self.lower, self.upper, name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x, training=training)

@@ -34,7 +34,8 @@ class _ConvND(Layer):
 
     def __init__(self, nb_filter: int, kernel_size, activation=None,
                  subsample=1, border_mode: str = "valid",
-                 use_bias: bool = True, name: Optional[str] = None, **_):
+                 use_bias: bool = True, dilation=1,
+                 name: Optional[str] = None, **_):
         super().__init__(name)
         self.nb_filter = nb_filter
         self.kernel_size = _tup(kernel_size, self.ndim)
@@ -42,10 +43,12 @@ class _ConvND(Layer):
         self.padding = _pad(border_mode)
         self.activation = get_activation(activation)
         self.use_bias = use_bias
+        self.dilation = _tup(dilation, self.ndim)
 
     def build_flax(self):
         return nn.Conv(self.nb_filter, self.kernel_size,
                        strides=self.strides, padding=self.padding,
+                       kernel_dilation=self.dilation,
                        use_bias=self.use_bias, name=self.name)
 
     def apply_flax(self, m, x, training=False):
@@ -73,6 +76,34 @@ class Conv3D(_ConvND):
 
     def __init__(self, nb_filter, kernel_size=3, **kwargs):
         super().__init__(nb_filter, kernel_size, **kwargs)
+
+
+class AtrousConvolution1D(Conv1D):
+    """Dilated 1-D convolution (reference AtrousConvolution1D —
+    keras-1 naming for `dilation_rate`); lowers to the same
+    lax.conv_general_dilated XLA op as Conv1D."""
+
+    def __init__(self, nb_filter, filter_length=3, atrous_rate=1,
+                 **kwargs):
+        super().__init__(nb_filter, filter_length,
+                         dilation=atrous_rate, **kwargs)
+
+
+class AtrousConvolution2D(Conv2D):
+    """Dilated 2-D convolution (reference AtrousConvolution2D)."""
+
+    def __init__(self, nb_filter, nb_row=3, nb_col=None, atrous_rate=1,
+                 **kwargs):
+        super().__init__(nb_filter, nb_row, nb_col,
+                         dilation=atrous_rate, **kwargs)
+
+
+class ShareConvolution2D(Conv2D):
+    """Reference ShareConvolution2D (torch.py:209): a Conv2D whose
+    workspace buffers are shared across model replicas to cut JVM
+    memory.  Buffer reuse is XLA's job on TPU (the compiler plans all
+    allocations), so the layer is mathematically and practically
+    Conv2D; the name is kept for API parity."""
 
 
 # reference naming aliases

@@ -14,25 +14,32 @@ from typing import Optional, Tuple
 import flax.linen as nn
 
 from analytics_zoo_tpu.keras.engine import Layer
-from analytics_zoo_tpu.keras.layers.local import _pair
 
 
-class ConvLSTM2D(Layer):
-    """Input [b, t, h, w, c] -> [b, t, h, w, filters] (or final state
-    [b, h, w, filters] with return_sequences=False)."""
+class _ConvLSTMND(Layer):
+    """Shared ConvLSTM recurrence: flax's ConvLSTMCell is rank-
+    agnostic (the kernel tuple's length sets the spatial rank), so 2D
+    and 3D differ only in how `kernel_size`/`strides` normalize."""
+
+    _rank = 2
 
     def __init__(self, filters: int, kernel_size, strides=1,
                  return_sequences: bool = False,
                  name: Optional[str] = None):
         super().__init__(name)
         self.filters = filters
-        self.kernel_size = _pair(kernel_size)
-        if _pair(strides) != (1, 1):
+        self.kernel_size = self._tuple(kernel_size)
+        ones = (1,) * self._rank
+        if self._tuple(strides) != ones:
             raise ValueError(
-                "ConvLSTM2D supports stride 1 only (matching flax "
-                "ConvLSTMCell; the reference's strided variant subsamples "
-                "inputs before the recurrence)")
+                f"{type(self).__name__} supports stride 1 only "
+                "(matching flax ConvLSTMCell; the reference's strided "
+                "variant subsamples inputs before the recurrence)")
         self.return_sequences = return_sequences
+
+    def _tuple(self, v) -> Tuple[int, ...]:
+        from analytics_zoo_tpu.keras.layers.conv import _tup
+        return _tup(v, self._rank)
 
     def build_flax(self):
         return nn.RNN(
@@ -43,3 +50,20 @@ class ConvLSTM2D(Layer):
     def apply_flax(self, m, x, training=False):
         out = m(x)
         return out if self.return_sequences else out[:, -1]
+
+
+class ConvLSTM2D(_ConvLSTMND):
+    """Input [b, t, h, w, c] -> [b, t, h, w, filters] (or final state
+    [b, h, w, filters] with return_sequences=False)."""
+
+    _rank = 2
+
+
+class ConvLSTM3D(_ConvLSTMND):
+    """Input [b, t, d, h, w, c] -> [b, t, d, h, w, filters] (or final
+    state [b, d, h, w, filters] with return_sequences=False).
+    Reference: scala `keras/layers/ConvLSTM3D.scala` (volumetric
+    ConvLSTM over 5-D frames); the recurrence is the same one
+    lax.scan of fused convs as ConvLSTM2D, just with rank-3 kernels."""
+
+    _rank = 3

@@ -287,3 +287,106 @@ class GaussianSampler(Layer):
 
     def apply_flax(self, m, mean, log_var, training=False):
         return m(mean, log_var, training=training)
+
+
+class BinaryThreshold(Layer):
+    """1.0 where x > value else 0.0 (reference BinaryThreshold,
+    torch.py:696)."""
+
+    def __init__(self, value: float = 1e-6, name: Optional[str] = None):
+        super().__init__(name)
+        self.value = value
+
+    def call(self, x, training=False):
+        return (x > self.value).astype(jnp.float32)
+
+
+class _MulModule(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        # single learnable scalar; init 1.0 (identity) rather than the
+        # reference's uniform(-1, 1) so a fresh layer doesn't randomly
+        # flip the signal's sign
+        return x * self.param("weight", nn.initializers.ones, (1,))
+
+
+class Mul(Layer):
+    """Learnable single-scalar multiplier (reference Mul,
+    torch.py:395)."""
+
+    def build_flax(self):
+        return _MulModule(name=self.name)
+
+    def apply_flax(self, m, x, training=False):
+        return m(x)
+
+
+class Max(Layer):
+    """Max over dimension `dim`, axis kept as size 1 (reference Max —
+    scala Max.scala computeOutputShape keeps a size-1 dim; indices
+    output (return_value=False) is not reproduced: argmax ints don't
+    backprop and nothing downstream in the reference consumes them)."""
+
+    def __init__(self, dim: int, name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.dim = dim
+
+    def call(self, x, training=False):
+        return jnp.max(x, axis=self.dim, keepdims=True)
+
+
+class Expand(Layer):
+    """Broadcast size-1 dims up to `tgt_sizes` (reference Expand /
+    InternalExpand; -1 keeps the input's size, dims count the batch
+    axis like the reference)."""
+
+    def __init__(self, tgt_sizes: Sequence[int],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.tgt_sizes = tuple(int(s) for s in tgt_sizes)
+
+    def call(self, x, training=False):
+        if len(self.tgt_sizes) != x.ndim:
+            raise ValueError(
+                f"Expand tgt_sizes {self.tgt_sizes} rank != input rank "
+                f"{x.ndim}")
+        tgt = tuple(x.shape[i] if s == -1 else s
+                    for i, s in enumerate(self.tgt_sizes))
+        return jnp.broadcast_to(x, tgt)
+
+
+class GetShape(Layer):
+    """The input's (static) shape as an int32 vector, batch dim
+    included (reference GetShape, core.py:345).  Shapes are static
+    under jit, so this is a compile-time constant."""
+
+    def call(self, x, training=False):
+        return jnp.asarray(x.shape, jnp.int32)
+
+
+class SplitTensor(Layer):
+    """Split along `dim` into `num_splits` equal parts; produces a
+    tuple of outputs (reference SplitTensor / InternalSplitTensor —
+    the Table output becomes the graph API's multi-output tuple,
+    consumed directly or via SelectTable)."""
+
+    def __init__(self, dim: int, num_splits: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dim, self.num_splits = dim, num_splits
+        self.n_outputs = num_splits
+
+    def call(self, x, training=False):
+        return tuple(jnp.split(x, self.num_splits, axis=self.dim))
+
+
+class SelectTable(Layer):
+    """Pick element `index` (0-based) from a list of inputs (reference
+    SelectTable, torch.py:793)."""
+
+    def __init__(self, index: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.index = index
+
+    def call(self, *xs, training=False):
+        return xs[self.index]

@@ -6,6 +6,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from analytics_zoo_tpu.keras.engine import Layer
@@ -140,3 +141,67 @@ def glove_word_embedding(path: str, word_index: dict,
     vectors, dim = read_glove_vectors(path)
     return WordEmbedding.from_word_index(word_index, vectors, dim,
                                          trainable=trainable, name=name)
+
+
+class _SparseEmbeddingModule(nn.Module):
+    input_dim: int
+    output_dim: int
+    combiner: str
+    max_norm: float
+
+    @nn.compact
+    def __call__(self, ids, weights=None):
+        # symmetric U(-0.05, 0.05), the keras "uniform" init this layer
+        # mirrors (flax's uniform() is one-sided [0, scale))
+        table = self.param(
+            "embedding",
+            lambda key, shape: jax.random.uniform(
+                key, shape, minval=-0.05, maxval=0.05),
+            (self.input_dim, self.output_dim))
+        mask = (ids >= 0)
+        rows = jnp.take(table, jnp.maximum(ids, 0), axis=0)  # [b,k,out]
+        if self.max_norm > 0:
+            norm = jnp.linalg.norm(rows, axis=-1, keepdims=True)
+            rows = rows * jnp.minimum(1.0, self.max_norm
+                                      / jnp.maximum(norm, 1e-12))
+        w = (jnp.where(mask, weights, 0.0) if weights is not None
+             else mask.astype(rows.dtype))
+        s = jnp.sum(rows * w[..., None], axis=-2)
+        if self.combiner == "sum":
+            return s
+        denom = jnp.sum(w, axis=-1, keepdims=True)
+        if self.combiner == "mean":
+            return s / jnp.maximum(denom, 1e-12)
+        if self.combiner == "sqrtn":
+            sq = jnp.sqrt(jnp.sum(jnp.square(w), axis=-1,
+                                  keepdims=True))
+            return s / jnp.maximum(sq, 1e-12)
+        raise ValueError(f"unknown combiner {self.combiner!r}")
+
+
+class SparseEmbedding(Layer):
+    """Embedding-bag over sparse id rows (reference SparseEmbedding,
+    embeddings.py:166: a 2-D SparseTensor of ids, optionally paired
+    with per-id weights).  TPU-native encoding: `ids` [b, k] with -1
+    padding (and optional `weights` [b, k] as a second input), reduced
+    per row with `combiner` in {"sum", "mean", "sqrtn"}; `max_norm`
+    l2-clips each embedding before combining.  One gather + masked
+    reduce — no sparse formats on device."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 combiner: str = "sum", max_norm: float = -1.0,
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError("combiner must be sum|mean|sqrtn")
+        self.input_dim, self.output_dim = input_dim, output_dim
+        self.combiner, self.max_norm = combiner, max_norm
+
+    def build_flax(self):
+        return _SparseEmbeddingModule(self.input_dim, self.output_dim,
+                                      self.combiner, self.max_norm,
+                                      name=self.name)
+
+    def apply_flax(self, m, ids, weights=None, training=False):
+        return m(ids.astype(jnp.int32) if ids.dtype != jnp.int32
+                 else ids, weights)

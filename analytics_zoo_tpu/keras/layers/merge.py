@@ -38,6 +38,11 @@ class Merge(Layer):
             for x in xs[1:]:
                 out = jnp.maximum(out, x)
             return out
+        if self.mode == "min":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
+            return out
         if self.mode in ("concat", "concatenate"):
             return jnp.concatenate(xs, axis=self.concat_axis)
         if self.mode == "dot":
@@ -68,6 +73,7 @@ Add = _named("sum")
 Multiply = _named("mul")
 Average = _named("ave")
 Maximum = _named("max")
+Minimum = _named("min")
 Dot = _named("dot")
 
 

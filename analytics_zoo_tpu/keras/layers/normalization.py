@@ -37,3 +37,54 @@ class LayerNormalization(Layer):
 
     def apply_flax(self, m, x, training=False):
         return m(x)
+
+
+class LRN2D(Layer):
+    """Cross-channel local response normalization (reference LRN2D,
+    torch.py:176 / BigDL SpatialCrossMapLRN):
+    y = x / (k + alpha/n * sum_{j in n-window over channels} x_j^2)^beta
+    on channels-last [b, h, w, c] input."""
+
+    def __init__(self, alpha: float = 1e-4, k: float = 1.0,
+                 beta: float = 0.75, n: int = 5,
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.alpha, self.k, self.beta, self.n = alpha, k, beta, n
+
+    def call(self, x, training=False):
+        import jax
+        import jax.numpy as jnp
+
+        sq = jnp.square(x)
+        window = (1,) * (x.ndim - 1) + (self.n,)
+        s = jax.lax.reduce_window(sq, 0.0, jax.lax.add, window,
+                                  (1,) * x.ndim, "SAME")
+        return x / jnp.power(self.k + self.alpha / self.n * s,
+                             self.beta)
+
+
+class WithinChannelLRN2D(Layer):
+    """Within-channel (spatial) local response normalization
+    (reference WithinChannelLRN2D, torch.py:667 / BigDL
+    SpatialWithinChannelLRN): each value is divided by
+    (1 + alpha/(size^2) * sum of x^2 over a size x size spatial
+    window in its own channel)^beta."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def call(self, x, training=False):
+        import jax
+        import jax.numpy as jnp
+
+        if x.ndim != 4:
+            raise ValueError(
+                f"WithinChannelLRN2D expects [b, h, w, c], got {x.shape}")
+        sq = jnp.square(x)
+        window = (1, self.size, self.size, 1)
+        s = jax.lax.reduce_window(sq, 0.0, jax.lax.add, window,
+                                  (1, 1, 1, 1), "SAME")
+        denom = 1.0 + self.alpha / (self.size * self.size) * s
+        return x / jnp.power(denom, self.beta)

@@ -1,0 +1,164 @@
+"""Round-4 layer-vocabulary closure (VERDICT r3 next-round #7):
+ConvLSTM3D + the remaining reference layers — AtrousConvolution1D/2D,
+ShareConvolution2D, LRN2D, WithinChannelLRN2D, BinaryThreshold, Mul,
+Max, Expand, GetShape, SplitTensor, SelectTable, RReLU, SparseDense,
+SparseEmbedding (reference scala pipeline/api/keras/layers/ +
+pyzoo torch.py/core.py/embeddings.py)."""
+
+import numpy as np
+
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.keras.engine import Input
+from analytics_zoo_tpu.keras.models import Model, Sequential
+
+from tests.test_keras_layer_breadth import _run
+
+
+def test_convlstm3d_shapes_and_grad():
+    import jax
+
+    x = np.random.default_rng(0).normal(
+        size=(2, 3, 4, 5, 6, 2)).astype(np.float32)  # [b,t,d,h,w,c]
+    out = _run([L.ConvLSTM3D(3, (2, 2, 2), return_sequences=True)], x)
+    assert out.shape == (2, 3, 4, 5, 6, 3)
+    out = _run([L.ConvLSTM3D(3, 2)], x)
+    assert out.shape == (2, 4, 5, 6, 3)
+
+    # gradients flow through the scan recurrence
+    m = Sequential([L.ConvLSTM3D(2, 2)])
+    mod = m.to_flax()
+    variables = mod.init(jax.random.PRNGKey(0), x)
+
+    def loss(v):
+        return (mod.apply(v, x) ** 2).sum()
+
+    g = jax.grad(loss)(variables)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert any(float(np.abs(np.asarray(le)).max()) > 0 for le in leaves)
+
+
+def test_atrous_convolutions():
+    x = np.random.default_rng(0).normal(size=(2, 16, 3)).astype(np.float32)
+    out = _run([L.AtrousConvolution1D(4, 3, atrous_rate=2)], x)
+    # effective kernel 1 + (3-1)*2 = 5 -> valid length 12
+    assert out.shape == (2, 12, 4)
+    xi = np.random.default_rng(1).normal(
+        size=(2, 10, 10, 3)).astype(np.float32)
+    out = _run([L.AtrousConvolution2D(4, 3, 3, atrous_rate=2)], xi)
+    assert out.shape == (2, 6, 6, 4)
+    # ShareConvolution2D is Conv2D parity (buffer sharing is XLA's job)
+    out = _run([L.ShareConvolution2D(4, 3, 3)], xi)
+    assert out.shape == (2, 8, 8, 4)
+
+
+def test_lrn_layers():
+    x = np.random.default_rng(0).normal(
+        size=(2, 6, 6, 8)).astype(np.float32)
+    out = _run([L.LRN2D(alpha=1e-2, k=1.0, beta=0.75, n=3)], x)
+    assert out.shape == x.shape
+    # normalization shrinks magnitude, preserves sign
+    assert np.all(np.abs(out) <= np.abs(x) + 1e-6)
+    assert np.all(np.sign(out) == np.sign(x))
+    # golden: single channel, n=1 -> x / (k + alpha*x^2)^beta
+    x1 = np.array([[[[2.0]]]], np.float32)
+    got = _run([L.LRN2D(alpha=0.5, k=1.0, beta=1.0, n=1)], x1)
+    assert np.allclose(got, 2.0 / (1.0 + 0.5 * 4.0))
+
+    out = _run([L.WithinChannelLRN2D(size=3, alpha=1.0)], x)
+    assert out.shape == x.shape
+    # golden 1x1 spatial: denom = (1 + alpha/size^2 * x^2)^beta
+    got = _run([L.WithinChannelLRN2D(size=3, alpha=9.0, beta=1.0)], x1)
+    assert np.allclose(got, 2.0 / (1.0 + 1.0 * 4.0))
+
+
+def test_binary_threshold_mul_max():
+    x = np.array([[-1.0, 0.5, 2.0]], np.float32)
+    assert np.allclose(_run([L.BinaryThreshold(0.6)], x), [[0, 0, 1]])
+    assert np.allclose(_run([L.Mul()], x), x)  # init = identity scalar
+    xm = np.array([[[1.0, 5.0], [3.0, 2.0]]], np.float32)
+    out = _run([L.Max(dim=1)], xm)
+    assert out.shape == (1, 1, 2)
+    assert np.allclose(out, [[[3.0, 5.0]]])
+
+
+def test_expand_getshape():
+    x = np.ones((2, 1, 3), np.float32)
+    out = _run([L.Expand((-1, 4, -1))], x)
+    assert out.shape == (2, 4, 3)
+    out = _run([L.GetShape()], x)
+    assert np.array_equal(out, [2, 1, 3])
+
+
+def test_split_tensor_select_table():
+    x = np.arange(12, dtype=np.float32).reshape(2, 6)
+    inp = Input((6,))
+    parts = L.SplitTensor(dim=1, num_splits=3)(inp)
+    assert len(parts) == 3
+    picked = L.SelectTable(1)(list(parts))
+    m = Model(inp, picked)
+    out = m.predict(x, batch_size=2)
+    assert np.allclose(out, x[:, 2:4])
+
+
+def test_rrelu_modes():
+    x = np.array([[-4.0, -1.0, 2.0]], np.float32)
+    lower, upper = 0.1, 0.3
+    # eval: deterministic mean slope
+    out = _run([L.RReLU(lower, upper)], x, training=False)
+    assert np.allclose(out, [[-4.0 * 0.2, -0.2, 2.0]])
+    # training: slopes within [lower, upper], positives untouched
+    out = _run([L.RReLU(lower, upper)], x, training=True)
+    assert out[0, 2] == 2.0
+    slopes = out[0, :2] / x[0, :2]
+    assert np.all(slopes >= lower - 1e-6)
+    assert np.all(slopes <= upper + 1e-6)
+
+
+def test_sparse_dense():
+    import jax
+
+    ids = np.array([[0, 3, -1], [1, -1, -1]], np.int32)
+    vals = np.array([[1.0, 2.0, 99.0], [0.5, 99.0, 99.0]], np.float32)
+    i1, i2 = Input((3,)), Input((3,))
+    y = L.SparseDense(4, input_dim=6, name="sd")([i1, i2])
+    m = Model([i1, i2], y)
+    mod = m.to_flax()
+    variables = mod.init(jax.random.PRNGKey(0), ids, vals)
+    out = np.asarray(mod.apply(variables, ids, vals))
+    w = np.asarray(variables["params"]["sd"]["kernel"])
+    b = np.asarray(variables["params"]["sd"]["bias"])
+    # padding (-1) rows must not contribute despite value 99
+    want0 = 1.0 * w[0] + 2.0 * w[3] + b
+    want1 = 0.5 * w[1] + b
+    assert np.allclose(out, np.stack([want0, want1]), atol=1e-5)
+
+
+def test_sparse_embedding_combiners():
+    import jax
+
+    ids = np.array([[2, 5, -1], [7, -1, -1]], np.int32)
+    for combiner in ("sum", "mean", "sqrtn"):
+        inp = Input((3,))
+        yv = L.SparseEmbedding(10, 4, combiner=combiner,
+                               name=f"se_{combiner}")(inp)
+        m = Model(inp, yv)
+        mod = m.to_flax()
+        variables = mod.init(jax.random.PRNGKey(0), ids)
+        out = np.asarray(mod.apply(variables, ids))
+        table = np.asarray(
+            variables["params"][f"se_{combiner}"]["embedding"])
+        s0 = table[2] + table[5]
+        n0 = {"sum": 1.0, "mean": 2.0, "sqrtn": np.sqrt(2.0)}[combiner]
+        assert np.allclose(out[0], s0 / n0, atol=1e-5)
+        # single-id row: all combiners agree
+        assert np.allclose(out[1], table[7], atol=1e-5)
+
+    # max_norm l2-clips each row before combining
+    inp = Input((3,))
+    yv = L.SparseEmbedding(10, 4, combiner="sum", max_norm=0.01,
+                           name="se_norm")(inp)
+    m = Model(inp, yv)
+    mod = m.to_flax()
+    variables = mod.init(jax.random.PRNGKey(0), ids)
+    out = np.asarray(mod.apply(variables, ids))
+    assert np.linalg.norm(out[1]) <= 0.01 + 1e-6
