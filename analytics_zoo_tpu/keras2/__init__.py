@@ -1,0 +1,13 @@
+"""keras2 namespace (reference `pyzoo/zoo/pipeline/api/keras2/` — the
+keras-2-signature variant of the zoo Keras API, partial in the
+reference too: core/conv/pooling/merge/local layers only).
+
+TPU-native design: one implementation.  These classes are thin
+signature adapters (`units`/`filters`/`kernel_size`/`strides`/
+`padding`/`rate` naming) over `analytics_zoo_tpu.keras` — the graph
+engine, flax lowering, and training path are shared, so a keras2 model
+is a keras model."""
+
+from analytics_zoo_tpu.keras.engine import Input  # noqa: F401
+from analytics_zoo_tpu.keras.models import Model, Sequential  # noqa: F401
+from analytics_zoo_tpu.keras2 import layers  # noqa: F401
