@@ -82,6 +82,9 @@ class _MTNet(nn.Module):
         logits = jnp.einsum("bnh,bh->bn", m, u) / jnp.sqrt(
             jnp.asarray(self.rnn_hid, jnp.float32))
         attn = jax.nn.softmax(logits, axis=1)
+        # observable (and pruned unless "intermediates" is mutable):
+        # tests assert the memory weights stay a simplex
+        self.sow("intermediates", "memory_attention", attn)
         context = jnp.einsum("bn,bnh->bh", attn, m)
 
         fused = jnp.concatenate([context, u], axis=-1)
