@@ -4,11 +4,13 @@
 `new_graph`/`freeze`).
 
 TPU-native: the live import paths are ONNX (wire decoder + flax
-interpreter) and torch (fx tracing); JVM-serialized formats (BigDL,
-Caffe, TF1 frozen graphs) have no portable runtime here and raise with
-the ONNX/torch escape hatch spelled out.  Graph surgery operates on the
-decoded ONNX graph: `new_graph` backward-slices to new output tensors,
-`freeze` turns trainable initializers into constants."""
+interpreter), torch (fx tracing), and TF1 frozen GraphDefs
+(`pipeline/tf_graph.py` — protobuf wire reader + jax interpreter, no
+tensorflow in the loop); the JVM-serialized formats (BigDL, Caffe)
+have no portable runtime here and raise with the ONNX escape hatch
+spelled out.  Graph surgery operates on the decoded ONNX graph:
+`new_graph` backward-slices to new output tensors, `freeze` turns
+trainable initializers into constants."""
 
 from __future__ import annotations
 
@@ -49,10 +51,15 @@ class Net:
             "Net.load_onnx")
 
     @staticmethod
-    def load_tf(path: str):
-        raise NotImplementedError(
-            "TF graph import is not supported in this image (no "
-            "tensorflow); export to ONNX and use Net.load_onnx")
+    def load_tf(path: str, outputs=None):
+        """Load a frozen TF1 GraphDef `.pb` for inference (reference
+        net_load.py:30 Net.load_tf / TFNet.scala).  No tensorflow in
+        the loop: the protobuf is decoded by a hand-rolled wire reader
+        and the graph interpreted into one jittable jax function
+        (`pipeline/tf_graph.py`).  Returns a TFNet: `predict(*arrays)`
+        feeds the placeholders."""
+        from analytics_zoo_tpu.pipeline.tf_graph import load_tf_graph
+        return load_tf_graph(path, outputs=outputs)
 
 
 class GraphNet:

@@ -110,6 +110,19 @@ class InferenceModel:
         self._predict_fn = lambda *feats: fn(params, model_state, *feats)
         return self
 
+    def load_tf(self, path_or_bytes, outputs=None):
+        """Serve a frozen TF1 GraphDef (reference doLoadTF /
+        TFNet-backed serving): the imported graph
+        (`pipeline/tf_graph.py`) becomes one jitted XLA program behind
+        the same batch-bucketed, semaphore-bounded predict path."""
+        import jax
+
+        from analytics_zoo_tpu.pipeline.tf_graph import load_tf_graph
+
+        net = load_tf_graph(path_or_bytes, outputs=outputs)
+        self._predict_fn = jax.jit(net._eval)
+        return self
+
     def load_model(self, path: str, model_cls=None,
                    quantize: bool = False, decrypt_key: str = None):
         """Load a `ZooModel.save_model` directory (reference

@@ -1,0 +1,241 @@
+"""TF1 frozen-graph importer (VERDICT r3 missing #4 / §2.4 "net
+loaders"; reference net_load.py:30 Net.load_tf + TFNet.scala).  Graphs
+are built as REAL protobuf wire bytes by tests/tf_graphdef_builder.py,
+then imported and checked against numpy math."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.net import Net
+from tests.tf_graphdef_builder import (
+    attr_b,
+    attr_f,
+    attr_i,
+    attr_ints,
+    attr_s,
+    attr_type,
+    const,
+    graphdef,
+    node,
+    placeholder,
+)
+
+
+def test_dense_relu_graph():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(4, 3)).astype(np.float32)
+    b = rng.normal(size=(3,)).astype(np.float32)
+    gd = graphdef([
+        placeholder("x"),
+        const("w", w), const("b", b),
+        node("mm", "MatMul", ["x", "w"]),
+        node("ba", "BiasAdd", ["mm", "b"]),
+        node("out", "Relu", ["ba"]),
+    ])
+    net = Net.load_tf(gd)
+    assert net.input_names == ["x"]
+    assert net.output_names == ["out"]
+    x = rng.normal(size=(5, 4)).astype(np.float32)
+    got = net.predict(x)
+    assert np.allclose(got, np.maximum(x @ w + b, 0), atol=1e-5)
+
+
+def test_conv_pool_batchnorm_graph():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    k = rng.normal(size=(3, 3, 3, 4)).astype(np.float32)
+    scale = rng.uniform(0.5, 1.5, 4).astype(np.float32)
+    offset = rng.normal(size=4).astype(np.float32)
+    mean = rng.normal(size=4).astype(np.float32)
+    var = rng.uniform(0.5, 2.0, 4).astype(np.float32)
+    gd = graphdef([
+        placeholder("x"),
+        const("k", k), const("scale", scale), const("offset", offset),
+        const("mean", mean), const("var", var),
+        node("conv", "Conv2D", ["x", "k"],
+             {"strides": attr_ints([1, 1, 1, 1]),
+              "padding": attr_s("SAME"),
+              "data_format": attr_s("NHWC")}),
+        node("bn", "FusedBatchNormV3",
+             ["conv", "scale", "offset", "mean", "var"],
+             {"epsilon": attr_f(1e-3)}),
+        node("relu", "Relu", ["bn:0"]),
+        node("pool", "MaxPool", ["relu"],
+             {"ksize": attr_ints([1, 2, 2, 1]),
+              "strides": attr_ints([1, 2, 2, 1]),
+              "padding": attr_s("VALID")}),
+    ])
+    net = Net.load_tf(gd)
+    got = net.predict(x)
+    assert got.shape == (2, 4, 4, 4)
+    # numpy reference
+    pad = np.pad(x, [(0, 0), (1, 1), (1, 1), (0, 0)])
+    conv = np.zeros((2, 8, 8, 4), np.float32)
+    for o in range(4):
+        for i in range(3):
+            for dy in range(3):
+                for dx in range(3):
+                    conv[:, :, :, o] += (
+                        pad[:, dy:dy + 8, dx:dx + 8, i] * k[dy, dx, i, o])
+    bn = (conv - mean) / np.sqrt(var + 1e-3) * scale + offset
+    relu = np.maximum(bn, 0)
+    want = relu.reshape(2, 4, 2, 4, 2, 4).max(axis=(2, 4))
+    assert np.allclose(got, want, atol=1e-3)
+
+
+def test_reductions_and_shapes():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    gd = graphdef([
+        placeholder("x"),
+        const("axes", np.array([1], np.int32)),
+        node("m", "Mean", ["x", "axes"], {"keep_dims": attr_b(True)}),
+        const("newshape", np.array([2, 4], np.int32)),
+        node("sq", "Squeeze", ["m"], {"squeeze_dims": attr_ints([1])}),
+        node("r", "Reshape", ["sq", "newshape"]),
+        node("sm", "Softmax", ["r"]),
+    ])
+    net = Net.load_tf(gd)
+    got = net.predict(x)
+    want = x.mean(axis=1)
+    want = np.exp(want - want.max(-1, keepdims=True))
+    want = want / want.sum(-1, keepdims=True)
+    assert np.allclose(got, want, atol=1e-5)
+
+
+def test_depthwise_and_concat_and_explicit_outputs():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(1, 6, 6, 2)).astype(np.float32)
+    dk = rng.normal(size=(3, 3, 2, 1)).astype(np.float32)
+    gd = graphdef([
+        placeholder("x"),
+        const("dk", dk),
+        node("dw", "DepthwiseConv2dNative", ["x", "dk"],
+             {"strides": attr_ints([1, 1, 1, 1]),
+              "padding": attr_s("SAME")}),
+        const("cax", np.array(3, np.int32)),
+        node("cat", "ConcatV2", ["x", "dw", "cax"],
+             {"N": attr_i(2)}),
+        node("sig", "Sigmoid", ["cat"]),
+    ])
+    # explicit intermediate output (reference TFNet output selection)
+    net = Net.load_tf(gd, outputs=["dw"])
+    got = net.predict(x)
+    assert got.shape == (1, 6, 6, 2)
+    pad = np.pad(x, [(0, 0), (1, 1), (1, 1), (0, 0)])
+    want = np.zeros_like(got)
+    for c in range(2):
+        for dy in range(3):
+            for dx in range(3):
+                want[:, :, :, c] += (
+                    pad[:, dy:dy + 6, dx:dx + 6, c] * dk[dy, dx, c, 0])
+    assert np.allclose(got, want, atol=1e-4)
+    full = Net.load_tf(gd)
+    assert full.output_names == ["sig"]
+    assert full.predict(x).shape == (1, 6, 6, 4)
+
+
+def test_unsupported_op_is_loud():
+    gd = graphdef([
+        placeholder("x"),
+        node("bad", "SparseTensorDenseMatMul", ["x"]),
+    ])
+    net = Net.load_tf(gd)
+    with pytest.raises(NotImplementedError, match="SparseTensorDense"):
+        net.predict(np.zeros((2, 2), np.float32))
+
+
+def test_control_edges_and_identity_chain():
+    w = np.eye(3, dtype=np.float32) * 2.0
+    gd = graphdef([
+        placeholder("x"),
+        const("w", w),
+        node("init", "NoOp"),
+        node("wi", "Identity", ["w", "^init"]),
+        node("mm", "MatMul", ["x", "wi"]),
+    ])
+    net = Net.load_tf(gd)
+    x = np.ones((2, 3), np.float32)
+    assert np.allclose(net.predict(x), x * 2.0)
+
+
+def test_tf_graph_served_through_inference_model():
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(6, 2)).astype(np.float32)
+    gd = graphdef([
+        placeholder("x"),
+        const("w", w),
+        node("mm", "MatMul", ["x", "w"]),
+        node("out", "Softmax", ["mm"]),
+    ])
+    im = InferenceModel(max_batch_size=16).load_tf(gd)
+    x = rng.normal(size=(5, 6)).astype(np.float32)
+    got = im.predict(x)   # batch 5 pads to bucket 8; depadded back
+    assert got.shape == (5, 2)
+    z = x @ w
+    want = np.exp(z - z.max(-1, keepdims=True))
+    want = want / want.sum(-1, keepdims=True)
+    assert np.allclose(got, want, atol=1e-5)
+
+
+def test_feeds_bind_by_name_not_node_order():
+    """Placeholders listed AFTER their consumer in the GraphDef (legal
+    — node order is not topo order) must still get the right feeds."""
+    gd = graphdef([
+        node("out", "Sub", ["b", "a"]),
+        placeholder("a"),
+        placeholder("b"),
+    ])
+    net = Net.load_tf(gd)
+    assert net.input_names == ["a", "b"]
+    a = np.full((2,), 10.0, np.float32)
+    b = np.full((2,), 1.0, np.float32)
+    assert np.allclose(net.predict(a, b), b - a)  # -9, not +9
+
+
+def test_bfloat16_and_half_val_consts():
+    import ml_dtypes
+
+    from tests.tf_graphdef_builder import (
+        _len_delim,
+        _tag,
+        _varint,
+        attr_type,
+    )
+
+    bf = np.asarray([1.0, -2.5, 0.375], ml_dtypes.bfloat16)
+    gd_nodes = [placeholder("x"), const("w", bf),
+                node("y", "Mul", ["x", "w"])]
+    net = Net.load_tf(graphdef(gd_nodes))
+    x = np.ones(3, np.float32)
+    assert np.allclose(net.predict(x), [1.0, -2.5, 0.375])
+
+    # half_val encoding (field 13 bit patterns) instead of
+    # tensor_content — hand-build the tensor proto
+    fp16 = np.asarray([1.5, -0.25], np.float16)
+    bits = fp16.view(np.uint16)
+    tensor = (_tag(1, 0) + _varint(19)            # dtype DT_HALF
+              + _len_delim(2, _len_delim(2, _tag(1, 0) + _varint(2)))
+              + b"".join(_tag(13, 0) + _varint(int(b)) for b in bits))
+    attr = _len_delim(8, tensor)
+    entry = _len_delim(1, b"value") + _len_delim(2, attr)
+    cnode = (_len_delim(1, b"h") + _len_delim(2, b"Const")
+             + _len_delim(5, entry))
+    gd = graphdef([placeholder("x"), cnode, node("y", "Mul", ["x", "h"])])
+    net = Net.load_tf(gd)
+    got = net.predict(np.ones(2, np.float32))
+    assert np.allclose(got, [1.5, -0.25])
+
+
+def test_deep_graph_no_recursion_limit():
+    """Production frozen graphs chain >1000 nodes; the topo sort must
+    not hit Python's recursion limit."""
+    nodes = [placeholder("x"), const("one", np.float32(1.0))]
+    prev = "x"
+    for i in range(1500):
+        nodes.append(node(f"a{i}", "AddV2", [prev, "one"]))
+        prev = f"a{i}"
+    net = Net.load_tf(graphdef(nodes))
+    got = net.predict(np.zeros((2,), np.float32))
+    assert np.allclose(got, 1500.0)

@@ -1,0 +1,119 @@
+"""Test-side GraphDef *encoder* — builds real protobuf wire-format
+frozen graphs without tensorflow, so the importer
+(`pipeline/tf_graph.py`) is tested against the actual `.pb` byte
+format (not a mock of its own parser)."""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+# the repo's shared wire-format primitives (utils/tf_example.py) — the
+# builder only adds two's-complement wrapping for negative varints
+from analytics_zoo_tpu.utils.tf_example import (
+    _len_delim,
+    _tag,
+    _varint as _uvarint,
+)
+
+import ml_dtypes
+
+_NP_TO_DT = {np.dtype("float32"): 1, np.dtype("float64"): 2,
+             np.dtype("int32"): 3, np.dtype("int64"): 9,
+             np.dtype("bool"): 10, np.dtype(ml_dtypes.bfloat16): 14,
+             np.dtype("float16"): 19}
+
+
+def _varint(v: int) -> bytes:
+    return _uvarint(v + (1 << 64) if v < 0 else v)
+
+
+def _enc_shape(shape: Sequence[int]) -> bytes:
+    out = b""
+    for d in shape:
+        out += _len_delim(2, _tag(1, 0) + _varint(int(d)))
+    return out
+
+
+def _enc_tensor(arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr)
+    out = _tag(1, 0) + _varint(_NP_TO_DT[arr.dtype])
+    out += _len_delim(2, _enc_shape(arr.shape))
+    out += _len_delim(4, arr.tobytes())     # tensor_content
+    return out
+
+
+def attr_tensor(arr) -> Dict[str, Any]:
+    return {"tensor": np.asarray(arr)}
+
+
+def attr_type(np_dtype) -> Dict[str, Any]:
+    return {"type": _NP_TO_DT[np.dtype(np_dtype)]}
+
+
+def attr_s(s: str) -> Dict[str, Any]:
+    return {"s": s}
+
+
+def attr_i(v: int) -> Dict[str, Any]:
+    return {"i": v}
+
+
+def attr_f(v: float) -> Dict[str, Any]:
+    return {"f": v}
+
+
+def attr_b(v: bool) -> Dict[str, Any]:
+    return {"b": v}
+
+
+def attr_ints(vals: Sequence[int]) -> Dict[str, Any]:
+    return {"list_i": list(vals)}
+
+
+def _enc_attr(attr: Dict[str, Any]) -> bytes:
+    out = b""
+    if "s" in attr:
+        out += _len_delim(2, attr["s"].encode())
+    if "i" in attr:
+        out += _tag(3, 0) + _varint(attr["i"])
+    if "f" in attr:
+        out += _tag(4, 5) + struct.pack("<f", attr["f"])
+    if "b" in attr:
+        out += _tag(5, 0) + _varint(int(attr["b"]))
+    if "type" in attr:
+        out += _tag(6, 0) + _varint(attr["type"])
+    if "tensor" in attr:
+        out += _len_delim(8, _enc_tensor(attr["tensor"]))
+    if "list_i" in attr:
+        lst = b"".join(_tag(3, 0) + _varint(v) for v in attr["list_i"])
+        out += _len_delim(1, lst)
+    return out
+
+
+def node(name: str, op: str, inputs: Sequence[str] = (),
+         attrs: Optional[Dict[str, Dict[str, Any]]] = None) -> bytes:
+    out = _len_delim(1, name.encode()) + _len_delim(2, op.encode())
+    for i in inputs:
+        out += _len_delim(3, i.encode())
+    for key, attr in (attrs or {}).items():
+        entry = _len_delim(1, key.encode()) + _len_delim(
+            2, _enc_attr(attr))
+        out += _len_delim(5, entry)
+    return out
+
+
+def graphdef(nodes: List[bytes]) -> bytes:
+    return b"".join(_len_delim(1, n) for n in nodes)
+
+
+def const(name: str, arr) -> bytes:
+    arr = np.asarray(arr)
+    return node(name, "Const", attrs={"value": attr_tensor(arr),
+                                      "dtype": attr_type(arr.dtype)})
+
+
+def placeholder(name: str, np_dtype=np.float32) -> bytes:
+    return node(name, "Placeholder", attrs={"dtype": attr_type(np_dtype)})
