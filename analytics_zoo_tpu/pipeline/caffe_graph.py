@@ -1,0 +1,440 @@
+"""Caffe `.caffemodel` importer.
+
+Reference: `models/caffe/{CaffeLoader,Converter,LayerConverter}.scala` —
+BigDL-backed conversion of Caffe nets (Convolution, InnerProduct,
+Pooling, LRN, BatchNorm+Scale, Eltwise, Concat, activations) — and
+`Net.load_caffe` (`pipeline/api/net/net_load.py`).
+
+TPU-native design: the binary NetParameter protobuf is decoded with the
+repo's shared wire-format reader (utils/tf_example.py) — no caffe, no
+protoc.  Layer semantics execute as ONE jittable jax function in NHWC
+(kernels are transposed OIHW→HWIO at load; InnerProduct restores
+Caffe's CHW flatten order before the matmul so trained weights stay
+bit-meaningful).  Caffe's ceil-mode pooling arithmetic is reproduced
+exactly — that off-by-one is where naive converters silently diverge.
+
+Scope: modern `layer` (LayerParameter) caffemodels.  Pre-2015
+V1LayerParameter nets raise with upgrade guidance (the reference's
+V1LayerConverter handled them via BigDL; upgrading the binary with
+caffe's own `upgrade_net_proto_binary` is the portable route).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.utils.tf_example import (
+    packed_floats as _packed_floats,
+    packed_ints as _packed_ints,
+    to_signed,
+    walk_fields,
+)
+
+
+def _parse_blob(buf: bytes) -> np.ndarray:
+    shape: List[int] = []
+    data: List[float] = []
+    legacy = {}
+    for fnum, wt, val in walk_fields(buf):
+        if fnum == 7:     # BlobShape
+            for f2, wt2, v2 in walk_fields(val):
+                if f2 == 1:
+                    shape.extend(_packed_ints(v2, wt2))
+        elif fnum == 5:   # data (packed float)
+            data.extend(_packed_floats(val, wt))
+        elif fnum == 9:   # double_data
+            if wt == 2:
+                data.extend(np.frombuffer(val, "<f8").tolist())
+        elif fnum in (1, 2, 3, 4):  # legacy num/channels/height/width
+            legacy[fnum] = val
+    if not shape and legacy:
+        shape = [legacy.get(k, 1) for k in (1, 2, 3, 4)]
+    arr = np.asarray(data, np.float32)
+    return arr.reshape(shape) if shape else arr
+
+
+def _parse_params(buf: bytes, spec: Dict[int, str]) -> Dict[str, Any]:
+    """Decode a *Parameter submessage given {field: name} with repeated
+    numeric fields accumulated into lists."""
+    out: Dict[str, Any] = {}
+    for fnum, wt, val in walk_fields(buf):
+        name = spec.get(fnum)
+        if name is None:
+            continue
+        if name.endswith("_f"):        # float scalar
+            out[name] = float(np.frombuffer(val, "<f4")[0])
+        elif name.endswith("_lf"):     # repeated float
+            out.setdefault(name, []).extend(_packed_floats(val, wt))
+        elif name.endswith("_l"):      # repeated int
+            out.setdefault(name, []).extend(_packed_ints(val, wt))
+        else:                          # int/bool/enum scalar
+            out[name] = to_signed(val) if isinstance(val, int) else val
+    return out
+
+
+_CONV_SPEC = {1: "num_output", 2: "bias_term", 3: "pad_l",
+              4: "kernel_l", 5: "group", 6: "stride_l",
+              9: "pad_h", 10: "pad_w", 11: "kernel_h", 12: "kernel_w",
+              13: "stride_h", 14: "stride_w", 18: "dilation_l"}
+_POOL_SPEC = {1: "pool", 2: "kernel_size", 3: "stride", 4: "pad",
+              5: "kernel_h", 6: "kernel_w", 7: "stride_h",
+              8: "stride_w", 9: "pad_h", 10: "pad_w",
+              12: "global_pooling"}
+_IP_SPEC = {1: "num_output", 2: "bias_term", 5: "axis", 6: "transpose"}
+_LRN_SPEC = {1: "local_size", 2: "alpha_f", 3: "beta_f",
+             4: "norm_region", 5: "k_f"}
+_BN_SPEC = {1: "use_global_stats", 2: "maf_f", 3: "eps_f"}
+_SCALE_SPEC = {1: "axis", 2: "num_axes", 4: "bias_term"}
+_ELTWISE_SPEC = {1: "operation", 2: "coeff_lf"}
+_CONCAT_SPEC = {1: "concat_dim", 2: "axis"}
+_POWER_SPEC = {1: "power_f", 2: "scale_f", 3: "shift_f"}
+
+_PARAM_FIELDS = {104: ("concat", _CONCAT_SPEC),
+                 106: ("conv", _CONV_SPEC),
+                 110: ("eltwise", _ELTWISE_SPEC),
+                 117: ("ip", _IP_SPEC),
+                 118: ("lrn", _LRN_SPEC),
+                 121: ("pool", _POOL_SPEC),
+                 122: ("power", _POWER_SPEC),
+                 139: ("bn", _BN_SPEC),
+                 142: ("scale", _SCALE_SPEC)}
+
+
+def _parse_layer(buf: bytes) -> Dict[str, Any]:
+    layer = {"name": "", "type": "", "bottoms": [], "tops": [],
+             "blobs": [], "params": {}, "phase": None}
+    for fnum, wt, val in walk_fields(buf):
+        if fnum == 1:
+            layer["name"] = val.decode()
+        elif fnum == 2:
+            layer["type"] = val.decode()
+        elif fnum == 3:
+            layer["bottoms"].append(val.decode())
+        elif fnum == 4:
+            layer["tops"].append(val.decode())
+        elif fnum == 7:
+            layer["blobs"].append(_parse_blob(val))
+        elif fnum == 8:   # include: NetStateRule { phase = 1 }
+            for f2, _, v2 in walk_fields(val):
+                if f2 == 1:
+                    layer["phase"] = v2    # 0 TRAIN, 1 TEST
+        elif fnum in _PARAM_FIELDS:
+            key, spec = _PARAM_FIELDS[fnum]
+            layer["params"][key] = _parse_params(val, spec)
+    return layer
+
+
+def parse_caffemodel(data: bytes) -> Dict[str, Any]:
+    net = {"name": "", "inputs": [], "input_shapes": [], "layers": []}
+    saw_v1 = False
+    for fnum, wt, val in walk_fields(data):
+        if fnum == 1:
+            net["name"] = val.decode()
+        elif fnum == 2:
+            saw_v1 = True
+        elif fnum == 3:
+            net["inputs"].append(val.decode())
+        elif fnum == 8:   # input_shape: BlobShape
+            dims = []
+            for f2, wt2, v2 in walk_fields(val):
+                if f2 == 1:
+                    dims.extend(_packed_ints(v2, wt2))
+            net["input_shapes"].append(dims)
+        elif fnum == 100:
+            net["layers"].append(_parse_layer(val))
+    if saw_v1 and not net["layers"]:
+        raise NotImplementedError(
+            "V1LayerParameter caffemodel (pre-2015): upgrade it with "
+            "caffe's upgrade_net_proto_binary, or convert to ONNX and "
+            "use Net.load_onnx")
+    return net
+
+
+# ---------------------------------------------------------------------
+# execution (NHWC internally; Caffe I/O stays NCHW)
+# ---------------------------------------------------------------------
+
+
+def _conv_geometry(p, key_h, key_w, key_l, default):
+    h = p.get(key_h)
+    w = p.get(key_w)
+    if h is not None or w is not None:
+        return int(h or default), int(w or default)
+    lst = p.get(key_l) or []
+    if len(lst) == 0:
+        return default, default
+    if len(lst) == 1:
+        return int(lst[0]), int(lst[0])
+    return int(lst[0]), int(lst[1])
+
+
+def _ceil_pool_pads(h, w, kh, kw, sh, sw, ph, pw):
+    """Caffe pooling output = ceil((X + 2p - k)/s) + 1 — reproduce by
+    right/bottom-extending the padded input so VALID pooling with the
+    same strides lands on exactly that many windows."""
+    oh = int(math.ceil((h + 2 * ph - kh) / sh)) + 1
+    ow = int(math.ceil((w + 2 * pw - kw) / sw)) + 1
+    # caffe clips windows that start inside the padding on the far side
+    if ph > 0 and (oh - 1) * sh >= h + ph:
+        oh -= 1
+    if pw > 0 and (ow - 1) * sw >= w + pw:
+        ow -= 1
+    eh = (oh - 1) * sh + kh - (h + ph)   # extra beyond the symmetric pad
+    ew = (ow - 1) * sw + kw - (w + pw)
+    return (ph, max(eh, ph)), (pw, max(ew, pw))
+
+
+class CaffeNet:
+    """A Caffe net as a pure jax function.  `predict(*arrays)` takes
+    Caffe-layout NCHW inputs and returns NCHW/2-D outputs (transposes
+    happen at the boundary; compute is NHWC inside)."""
+
+    def __init__(self, net: Dict[str, Any],
+                 outputs: Optional[Sequence[str]] = None):
+        self.net = net
+        # runnable layers: skip TRAIN-only and data/loss bookkeeping
+        self.layers = [
+            ly for ly in net["layers"]
+            if ly["phase"] != 0 and ly["type"] not in (
+                "Data", "ImageData", "HDF5Data", "Accuracy", "Silence")]
+        self.input_names = list(net["inputs"]) + [
+            ly["tops"][0] for ly in self.layers if ly["type"] == "Input"]
+        produced = {t for ly in self.layers for t in ly["tops"]}
+        consumed = {b for ly in self.layers for b in ly["bottoms"]}
+        if outputs is None:
+            outputs = [t for t in produced
+                       if t not in consumed and t not in self.input_names]
+            if not outputs and self.layers:
+                # every top is also consumed — happens when the net
+                # ends in an IN-PLACE layer (top == bottom, e.g. a
+                # trailing ReLU); the last layer's top is the output
+                outputs = [self.layers[-1]["tops"][0]]
+        self.output_names = list(outputs)
+        self._jitted = None
+
+    # -- per-layer semantics ------------------------------------------
+
+    def _eval(self, *feeds):
+        import jax
+        import jax.numpy as jnp
+
+        def to_nhwc(x):
+            return jnp.transpose(x, (0, 2, 3, 1)) if x.ndim == 4 else x
+
+        env: Dict[str, Any] = {
+            name: to_nhwc(x)
+            for name, x in zip(self.input_names, feeds)}
+
+        for ly in self.layers:
+            typ, p, blobs = ly["type"], ly["params"], ly["blobs"]
+            ins = [env[b] for b in ly["bottoms"]]
+            x = ins[0] if ins else None
+            if typ == "Input":
+                continue
+            elif typ == "Deconvolution":
+                # caffe deconv blobs are [C_in, C_out/g, kh, kw] with
+                # transposed-conv geometry — misdeclaring either gives
+                # silently wrong outputs, so refuse rather than guess
+                raise NotImplementedError(
+                    "Caffe Deconvolution import is not supported; "
+                    "convert the model to ONNX (ConvTranspose) and use "
+                    "Net.load_onnx")
+            elif typ == "Convolution":
+                cp = p.get("conv", {})
+                kh, kw = _conv_geometry(cp, "kernel_h", "kernel_w",
+                                        "kernel_l", 3)
+                sh, sw = _conv_geometry(cp, "stride_h", "stride_w",
+                                        "stride_l", 1)
+                ph, pw = _conv_geometry(cp, "pad_h", "pad_w", "pad_l", 0)
+                dil = cp.get("dilation_l") or [1]
+                groups = int(cp.get("group", 1))
+                n_out = int(cp["num_output"])
+                cin = x.shape[-1] // groups
+                w = jnp.asarray(blobs[0].reshape(n_out, cin, kh, kw)
+                                .transpose(2, 3, 1, 0))   # OIHW -> HWIO
+                out = jax.lax.conv_general_dilated(
+                    x, w, window_strides=(sh, sw),
+                    padding=[(ph, ph), (pw, pw)],
+                    rhs_dilation=(int(dil[0]),) * 2,
+                    feature_group_count=groups,
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                if int(cp.get("bias_term", 1)) and len(blobs) > 1:
+                    out = out + jnp.asarray(blobs[1]).reshape(-1)
+            elif typ in ("InnerProduct",):
+                ip = p.get("ip", {})
+                w = np.asarray(blobs[0])      # [n_out, n_in]
+                if x.ndim == 4:
+                    # restore Caffe's CHW flatten order
+                    x2 = jnp.transpose(x, (0, 3, 1, 2)).reshape(
+                        x.shape[0], -1)
+                else:
+                    x2 = x.reshape(x.shape[0], -1)
+                w2 = jnp.asarray(w.reshape(w.shape[0], -1))
+                out = x2 @ (w2 if int(ip.get("transpose", 0)) else w2.T)
+                if int(ip.get("bias_term", 1)) and len(blobs) > 1:
+                    out = out + jnp.asarray(blobs[1]).reshape(-1)
+            elif typ == "Pooling":
+                pp = p.get("pool", {})
+                if int(pp.get("global_pooling", 0)):
+                    out = (jnp.max(x, axis=(1, 2))
+                           if int(pp.get("pool", 0)) == 0
+                           else jnp.mean(x, axis=(1, 2)))
+                    out = out[:, None, None, :]
+                else:
+                    kh, kw = _conv_geometry(pp, "kernel_h", "kernel_w",
+                                            None, int(pp.get(
+                                                "kernel_size", 2)))
+                    sh, sw = _conv_geometry(pp, "stride_h", "stride_w",
+                                            None, int(pp.get("stride",
+                                                             1)))
+                    ph, pw = _conv_geometry(pp, "pad_h", "pad_w", None,
+                                            int(pp.get("pad", 0)))
+                    (pt, pb), (pl, pr) = _ceil_pool_pads(
+                        x.shape[1], x.shape[2], kh, kw, sh, sw, ph, pw)
+                    if int(pp.get("pool", 0)) == 0:   # MAX
+                        xp = jnp.pad(x, [(0, 0), (pt, pb), (pl, pr),
+                                         (0, 0)],
+                                     constant_values=-np.inf)
+                        out = jax.lax.reduce_window(
+                            xp, -jnp.inf, jax.lax.max,
+                            (1, kh, kw, 1), (1, sh, sw, 1), "VALID")
+                    else:                              # AVE
+                        xp = jnp.pad(x, [(0, 0), (pt, pb), (pl, pr),
+                                         (0, 0)])
+                        s = jax.lax.reduce_window(
+                            xp, 0.0, jax.lax.add, (1, kh, kw, 1),
+                            (1, sh, sw, 1), "VALID")
+                        # caffe divides by the FULL window size
+                        out = s / (kh * kw)
+            elif typ == "ReLU":
+                out = jax.nn.relu(x)
+            elif typ == "PReLU":
+                out = jnp.where(x >= 0, x,
+                                jnp.asarray(blobs[0]).reshape(-1) * x)
+            elif typ == "ELU":
+                out = jax.nn.elu(x)
+            elif typ == "Sigmoid":
+                out = jax.nn.sigmoid(x)
+            elif typ == "TanH":
+                out = jnp.tanh(x)
+            elif typ == "AbsVal":
+                out = jnp.abs(x)
+            elif typ == "Log":
+                out = jnp.log(x)
+            elif typ == "Exp":
+                out = jnp.exp(x)
+            elif typ == "Power":
+                pw_ = p.get("power", {})
+                out = (pw_.get("shift_f", 0.0)
+                       + pw_.get("scale_f", 1.0) * x) \
+                    ** pw_.get("power_f", 1.0)
+            elif typ in ("Softmax", "SoftmaxWithLoss"):
+                # NHWC: caffe softmaxes over channels (axis 1 in NCHW)
+                out = jax.nn.softmax(x, axis=-1)
+            elif typ == "Dropout":
+                out = x                     # inference = identity
+            elif typ == "LRN":
+                lp = p.get("lrn", {})
+                n = int(lp.get("local_size", 5))
+                alpha = lp.get("alpha_f", 1.0)
+                beta = lp.get("beta_f", 0.75)
+                k = lp.get("k_f", 1.0)
+                sq = jnp.square(x)
+                if int(lp.get("norm_region", 0)) == 0:  # ACROSS_CHANNELS
+                    win = (1,) * (x.ndim - 1) + (n,)
+                else:                                   # WITHIN_CHANNEL
+                    win = (1, n, n, 1)
+                s = jax.lax.reduce_window(sq, 0.0, jax.lax.add, win,
+                                          (1,) * x.ndim, "SAME")
+                out = x / jnp.power(k + alpha / (n * n if win[1] == n
+                                                 else n) * s, beta)
+            elif typ == "BatchNorm":
+                eps = p.get("bn", {}).get("eps_f", 1e-5)
+                mean, var, sf = (np.asarray(b).reshape(-1)
+                                 for b in blobs[:3])
+                scale = 1.0 / sf[0] if sf.size and sf[0] != 0 else 1.0
+                out = (x - mean * scale) * jax.lax.rsqrt(
+                    jnp.asarray(var * scale) + eps)
+            elif typ == "Scale":
+                gamma = jnp.asarray(blobs[0]).reshape(-1)
+                out = x * gamma
+                if int(p.get("scale", {}).get("bias_term", 0)) \
+                        and len(blobs) > 1:
+                    out = out + jnp.asarray(blobs[1]).reshape(-1)
+            elif typ == "Eltwise":
+                ep = p.get("eltwise", {})
+                operation = int(ep.get("operation", 1))
+                if operation == 0:      # PROD
+                    out = ins[0]
+                    for y in ins[1:]:
+                        out = out * y
+                elif operation == 2:    # MAX
+                    out = ins[0]
+                    for y in ins[1:]:
+                        out = jnp.maximum(out, y)
+                else:                   # SUM (with optional coeffs)
+                    coeff = ep.get("coeff_lf") or [1.0] * len(ins)
+                    out = coeff[0] * ins[0]
+                    for c, y in zip(coeff[1:], ins[1:]):
+                        out = out + c * y
+            elif typ == "Concat":
+                cp = p.get("concat", {})
+                axis = int(cp.get("axis", cp.get("concat_dim", 1)))
+                if ins[0].ndim == 4:
+                    axis = {0: 0, 1: 3, 2: 1, 3: 2}[axis]  # NCHW->NHWC
+                out = jnp.concatenate(ins, axis=axis)
+            elif typ == "Flatten":
+                if x.ndim == 4:   # CHW order, like InnerProduct
+                    x = jnp.transpose(x, (0, 3, 1, 2))
+                out = x.reshape(x.shape[0], -1)
+            else:
+                raise NotImplementedError(
+                    f"Caffe layer type '{typ}' (layer '{ly['name']}') "
+                    "is not supported by the importer")
+            env[ly["tops"][0]] = out
+
+        def from_nhwc(x):
+            return jnp.transpose(x, (0, 3, 1, 2)) if x.ndim == 4 else x
+
+        outs = [from_nhwc(env[name]) for name in self.output_names]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def predict(self, *feeds):
+        import jax
+
+        if len(feeds) != len(self.input_names):
+            raise ValueError(
+                f"net has {len(self.input_names)} inputs "
+                f"{self.input_names}, got {len(feeds)}")
+        if self._jitted is None:
+            self._jitted = jax.jit(self._eval)
+        out = self._jitted(*feeds)
+        if isinstance(out, tuple):
+            return tuple(np.asarray(o) for o in out)
+        return np.asarray(out)
+
+    __call__ = predict
+
+
+def load_caffe(def_path: Optional[str], model_path_or_bytes,
+               outputs: Optional[Sequence[str]] = None) -> CaffeNet:
+    """Load a Caffe model (reference Net.load_caffe(defPath,
+    modelPath)).  The binary caffemodel carries both topology and
+    weights; `def_path` (deploy prototxt) is consulted only for the
+    `input:`/`input_dim:` declaration when the binary lacks one."""
+    if isinstance(model_path_or_bytes, (bytes, bytearray)):
+        data = bytes(model_path_or_bytes)
+    else:
+        with open(model_path_or_bytes, "rb") as f:
+            data = f.read()
+    net = parse_caffemodel(data)
+    if not net["inputs"] and def_path:
+        with open(def_path) as f:
+            txt = f.read()
+        net["inputs"] = re.findall(r'^\s*input\s*:\s*"([^"]+)"', txt,
+                                   re.M)
+    return CaffeNet(net, outputs=outputs)

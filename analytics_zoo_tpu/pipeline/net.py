@@ -45,10 +45,15 @@ class Net:
             "hosts; export the model to ONNX and use Net.load_onnx")
 
     @staticmethod
-    def load_caffe(def_path: str, model_path: str):
-        raise NotImplementedError(
-            "Caffe import is not supported; convert to ONNX and use "
-            "Net.load_onnx")
+    def load_caffe(def_path: str, model_path: str, outputs=None):
+        """Load a Caffe model for inference (reference
+        Net.load_caffe / models/caffe/CaffeLoader.scala).  The binary
+        caffemodel protobuf (topology + weights) is decoded by the
+        shared wire reader and interpreted into one jittable jax
+        function (`pipeline/caffe_graph.py`); `def_path` is consulted
+        only for the deploy `input:` declaration."""
+        from analytics_zoo_tpu.pipeline.caffe_graph import load_caffe
+        return load_caffe(def_path, model_path, outputs=outputs)
 
     @staticmethod
     def load_tf(path: str, outputs=None):

@@ -27,7 +27,9 @@ import numpy as np
 # the shared protobuf tag-walker behind the Example/ONNX/TensorBoard
 # codecs — one wire-format implementation for the whole repo
 from analytics_zoo_tpu.utils.tf_example import (
-    _read_varint,
+    packed_bools,
+    packed_floats,
+    packed_ints,
     to_signed,
     walk_fields as _fields,
 )
@@ -70,41 +72,20 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
         elif fnum == 4:
             content = val
         elif fnum == 5:   # float_val (packed or repeated)
-            if wt == 2:
-                f32s.extend(np.frombuffer(val, "<f4").tolist())
-            else:
-                f32s.append(np.frombuffer(val, "<f4")[0])
+            f32s.extend(packed_floats(val, wt))
         elif fnum == 6:
             if wt == 2:
                 f64s.extend(np.frombuffer(val, "<f8").tolist())
             else:
                 f64s.append(np.frombuffer(val, "<f8")[0])
         elif fnum == 7:   # int_val
-            if wt == 2:
-                j = 0
-                while j < len(val):
-                    v, j = _read_varint(val, j)
-                    i32s.append(to_signed(v))
-            else:
-                i32s.append(to_signed(val))
+            i32s.extend(packed_ints(val, wt))
         elif fnum == 10:  # int64_val
-            if wt == 2:
-                j = 0
-                while j < len(val):
-                    v, j = _read_varint(val, j)
-                    i64s.append(to_signed(v))
-            else:
-                i64s.append(to_signed(val))
+            i64s.extend(packed_ints(val, wt))
         elif fnum == 11:  # bool_val
-            bools.append(bool(val))
+            bools.extend(packed_bools(val, wt))
         elif fnum == 13:  # half_val: fp16/bf16 bit patterns as int32s
-            if wt == 2:
-                j = 0
-                while j < len(val):
-                    v, j = _read_varint(val, j)
-                    halves.append(v)
-            else:
-                halves.append(val)
+            halves.extend(packed_ints(val, wt))
     dt = _DTYPES.get(dtype_num)
     if dt is None:
         raise NotImplementedError(f"tensor dtype enum {dtype_num}")
@@ -149,17 +130,11 @@ def _parse_attr(buf: bytes) -> Dict[str, Any]:
                 if f2 == 2:
                     lst["s"].append(v2.decode())
                 elif f2 == 3:
-                    if wt2 == 2:   # packed
-                        j = 0
-                        while j < len(v2):
-                            x, j = _read_varint(v2, j)
-                            lst["i"].append(to_signed(x))
-                    else:
-                        lst["i"].append(to_signed(v2))
+                    lst["i"].extend(packed_ints(v2, wt2))
                 elif f2 == 4:
-                    lst["f"].append(float(np.frombuffer(v2, "<f4")[0]))
+                    lst["f"].extend(packed_floats(v2, wt2))
                 elif f2 == 5:
-                    lst["b"].append(bool(v2))
+                    lst["b"].extend(packed_bools(v2, wt2))
             out["list"] = lst
     return out
 

@@ -197,3 +197,31 @@ def decode_example(data: bytes) -> Dict[str, List]:
             else:
                 out[name] = []
     return out
+
+
+def packed_ints(val, wire) -> list:
+    """Repeated signed varint field: handles both packed (wire 2) and
+    unpacked (wire 0) encodings — shared by the GraphDef/caffemodel
+    parsers."""
+    if wire == 2:
+        out, pos = [], 0
+        while pos < len(val):
+            v, pos = _read_varint(val, pos)
+            out.append(to_signed(v))
+        return out
+    return [to_signed(val)]
+
+
+def packed_floats(val, wire) -> list:
+    """Repeated float32 field, packed or single fixed32 value."""
+    import numpy as np
+
+    return np.frombuffer(val, "<f4").tolist() if wire == 2 else [
+        float(np.frombuffer(val, "<f4")[0])]
+
+
+def packed_bools(val, wire) -> list:
+    """Repeated bool field: packed chunks are one varint per element."""
+    if wire == 2:
+        return [bool(b) for b in val]
+    return [bool(val)]

@@ -1,0 +1,210 @@
+"""Caffe caffemodel importer (reference models/caffe/Converter.scala +
+Net.load_caffe).  Models are built as REAL protobuf wire bytes by an
+in-test encoder, then imported and checked against numpy math —
+including Caffe's ceil-mode pooling arithmetic."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.net import Net
+from analytics_zoo_tpu.utils.tf_example import (
+    _len_delim,
+    _tag,
+    _varint,
+)
+
+# ---- caffemodel wire encoder (NetParameter subset) -------------------
+
+
+def _blob(arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr, np.float32)
+    shape = b"".join(_tag(1, 0) + _varint(d) for d in arr.shape)
+    return (_len_delim(7, shape)
+            + _len_delim(5, arr.astype("<f4").tobytes()))
+
+
+def _params(spec_field: int, fields: dict) -> bytes:
+    out = b""
+    for fnum, v in fields.items():
+        if isinstance(v, float):
+            out += _tag(fnum, 5) + np.float32(v).tobytes()
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                out += _tag(fnum, 0) + _varint(int(x))
+        else:
+            out += _tag(fnum, 0) + _varint(int(v))
+    return _len_delim(spec_field, out)
+
+
+def layer(name: str, typ: str, bottoms, tops, blobs=(),
+          params: bytes = b"", phase=None) -> bytes:
+    out = _len_delim(1, name.encode()) + _len_delim(2, typ.encode())
+    for b in bottoms:
+        out += _len_delim(3, b.encode())
+    for t in tops:
+        out += _len_delim(4, t.encode())
+    for b in blobs:
+        out += _len_delim(7, _blob(b))
+    if phase is not None:
+        out += _len_delim(8, _tag(1, 0) + _varint(phase))
+    out += params
+    return _len_delim(100, out)
+
+
+def netparam(layers, inputs=()) -> bytes:
+    out = _len_delim(1, b"testnet")
+    for i in inputs:
+        out += _len_delim(3, i.encode())
+    return out + b"".join(layers)
+
+
+# ---- tests -----------------------------------------------------------
+
+
+def test_conv_relu_ip_softmax():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)  # NCHW
+    k = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)  # OIHW
+    kb = rng.normal(size=(4,)).astype(np.float32)
+    w = rng.normal(size=(5, 4 * 8 * 8)).astype(np.float32)
+    wb = rng.normal(size=(5,)).astype(np.float32)
+    net = Net.load_caffe(None, netparam([
+        layer("conv", "Convolution", ["data"], ["c1"], [k, kb],
+              _params(106, {1: 4, 4: [3], 3: [1]})),   # pad 1
+        layer("relu", "ReLU", ["c1"], ["c1"]),          # in-place
+        layer("fc", "InnerProduct", ["c1"], ["fc"], [w, wb],
+              _params(117, {1: 5})),
+        layer("prob", "Softmax", ["fc"], ["prob"]),
+    ], inputs=["data"]))
+    assert net.input_names == ["data"]
+    got = net.predict(x)
+    # numpy reference (NCHW)
+    pad = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+    conv = np.zeros((2, 4, 8, 8), np.float32)
+    for o in range(4):
+        for i in range(3):
+            for dy in range(3):
+                for dx in range(3):
+                    conv[:, o] += pad[:, i, dy:dy + 8, dx:dx + 8] \
+                        * k[o, i, dy, dx]
+    conv = np.maximum(conv + kb[None, :, None, None], 0)
+    fc = conv.reshape(2, -1) @ w.T + wb
+    want = np.exp(fc - fc.max(-1, keepdims=True))
+    want = want / want.sum(-1, keepdims=True)
+    assert np.allclose(got, want, atol=1e-3)
+
+
+def test_ceil_mode_pooling():
+    """Caffe pooling output is ceil((H+2p-k)/s)+1: H=5,k=2,s=2 gives
+    ceil(3/2)+1 = 3 (torch/tf floor would give 2)."""
+    x = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+    net = Net.load_caffe(None, netparam([
+        layer("pool", "Pooling", ["data"], ["p"], [],
+              _params(121, {1: 0, 2: 2, 3: 2})),   # MAX k=2 s=2
+    ], inputs=["data"]))
+    got = net.predict(x)
+    assert got.shape == (1, 1, 3, 3)
+    want = np.array([[6, 8, 9], [16, 18, 19], [21, 23, 24]],
+                    np.float32)
+    assert np.allclose(got[0, 0], want)
+    # AVE divides by the full window even at the clipped edge
+    net = Net.load_caffe(None, netparam([
+        layer("pool", "Pooling", ["data"], ["p"], [],
+              _params(121, {1: 1, 2: 2, 3: 2})),
+    ], inputs=["data"]))
+    ave = net.predict(x)
+    assert ave.shape == (1, 1, 3, 3)
+    assert np.isclose(ave[0, 0, 0, 0], (0 + 1 + 5 + 6) / 4)
+    assert np.isclose(ave[0, 0, 2, 2], 24 / 4)   # 1 value / 4
+
+
+def test_batchnorm_scale_eltwise_concat():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 3, 4, 4)).astype(np.float32)
+    mean = rng.normal(size=3).astype(np.float32)
+    var = rng.uniform(0.5, 2.0, 3).astype(np.float32)
+    sf = np.array([2.0], np.float32)   # scale factor blob
+    gamma = rng.uniform(0.5, 1.5, 3).astype(np.float32)
+    beta = rng.normal(size=3).astype(np.float32)
+    net = Net.load_caffe(None, netparam([
+        layer("bn", "BatchNorm", ["data"], ["bn"], [mean, var, sf],
+              _params(139, {3: 1e-5})),
+        layer("sc", "Scale", ["bn"], ["sc"], [gamma, beta],
+              _params(142, {4: 1})),
+        layer("sum", "Eltwise", ["sc", "data"], ["sum"], [],
+              _params(110, {1: 1})),
+        layer("cat", "Concat", ["sum", "data"], ["cat"], [],
+              _params(104, {2: 1})),
+    ], inputs=["data"]))
+    got = net.predict(x)
+    m, v = mean / 2.0, var / 2.0
+    bn = (x - m[None, :, None, None]) / np.sqrt(
+        v[None, :, None, None] + 1e-5)
+    sc = bn * gamma[None, :, None, None] + beta[None, :, None, None]
+    want = np.concatenate([sc + x, x], axis=1)
+    assert got.shape == (2, 6, 4, 4)
+    assert np.allclose(got, want, atol=1e-4)
+
+
+def test_lrn_across_channels_golden():
+    x = np.full((1, 1, 1, 1), 2.0, np.float32)
+    net = Net.load_caffe(None, netparam([
+        layer("lrn", "LRN", ["data"], ["l"], [],
+              _params(118, {1: 1, 2: 0.5, 3: 1.0})),  # n=1 a=.5 b=1
+    ], inputs=["data"]))
+    got = net.predict(x)
+    assert np.allclose(got, 2.0 / (1.0 + 0.5 * 4.0))
+
+
+def test_train_phase_layers_skipped_and_loss_head():
+    w = np.eye(4, dtype=np.float32)
+    net = Net.load_caffe(None, netparam([
+        layer("fc", "InnerProduct", ["data"], ["fc"], [w],
+              _params(117, {1: 4, 2: 0})),
+        layer("drop", "Dropout", ["fc"], ["fc"]),
+        layer("trainonly", "SomeTrainThing", ["fc"], ["t"], [],
+              phase=0),
+        layer("loss", "SoftmaxWithLoss", ["fc"], ["loss"]),
+    ], inputs=["data"]))
+    x = np.ones((2, 4), np.float32)
+    got = net.predict(x)
+    assert np.allclose(got, 0.25)   # softmax of equal logits
+
+
+def test_unsupported_layer_and_v1_are_loud():
+    with pytest.raises(NotImplementedError, match="Exotic"):
+        Net.load_caffe(None, netparam([
+            layer("z", "Exotic", ["data"], ["z"]),
+        ], inputs=["data"])).predict(np.ones((1, 2), np.float32))
+    # V1LayerParameter (field 2) with no modern layers
+    v1 = _len_delim(1, b"old") + _len_delim(2, b"\x00")
+    with pytest.raises(NotImplementedError, match="upgrade"):
+        Net.load_caffe(None, v1)
+
+
+def test_prototxt_input_declaration(tmp_path):
+    w = np.eye(2, dtype=np.float32) * 3.0
+    proto = tmp_path / "deploy.prototxt"
+    proto.write_text('name: "n"\ninput: "data"\n'
+                     'input_dim: 1\ninput_dim: 2\n')
+    model = netparam([
+        layer("fc", "InnerProduct", ["data"], ["fc"], [w],
+              _params(117, {1: 2, 2: 0})),
+    ])
+    net = Net.load_caffe(str(proto), model)
+    assert net.input_names == ["data"]
+    assert np.allclose(net.predict(np.ones((1, 2), np.float32)), 3.0)
+
+
+def test_inplace_terminal_layer_output():
+    """A net ending in an in-place layer (top == bottom) must still
+    produce that tensor as the default output."""
+    w = np.array([[1.0, -1.0], [-1.0, 1.0]], np.float32)
+    net = Net.load_caffe(None, netparam([
+        layer("fc", "InnerProduct", ["data"], ["fc"], [w],
+              _params(117, {1: 2, 2: 0})),
+        layer("relu", "ReLU", ["fc"], ["fc"]),   # in-place terminal
+    ], inputs=["data"]))
+    assert net.output_names == ["fc"]
+    x = np.array([[2.0, -3.0]], np.float32)
+    assert np.allclose(net.predict(x), np.maximum(x @ w.T, 0))

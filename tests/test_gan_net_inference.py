@@ -128,11 +128,11 @@ def test_net_loaders_and_graph_surgery():
     np.testing.assert_allclose(np.asarray(frozen(x)),
                                np.maximum(x @ w1.T, 0), atol=1e-5)
 
-    # JVM formats raise with the escape hatch named
+    # BigDL JVM serialization raises with the escape hatch named; TF1
+    # frozen graphs and caffemodels import natively since r4
+    # (tests/test_tf_graph_import.py, tests/test_caffe_import.py)
     with pytest.raises(NotImplementedError, match="ONNX"):
         Net.load_bigdl("x.bigdl")
-    with pytest.raises(NotImplementedError, match="ONNX"):
-        Net.load_tf("frozen.pb")
 
 
 def test_net_load_torch():

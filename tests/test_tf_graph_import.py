@@ -239,3 +239,18 @@ def test_deep_graph_no_recursion_limit():
     net = Net.load_tf(graphdef(nodes))
     got = net.predict(np.zeros((2,), np.float32))
     assert np.allclose(got, 1500.0)
+
+
+def test_shared_packed_decoders():
+    from analytics_zoo_tpu.utils.tf_example import (
+        packed_bools,
+        packed_floats,
+        packed_ints,
+    )
+
+    assert packed_bools(b"\x00\x01\x00", 2) == [False, True, False]
+    assert packed_bools(1, 0) == [True]
+    assert packed_ints(b"\x03\x7f", 2) == [3, 127]
+    assert packed_ints((1 << 64) - 2, 0) == [-2]
+    two = np.asarray([1.5, -2.0], "<f4").tobytes()
+    assert packed_floats(two, 2) == [1.5, -2.0]
