@@ -172,10 +172,11 @@ def _conv_geometry(p, key_h, key_w, key_l, default):
     return int(lst[0]), int(lst[1])
 
 
-def _ceil_pool_pads(h, w, kh, kw, sh, sw, ph, pw):
-    """Caffe pooling output = ceil((X + 2p - k)/s) + 1 — reproduce by
-    right/bottom-extending the padded input so VALID pooling with the
-    same strides lands on exactly that many windows."""
+def _ceil_pool_geometry(h, w, kh, kw, sh, sw, ph, pw):
+    """Caffe pooling output = ceil((X + 2p - k)/s) + 1 (with the
+    far-side clip); returns (oh, ow, pad_pairs) such that VALID
+    pooling over the padded input, sliced to [:oh, :ow], reproduces
+    exactly Caffe's windows."""
     oh = int(math.ceil((h + 2 * ph - kh) / sh)) + 1
     ow = int(math.ceil((w + 2 * pw - kw) / sw)) + 1
     # caffe clips windows that start inside the padding on the far side
@@ -185,7 +186,7 @@ def _ceil_pool_pads(h, w, kh, kw, sh, sw, ph, pw):
         ow -= 1
     eh = (oh - 1) * sh + kh - (h + ph)   # extra beyond the symmetric pad
     ew = (ow - 1) * sw + kw - (w + pw)
-    return (ph, max(eh, ph)), (pw, max(ew, pw))
+    return oh, ow, ((ph, max(eh, 0)), (pw, max(ew, 0)))
 
 
 class CaffeNet:
@@ -206,7 +207,9 @@ class CaffeNet:
         produced = {t for ly in self.layers for t in ly["tops"]}
         consumed = {b for ly in self.layers for b in ly["bottoms"]}
         if outputs is None:
-            outputs = [t for t in produced
+            # layer order, not set order: multi-output nets must give a
+            # deterministic output tuple across processes
+            outputs = [t for ly in self.layers for t in ly["tops"]
                        if t not in consumed and t not in self.input_names]
             if not outputs and self.layers:
                 # every top is also consumed — happens when the net
@@ -250,7 +253,7 @@ class CaffeNet:
                 sh, sw = _conv_geometry(cp, "stride_h", "stride_w",
                                         "stride_l", 1)
                 ph, pw = _conv_geometry(cp, "pad_h", "pad_w", "pad_l", 0)
-                dil = cp.get("dilation_l") or [1]
+                dh, dw = _conv_geometry(cp, None, None, "dilation_l", 1)
                 groups = int(cp.get("group", 1))
                 n_out = int(cp["num_output"])
                 cin = x.shape[-1] // groups
@@ -259,7 +262,7 @@ class CaffeNet:
                 out = jax.lax.conv_general_dilated(
                     x, w, window_strides=(sh, sw),
                     padding=[(ph, ph), (pw, pw)],
-                    rhs_dilation=(int(dil[0]),) * 2,
+                    rhs_dilation=(dh, dw),
                     feature_group_count=groups,
                     dimension_numbers=("NHWC", "HWIO", "NHWC"))
                 if int(cp.get("bias_term", 1)) and len(blobs) > 1:
@@ -293,23 +296,36 @@ class CaffeNet:
                                                              1)))
                     ph, pw = _conv_geometry(pp, "pad_h", "pad_w", None,
                                             int(pp.get("pad", 0)))
-                    (pt, pb), (pl, pr) = _ceil_pool_pads(
-                        x.shape[1], x.shape[2], kh, kw, sh, sw, ph, pw)
+                    h_in, w_in = x.shape[1], x.shape[2]
+                    oh, ow, pads = _ceil_pool_geometry(
+                        h_in, w_in, kh, kw, sh, sw, ph, pw)
+                    (pt, pb), (pl, pr) = pads
                     if int(pp.get("pool", 0)) == 0:   # MAX
                         xp = jnp.pad(x, [(0, 0), (pt, pb), (pl, pr),
                                          (0, 0)],
                                      constant_values=-np.inf)
                         out = jax.lax.reduce_window(
                             xp, -jnp.inf, jax.lax.max,
-                            (1, kh, kw, 1), (1, sh, sw, 1), "VALID")
+                            (1, kh, kw, 1), (1, sh, sw, 1),
+                            "VALID")[:, :oh, :ow]
                     else:                              # AVE
                         xp = jnp.pad(x, [(0, 0), (pt, pb), (pl, pr),
                                          (0, 0)])
                         s = jax.lax.reduce_window(
                             xp, 0.0, jax.lax.add, (1, kh, kw, 1),
-                            (1, sh, sw, 1), "VALID")
-                        # caffe divides by the FULL window size
-                        out = s / (kh * kw)
+                            (1, sh, sw, 1), "VALID")[:, :oh, :ow]
+                        # caffe's divisor is the window clipped to
+                        # [0, X + pad): zero-padding counts, the
+                        # ceil-mode far extension does not — build it
+                        # by pooling a mask that is 1 on [0, X+p)
+                        mask = np.zeros((1,) + xp.shape[1:3] + (1,),
+                                        np.float32)
+                        mask[:, :h_in + 2 * pt, :w_in + 2 * pl] = 1.0
+                        cnt = jax.lax.reduce_window(
+                            jnp.asarray(mask), 0.0, jax.lax.add,
+                            (1, kh, kw, 1), (1, sh, sw, 1),
+                            "VALID")[:, :oh, :ow]
+                        out = s / jnp.maximum(cnt, 1.0)
             elif typ == "ReLU":
                 out = jax.nn.relu(x)
             elif typ == "PReLU":
@@ -360,11 +376,28 @@ class CaffeNet:
                 out = (x - mean * scale) * jax.lax.rsqrt(
                     jnp.asarray(var * scale) + eps)
             elif typ == "Scale":
-                gamma = jnp.asarray(blobs[0]).reshape(-1)
-                out = x * gamma
-                if int(p.get("scale", {}).get("bias_term", 0)) \
-                        and len(blobs) > 1:
-                    out = out + jnp.asarray(blobs[1]).reshape(-1)
+                if len(ins) == 2:
+                    # two-bottom form: the scaler is a tensor input
+                    other = ins[1]
+                    if other.ndim == 1:     # per-channel
+                        out = x * other
+                    elif other.shape == x.shape:
+                        out = x * other
+                    else:
+                        raise NotImplementedError(
+                            f"Scale layer '{ly['name']}': two-bottom "
+                            f"broadcast {other.shape} vs {x.shape} not "
+                            "supported")
+                elif blobs:
+                    gamma = jnp.asarray(blobs[0]).reshape(-1)
+                    out = x * gamma
+                    if int(p.get("scale", {}).get("bias_term", 0)) \
+                            and len(blobs) > 1:
+                        out = out + jnp.asarray(blobs[1]).reshape(-1)
+                else:
+                    raise NotImplementedError(
+                        f"Scale layer '{ly['name']}' has neither blobs "
+                        "nor a second bottom")
             elif typ == "Eltwise":
                 ep = p.get("eltwise", {})
                 operation = int(ep.get("operation", 1))
@@ -384,6 +417,7 @@ class CaffeNet:
             elif typ == "Concat":
                 cp = p.get("concat", {})
                 axis = int(cp.get("axis", cp.get("concat_dim", 1)))
+                axis %= ins[0].ndim          # caffe allows negatives
                 if ins[0].ndim == 4:
                     axis = {0: 0, 1: 3, 2: 1, 3: 2}[axis]  # NCHW->NHWC
                 out = jnp.concatenate(ins, axis=axis)

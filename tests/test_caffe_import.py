@@ -107,7 +107,8 @@ def test_ceil_mode_pooling():
     want = np.array([[6, 8, 9], [16, 18, 19], [21, 23, 24]],
                     np.float32)
     assert np.allclose(got[0, 0], want)
-    # AVE divides by the full window even at the clipped edge
+    # AVE divides by the window CLIPPED to [0, X+pad) — caffe's
+    # pool_size = (hend-hstart)*(wend-wstart) with hend=min(.., X+pad)
     net = Net.load_caffe(None, netparam([
         layer("pool", "Pooling", ["data"], ["p"], [],
               _params(121, {1: 1, 2: 2, 3: 2})),
@@ -115,7 +116,8 @@ def test_ceil_mode_pooling():
     ave = net.predict(x)
     assert ave.shape == (1, 1, 3, 3)
     assert np.isclose(ave[0, 0, 0, 0], (0 + 1 + 5 + 6) / 4)
-    assert np.isclose(ave[0, 0, 2, 2], 24 / 4)   # 1 value / 4
+    assert np.isclose(ave[0, 0, 0, 2], (4 + 9) / 2)   # 1x2 window
+    assert np.isclose(ave[0, 0, 2, 2], 24 / 1)        # 1x1 window
 
 
 def test_batchnorm_scale_eltwise_concat():
