@@ -1,7 +1,8 @@
 """AutoXGBoost (reference: `pyzoo/zoo/orca/automl/xgboost/auto_xgb.py` —
-XGBoost + hyperparameter search over Ray Tune).  Dep-gated on the
-xgboost package; the search itself runs on the framework's parallel
-SearchEngine (thread backend: xgboost releases the GIL)."""
+XGBoost + hyperparameter search over Ray Tune).  Uses the xgboost
+package when installed, else the native histogram-GBDT backend
+(`orca/automl/gbdt.py`) with the same API subset — either way the
+search runs on the framework's parallel SearchEngine."""
 
 from __future__ import annotations
 
@@ -9,8 +10,8 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.orca.automl.gbdt import xgboost_backend
 from analytics_zoo_tpu.orca.automl.search_engine import SearchEngine
-from analytics_zoo_tpu.utils.deps import require
 
 
 _CLF_METRICS: Dict[str, tuple] = {
@@ -32,7 +33,6 @@ class _AutoXGBBase:
 
     def __init__(self, metric: Optional[str] = None,
                  metric_mode: Optional[str] = None, **fixed_params):
-        require("xgboost", "AutoXGBoost")
         metric = metric or self._default_metric
         if metric not in self._metrics:
             raise ValueError(
@@ -53,9 +53,7 @@ class _AutoXGBBase:
         ASHA rungs; each adds `rounds_per_epoch` boosting rounds via
         xgboost warm-start, so early stopping prunes cheap short models
         before the full round budget is spent."""
-        import xgboost
-
-        cls = getattr(xgboost, self._cls_attr)
+        cls = getattr(xgboost_backend(), self._cls_attr)
         x, y = (np.asarray(a) for a in data)
         vx, vy = ((np.asarray(a) for a in validation_data)
                   if validation_data is not None else (x, y))

@@ -229,12 +229,13 @@ class NNClassifierModel(NNModel):
 
 
 # ---------------------------------------------------------------------------
-# XGBoost wrappers (reference :685-780) — dep-gated like ARIMA/Prophet
+# XGBoost wrappers (reference :685-780) — the xgboost package when
+# installed, else the native histogram-GBDT backend
 # ---------------------------------------------------------------------------
 
 def _require_xgboost():
-    from analytics_zoo_tpu.utils.deps import require
-    return require("xgboost", "XGBClassifier/XGBRegressor")
+    from analytics_zoo_tpu.orca.automl.gbdt import xgboost_backend
+    return xgboost_backend()
 
 
 class _XGBBase:

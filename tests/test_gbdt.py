@@ -1,0 +1,74 @@
+"""Native histogram-GBDT backend (orca/automl/gbdt.py) — the engine
+behind XGBClassifier/XGBRegressor/AutoXGBoost when the xgboost package
+is absent (it is, in this image)."""
+
+import numpy as np
+
+from analytics_zoo_tpu.orca.automl.gbdt import (
+    GBDTClassifier,
+    GBDTRegressor,
+    xgboost_backend,
+)
+
+
+def test_backend_resolves_to_native_here():
+    be = xgboost_backend()
+    assert hasattr(be, "XGBClassifier") and hasattr(be, "XGBRegressor")
+
+
+def test_regressor_fits_nonlinear_function():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-2, 2, (2000, 5))
+    y = (np.sin(2 * x[:, 0]) + x[:, 1] ** 2 - 0.5 * x[:, 2]
+         + 0.1 * rng.normal(size=2000))
+    m = GBDTRegressor(n_estimators=60, max_depth=4,
+                      learning_rate=0.2).fit(x[:1600], y[:1600])
+    mse = float(np.mean((m.predict(x[1600:]) - y[1600:]) ** 2))
+    assert mse < 0.05 * float(np.var(y[1600:])), mse
+
+
+def test_warm_start_adds_trees_and_improves():
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-1, 1, (1000, 3))
+    y = x[:, 0] * x[:, 1] + 0.05 * rng.normal(size=1000)
+    m = GBDTRegressor(n_estimators=20, max_depth=3,
+                      learning_rate=0.2).fit(x, y)
+    mse1 = float(np.mean((m.predict(x) - y) ** 2))
+    m2 = GBDTRegressor(n_estimators=20, max_depth=3,
+                       learning_rate=0.2).fit(x, y,
+                                              xgb_model=m.get_booster())
+    assert m2.n_trees == 40
+    mse2 = float(np.mean((m2.predict(x) - y) ** 2))
+    assert mse2 < mse1
+
+
+def test_multiclass_softmax():
+    rng = np.random.default_rng(2)
+    x = rng.uniform(-2, 2, (1500, 4))
+    y = np.digitize(x[:, 0] + 0.5 * x[:, 1], [-1.0, 1.0])  # 3 classes
+    c = GBDTClassifier(n_estimators=30, max_depth=3).fit(x[:1200],
+                                                         y[:1200])
+    proba = c.predict_proba(x[1200:])
+    assert proba.shape == (300, 3)
+    assert np.allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    acc = float((c.predict(x[1200:]) == y[1200:]).mean())
+    assert acc > 0.9, acc
+
+
+def test_classifier_preserves_label_values():
+    # non-contiguous labels map back through predict
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(400, 2))
+    y = np.where(x[:, 0] > 0, 7, -3)
+    c = GBDTClassifier(n_estimators=15, max_depth=2).fit(x, y)
+    assert set(np.unique(c.predict(x))) <= {7, -3}
+    assert (c.predict(x) == y).mean() > 0.95
+
+
+def test_min_child_weight_blocks_tiny_splits():
+    x = np.array([[0.0], [1.0], [2.0], [3.0]] * 2, np.float64)
+    y = np.array([0.0, 0.0, 1.0, 1.0] * 2)
+    blocked = GBDTRegressor(n_estimators=3, max_depth=3,
+                            min_child_weight=100.0).fit(x, y)
+    # no split can satisfy the hessian floor -> stump predictions
+    assert np.allclose(blocked.predict(x), blocked.predict(x)[0])

@@ -13,6 +13,7 @@ from analytics_zoo_tpu.pipeline.nnframes import (
     NNClassifier,
     NNEstimator,
     XGBClassifier,
+    XGBRegressor,
 )
 
 
@@ -118,15 +119,49 @@ def test_asymmetric_gradient_clipping():
     np.testing.assert_allclose(np.asarray(updates["w"]), [1.0, -4.0, -5.0])
 
 
-def test_xgboost_gated():
-    clf = XGBClassifier().setNumRound(5)
-    with pytest.raises(ImportError, match="xgboost"):
-        clf.fit(_clf_df(10))
+def test_xgbclassifier_native_backend():
+    """XGBClassifier runs in this image via the native histogram-GBDT
+    backend (orca/automl/gbdt.py) — no xgboost package needed."""
+    df = _clf_df(400)
+    clf = (XGBClassifier({"max_depth": 3, "learning_rate": 0.3})
+           .setNumRound(30))
+    out = clf.fit(df).transform(_clf_df(200, seed=1))
+    acc = (out["prediction"].to_numpy()
+           == out["label"].to_numpy()).mean()
+    assert acc > 0.9, acc
 
 
-def test_auto_xgboost_gated():
+def test_xgbregressor_native_backend():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(400, 3)).astype(np.float32)
+    y = x[:, 0] * 2 - x[:, 1] + 0.05 * rng.normal(size=400)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    reg = XGBRegressor({"max_depth": 4}).setNumRound(40)
+    out = reg.fit(df).transform(df)
+    mse = float(np.mean((out["prediction"] - y) ** 2))
+    assert mse < 0.3 * float(np.var(y)), mse
+
+
+def test_auto_xgboost_search_runs():
+    """AutoXGBoost end-to-end on the native backend: ASHA rungs with
+    warm-start boosting continuation between rungs."""
     from analytics_zoo_tpu.orca.automl import hp
-    with pytest.raises(ImportError, match="xgboost"):
-        from analytics_zoo_tpu.orca.automl.xgboost import (
-            AutoXGBClassifier)
-        AutoXGBClassifier()
+    from analytics_zoo_tpu.orca.automl.xgboost import AutoXGBClassifier
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(400, 4))
+    y = (x[:, 0] - x[:, 2] > 0).astype(int)
+    auto = AutoXGBClassifier(metric="accuracy")
+    auto.fit((x[:300], y[:300]), validation_data=(x[300:], y[300:]),
+             search_space={"max_depth": hp.grid_search([2, 4]),
+                           "learning_rate": hp.choice([0.3])},
+             epochs=2, rounds_per_epoch=15)
+    assert auto.get_best_config()["max_depth"] in (2, 4)
+    pred = auto.predict(x[300:])
+    assert (pred == y[300:]).mean() > 0.85
+    # ASHA rungs warm-started: winner has rounds from both rungs
+    # (n_trees is the native backend's attribute; with a real xgboost
+    # install the equivalent check is the booster's num_boosted_rounds)
+    best = auto.get_best_model()
+    if hasattr(best, "n_trees"):
+        assert best.n_trees == 30
