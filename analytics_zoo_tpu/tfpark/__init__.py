@@ -1,0 +1,51 @@
+"""tfpark compatibility namespace (reference `pyzoo/zoo/tfpark/` — the
+TF1-era distributed API: KerasModel, TFEstimator, TFNet/TFPredictor,
+GANEstimator, BERT estimators, TFDataset).
+
+The TF1 runtime is designed out (SURVEY §2.4: models are JAX; the
+TF-graph-in-BigDL engine DP-7 has no equivalent cost), so this module
+is the MIGRATION surface: each reference name resolves to its
+TPU-native equivalent, and names whose machinery no longer exists
+raise with the replacement spelled out.
+
+| reference | here |
+|---|---|
+| `TFNet.from_export_folder / from_session` | `load_tf_graph(path)` / `Net.load_tf(path)` — returns the `TFNet` class re-exported here (frozen GraphDef importer, `pipeline/tf_graph.py`) |
+| `TFPredictor` | `InferenceModel` (`serving/inference_model.py`) |
+| `GANEstimator` | `GANEstimator` (`orca/learn/gan.py`) |
+| `BERTClassifier / BERTNER / BERTSQuAD` | same names (`models/bert.py`) |
+| `KerasModel / TFEstimator / TFOptimizer` | `orca.learn.Estimator` (from_flax/from_keras/from_torch/from_onnx) |
+| `ZooOptimizer` | `orca.learn.optimizers` (optax-backed registry) |
+| `TFDataset` | `XShards` / data-creator functions (`orca/data`) |
+"""
+
+from analytics_zoo_tpu.models.bert import (  # noqa: F401
+    BERTClassifier,
+    BERTNER,
+    BERTSQuAD,
+)
+from analytics_zoo_tpu.orca.learn.gan import GANEstimator  # noqa: F401
+from analytics_zoo_tpu.pipeline.tf_graph import (  # noqa: F401
+    TFNet,
+    load_tf_graph,
+)
+from analytics_zoo_tpu.serving.inference_model import (  # noqa: F401
+    InferenceModel as TFPredictor,
+)
+
+_REPLACED = {
+    "KerasModel": "orca.learn.Estimator.from_keras / from_flax",
+    "TFEstimator": "orca.learn.Estimator (uniform fit/evaluate/predict)",
+    "TFOptimizer": "orca.learn.Estimator (the one SPMD engine)",
+    "ZooOptimizer": "orca.learn.optimizers (optax-backed registry)",
+    "TFDataset": "orca.data.XShards or data-creator functions",
+}
+
+
+def __getattr__(name):
+    if name in _REPLACED:
+        raise AttributeError(
+            f"tfpark.{name} is TF1-runtime machinery that is designed "
+            f"out on TPU; use analytics_zoo_tpu.{_REPLACED[name]} "
+            "instead (see docs/migration-from-analytics-zoo.md)")
+    raise AttributeError(name)

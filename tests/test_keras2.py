@@ -58,3 +58,4 @@ def test_keras2_merge_functional():
     got = np.asarray(Model([a, b], L2.average([a, b])).predict(
         [xa, xb], batch_size=3))
     assert np.allclose(got, 1.5)
+

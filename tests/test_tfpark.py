@@ -1,0 +1,20 @@
+"""tfpark compatibility namespace (reference pyzoo/zoo/tfpark/ — the
+TF1-era API surface resolved to TPU-native equivalents; designed-out
+machinery raises with the replacement named)."""
+
+import pytest
+
+from analytics_zoo_tpu import tfpark
+
+
+def test_tfpark_compat_namespace():
+    """tfpark migration surface: equivalents resolve, designed-out
+    names raise with the replacement named."""
+    assert tfpark.TFNet is not None
+    assert tfpark.TFPredictor is not None
+    assert tfpark.GANEstimator is not None
+    assert tfpark.BERTClassifier is not None
+    with pytest.raises(AttributeError, match="Estimator"):
+        tfpark.KerasModel
+    with pytest.raises(AttributeError, match="XShards"):
+        tfpark.TFDataset
