@@ -501,11 +501,13 @@ if __name__ == "__main__":
             "bert_params": n_params}))
     elif "--bert512-stage" in sys.argv:
         # r4 sweep on v5e-1 (all through Estimator.fit, DEVICE store):
-        # flash+dots b96 102k tok/s / 0.370 MFU; einsum+dots b96 89k /
-        # 0.324; flash+full-remat b256 100k / 0.363; b112/b128 OOM.
-        # ~0.37 is the seq-512 ceiling here: attention (d=64 kernels)
-        # runs below the dense ~45% efficiency that set the r3 H=768
-        # ceiling — see docs/parallelism-and-performance.md.
+        # flash+dots b96 102k tok/s / 0.370 MFU; flash+dots_all b96
+        # 102k / 0.369 (remat policy is NOT the lever at this length);
+        # einsum+dots b96 89k / 0.324; flash+full-remat b256 100k /
+        # 0.363; b112/b128 OOM.  ~0.37 is the seq-512 ceiling here:
+        # attention (d=64 kernels) runs below the dense ~45% efficiency
+        # that set the r3 H=768 ceiling — see
+        # docs/parallelism-and-performance.md.
         from analytics_zoo_tpu import init_orca_context
         init_orca_context(cluster_mode="local")
         tps, mfu, _ = bert_finetune_metrics(
