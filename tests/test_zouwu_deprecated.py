@@ -1,0 +1,95 @@
+"""Deprecated zouwu AutoTS compatibility layer (reference
+`pyzoo/zoo/chronos/autots/deprecated/` — AutoTSTrainer /
+TimeSequencePredictor / recipes / load_ts_pipeline, deprecated there
+in favour of AutoTSEstimator but still a SURVEY §2.6 row)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.chronos.autots.deprecated import (
+    AutoTSTrainer,
+    LSTMGridRandomRecipe,
+    SmokeRecipe,
+    TimeSequencePredictor,
+    load_ts_pipeline,
+)
+
+
+def _df(n=200):
+    t = np.arange(n)
+    return pd.DataFrame({
+        "datetime": pd.date_range("2020-01-01", periods=n, freq="h"),
+        "value": np.sin(2 * np.pi * t / 24) + 0.05 * np.random.default_rng(
+            0).normal(size=n),
+    })
+
+
+def test_autots_trainer_smoke_recipe_fits_and_warns(tmp_path):
+    init_orca_context(cluster_mode="local")
+    df = _df()
+    with pytest.warns(DeprecationWarning, match="AutoTSEstimator"):
+        trainer = AutoTSTrainer(horizon=2, dt_col="datetime",
+                                target_col="value", past_seq_len=24)
+    ts_pipeline = trainer.fit(df.iloc[:160], validation_df=df.iloc[160:],
+                              recipe=SmokeRecipe())
+    pred = ts_pipeline.predict(df.iloc[160:])
+    # horizon=0 inference windows: every full lookback window forecasts
+    assert pred.shape[:2] == (len(df.iloc[160:]) - 24 + 1, 2)
+    # the canonical old-API usage: exactly one lookback window of the
+    # newest data -> the forecast BEYOND the end of the input
+    latest = ts_pipeline.predict(df.iloc[-24:])
+    assert latest.shape[:2] == (1, 2)
+    # save -> deprecated loader round-trip
+    p = str(tmp_path / "zouwu_pipeline")
+    ts_pipeline.save(p)
+    with pytest.warns(DeprecationWarning):
+        again = load_ts_pipeline(p, dt_col="datetime",
+                                 target_col="value")
+    assert np.allclose(again.predict(df.iloc[160:]), pred, atol=1e-5)
+
+
+def test_time_sequence_predictor_alias_and_grid_recipe():
+    init_orca_context(cluster_mode="local")
+    df = _df(160)
+    with pytest.warns(DeprecationWarning):
+        tsp = TimeSequencePredictor(future_seq_len=1,
+                                    dt_col="datetime",
+                                    target_col="value",
+                                    past_seq_len=12)
+    pipeline = tsp.fit(df, recipe=LSTMGridRandomRecipe(
+        hidden_dim=[8], layer_num=[1]))
+    assert pipeline.best_config["hidden_dim"] == 8
+    assert pipeline.predict(df.iloc[-40:]).shape[1] == 1
+
+
+def test_wrapped_scaled_pipeline_predicts_in_original_units(tmp_path):
+    """A scaled AutoTSEstimator pipeline, reloaded through the
+    deprecated dataframe-first wrapper, must scale raw-unit inputs
+    with the SAME fitted scaler (and unscale outputs)."""
+    from analytics_zoo_tpu.chronos.autots import AutoTSEstimator
+    from analytics_zoo_tpu.chronos.data import TSDataset
+    from analytics_zoo_tpu.orca.automl import hp
+
+    init_orca_context(cluster_mode="local")
+    df = _df(200)
+    df["value"] = df["value"] * 50 + 300   # far-from-unit scale
+    tsd = TSDataset.from_pandas(df.iloc[:160], dt_col="datetime",
+                                target_col="value").scale()
+    est = AutoTSEstimator(model="lstm", past_seq_len=24,
+                          future_seq_len=1,
+                          search_space={"hidden_dim": hp.choice([16]),
+                                        "layer_num": hp.choice([1]),
+                                        "lr": hp.choice([3e-3])})
+    base = est.fit(tsd, epochs=2, n_sampling=1)
+    p = str(tmp_path / "scaled_pipeline")
+    base.save(p)
+    with pytest.warns(DeprecationWarning):
+        wrapped = load_ts_pipeline(p, dt_col="datetime",
+                                   target_col="value")
+    pred = wrapped.predict(df.iloc[-24:])
+    # original units: a sine at mean 300 must forecast near 300, not
+    # in scaled space (~0) — garbage-scale inputs would be way off
+    assert pred.shape[:2] == (1, 1)
+    assert 150.0 < float(pred.ravel()[0]) < 450.0, pred
