@@ -399,7 +399,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, kv_mask, bias, seed, out, lse, g, *,
+def _flash_bwd(q, k, v, kv_mask, bias, seed, out, lse, g, dlse, *,
                block_q: int, block_k: int, causal: bool, dropout: float,
                h: int, bias_per_head: bool, interpret: bool):
     """Pallas backward: returns (dq, dk, dv)."""
@@ -409,9 +409,14 @@ def _flash_bwd(q, k, v, kv_mask, bias, seed, out, lse, g, *,
     num_k = t // block_k
     has_mask = kv_mask is not None
     has_bias = bias is not None
-    # delta = rowsum(dO * O) — tiny elementwise pass, XLA fuses it
+    # delta = rowsum(dO * O) - dlse — tiny elementwise pass, XLA fuses
+    # it.  The -dlse term IS the lse cotangent: ds_ij = p_ij*(dp_ij -
+    # delta_i) and d lse_i/d s_ij = p_ij, so an lse cotangent just
+    # shifts delta.
     delta = (g.astype(jnp.float32) * out.astype(jnp.float32)
              ).sum(-1, keepdims=True)                      # [bh, t, 1]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     mask_arg = None
     if has_mask:
@@ -492,7 +497,9 @@ def _reference_attn(q, k, v, causal: bool, kv_mask=None, bias=None,
     shapes and the numerical oracle in tests).  [bh, t, d]; kv_mask
     [bh, t]; bias [bh, t, t].  Dropout uses the SAME counter-based hash
     as the kernels, so fallback and kernel agree bit-for-bit on which
-    probabilities drop."""
+    probabilities drop.  Returns (out, lse) with lse [bh, t, 1] — the
+    same (pre-dropout) logsumexp contract as the kernel, which is what
+    makes ring/blockwise composition exact."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = _einsum("btd,bsd->bts", q.astype(jnp.float32),
                 k.astype(jnp.float32)) * scale
@@ -511,7 +518,9 @@ def _reference_attn(q, k, v, causal: bool, kv_mask=None, bias=None,
     p = jnp.exp(s - m)
     if keep is not None:
         p = jnp.where(keep, p, 0.0)
-    p = p / jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-20)
+    l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-20)
+    lse = m + jnp.log(l)
+    p = p / l
     if dropout > 0.0:
         bh = q.shape[0]
         q_pos = jnp.arange(t)[None, :, None]
@@ -521,17 +530,21 @@ def _reference_attn(q, k, v, causal: bool, kv_mask=None, bias=None,
             & jnp.int32(0x7FFFFFFF)
         keep_d = bits >= jnp.int32(int(dropout * 0x7FFFFFFF))
         p = jnp.where(keep_d, p * (1.0 / (1.0 - dropout)), 0.0)
-    return _einsum("bts,bsd->btd", p.astype(v.dtype), v)
+    return _einsum("bts,bsd->btd", p.astype(v.dtype), v), lse
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13, 14))
 def _flash(q, k, v, kv_mask, bias, seed, block_q, block_k, causal,
            dropout, h, bias_per_head, interpret, bwd_block_q, bwd_block_k):
-    out, _lse = _flash_fwd(
+    """Returns (out, lse [bh, t, 1]).  Differentiable in BOTH outputs:
+    the lse cotangent folds into the backward's delta term
+    (d lse_i / d s_ij = p_ij, so ds += p * dlse — i.e. delta -= dlse),
+    which is what makes blockwise/ring composition through lse exact
+    under autodiff."""
+    return _flash_fwd(
         q, k, v, kv_mask, bias, seed, block_q=block_q, block_k=block_k,
         causal=causal, dropout=dropout, h=h, bias_per_head=bias_per_head,
         interpret=interpret)
-    return out
 
 
 def _flash_vjp_fwd(q, k, v, kv_mask, bias, seed, block_q, block_k, causal,
@@ -541,14 +554,15 @@ def _flash_vjp_fwd(q, k, v, kv_mask, bias, seed, block_q, block_k, causal,
         q, k, v, kv_mask, bias, seed, block_q=block_q, block_k=block_k,
         causal=causal, dropout=dropout, h=h, bias_per_head=bias_per_head,
         interpret=interpret)
-    return out, (q, k, v, kv_mask, bias, seed, out, lse)
+    return (out, lse), (q, k, v, kv_mask, bias, seed, out, lse)
 
 
 def _flash_vjp_bwd(block_q, block_k, causal, dropout, h, bias_per_head,
                    interpret, bwd_block_q, bwd_block_k, res, g):
     q, k, v, kv_mask, bias, seed, out, lse = res
+    do, dlse = g
     dq, dk, dv = _flash_bwd(
-        q, k, v, kv_mask, bias, seed, out, lse, g,
+        q, k, v, kv_mask, bias, seed, out, lse, do, dlse,
         block_q=bwd_block_q, block_k=bwd_block_k, causal=causal,
         dropout=dropout, h=h, bias_per_head=bias_per_head,
         interpret=interpret)
@@ -568,7 +582,7 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
                     block_k: int = DEFAULT_BLOCK_K,
                     bwd_block_q: int = DEFAULT_BLOCK_Q_BWD,
                     bwd_block_k: int = DEFAULT_BLOCK_K_BWD,
-                    interpret: bool = None):
+                    interpret: bool = None, return_lse: bool = False):
     """Flash attention over [batch, t, heads, d] (BTHD, same convention as
     `ops.attention.dot_product_attention`).
 
@@ -581,6 +595,11 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
     key is folded into an int32 seed for the positional hash RNG, so the
     forward and backward kernels agree on the keep mask without a [T, T]
     mask ever existing.
+
+    return_lse=True additionally returns the per-row logsumexp
+    [batch, t, heads] (pre-dropout, matching the kernel's online-softmax
+    bookkeeping) — differentiable, which is what lets ring attention
+    merge per-shard flash outputs exactly (parallel/ring_attention.py).
 
     Falls back to the blockwise-free reference implementation when shapes
     don't tile (t % block sizes); the fallback honors all the same
@@ -647,15 +666,23 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
     mask_unaligned = mask_bh is not None and (
         (block_k % 128 and block_k != t)
         or (bwd_block_k % 128 and bwd_block_k != t))
+    def lse_bthd(lse_bh):
+        # [bh, t, 1] -> [b, t, h] (the BTHD row convention)
+        return lse_bh.reshape(b, h, t).transpose(0, 2, 1)
+
     if untiled or mask_unaligned:
         bias_ref = None
         if bias is not None:
             bias_ref = jax.lax.stop_gradient(
                 jnp.broadcast_to(bias, (b, h, t, t)).reshape(b * h, t, t))
-        return from_bh(_reference_attn(
+        out_bh, lse_bh = _reference_attn(
             to_bh(q), to_bh(k), to_bh(v), causal, mask_bh, bias_ref,
-            dropout_rate, seed)).astype(q.dtype)
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), mask_bh, bias_arr, seed,
-                 block_q, block_k, causal, dropout_rate, h, bias_per_head,
-                 interpret, bwd_block_q, bwd_block_k)
-    return from_bh(out)
+            dropout_rate, seed)
+        out = from_bh(out_bh).astype(q.dtype)
+        return (out, lse_bthd(lse_bh)) if return_lse else out
+    out_bh, lse_bh = _flash(
+        to_bh(q), to_bh(k), to_bh(v), mask_bh, bias_arr, seed,
+        block_q, block_k, causal, dropout_rate, h, bias_per_head,
+        interpret, bwd_block_q, bwd_block_k)
+    out = from_bh(out_bh)
+    return (out, lse_bthd(lse_bh)) if return_lse else out

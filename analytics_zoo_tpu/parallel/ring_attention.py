@@ -53,11 +53,23 @@ def _block_attn(q, k, v, bias):
 
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
-                   kv_mask=None):
+                   kv_mask=None, impl: str = "einsum"):
     """Per-device ring attention.  q, k, v: [batch, t_local, heads, d]
     shards of the sequence dim over `axis_name`; kv_mask: optional
     [batch, t_local] key-validity shard (1 = attend).  Returns the local
-    output shard [batch, t_local, heads, d].  Call under shard_map."""
+    output shard [batch, t_local, heads, d].  Call under shard_map.
+
+    impl="einsum" materializes per-shard [t_local, t_local] scores each
+    ring step; impl="flash" runs the Pallas kernel per shard and merges
+    shards through the kernel's logsumexp (exact under autodiff — the
+    lse cotangent folds into the kernel backward), so per-device memory
+    stays O(t_local * d) and the SP sequence ceiling rises by the score
+    factor."""
+    if impl not in ("einsum", "flash"):
+        raise ValueError("impl must be 'einsum' or 'flash'")
+    if impl == "flash":
+        return _ring_attention_flash(q, k, v, axis_name=axis_name,
+                                     causal=causal, kv_mask=kv_mask)
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
@@ -113,8 +125,77 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     return (o / jnp.maximum(denom, 1e-20)).astype(q.dtype)
 
 
+def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
+                          kv_mask):
+    """Flash-kernel ring: each step runs blockwise attention of the
+    local Q shard against the K/V shard currently held, then merges the
+    normalized per-shard outputs via logsumexp:
+        lse_new = logaddexp(lse_acc, lse_blk)
+        o_new   = o_acc*exp(lse_acc-lse_new) + o_blk*exp(lse_blk-lse_new)
+    Causality decomposes over shards the classic ring way: the diagonal
+    step runs the kernel's causal mask, earlier-position shards attend
+    fully, later-position shards contribute nothing (lse = -inf)."""
+    from analytics_zoo_tpu.ops.pallas.flash_attention import (
+        flash_attention)
+
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    has_mask = kv_mask is not None
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def run_flash(k_cur, v_cur, mask_cur, blk_causal: bool):
+        return flash_attention(
+            q, k_cur, v_cur,
+            kv_mask=(mask_cur if has_mask else None),
+            causal=blk_causal, return_lse=True)
+
+    def step_fn(carry, step):
+        o_acc, lse_acc, k_cur, v_cur, mask_cur = carry
+        mask_arg = mask_cur if has_mask else None
+        if causal:
+            src_idx = (my_idx - step) % axis_size
+
+            def dead(_):
+                return (jnp.zeros((b, t_local, h, d), q.dtype),
+                        jnp.full((b, t_local, h), NEG_INF, jnp.float32))
+
+            def full(_):
+                return run_flash(k_cur, v_cur, mask_arg, False)
+
+            def diag(_):
+                return run_flash(k_cur, v_cur, mask_arg, True)
+
+            case = jnp.where(src_idx == my_idx, 2,
+                             jnp.where(src_idx < my_idx, 1, 0))
+            o_blk, lse_blk = jax.lax.switch(case, [dead, full, diag],
+                                            operand=None)
+        else:
+            o_blk, lse_blk = run_flash(k_cur, v_cur, mask_arg, False)
+        lse_blk = lse_blk.astype(jnp.float32)
+        lse_new = jnp.logaddexp(lse_acc, lse_blk)
+        w_old = jnp.exp(lse_acc - lse_new)[..., None]     # [b, t, h, 1]
+        w_new = jnp.exp(lse_blk - lse_new)[..., None]
+        o_new = (o_acc * w_old
+                 + o_blk.astype(jnp.float32) * w_new)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = (jax.lax.ppermute(mask_cur, axis_name, perm)
+                    if has_mask else mask_cur)
+        return (o_new, lse_new, k_nxt, v_nxt, mask_nxt), None
+
+    o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    lse0 = jnp.full((b, t_local, h), NEG_INF, jnp.float32)
+    mask0 = (kv_mask.astype(jnp.int32) if has_mask
+             else jnp.zeros((b, t_local), jnp.int32))
+    (o, _, _, _, _), _ = jax.lax.scan(
+        step_fn, (o0, lse0, k, v, mask0), jnp.arange(axis_size))
+    return o.astype(q.dtype)
+
+
 def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
-                        causal: bool = False, kv_mask=None):
+                        causal: bool = False, kv_mask=None,
+                        impl: str = "einsum"):
     """Convenience wrapper: takes GLOBAL [batch, t, heads, d] arrays, shards
     the sequence dim over the mesh's "sp" axis with shard_map, and runs
     ring_attention.  kv_mask: optional [batch, t] key-validity mask.  Falls
@@ -122,6 +203,13 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
     from analytics_zoo_tpu.common.context import OrcaContext
     mesh = mesh or OrcaContext.mesh
     if "sp" not in mesh.axis_names or mesh.shape["sp"] == 1:
+        if impl == "flash":
+            # honor the requested memory bound on one device too:
+            # flash handles the unsharded case in O(t*d)
+            from analytics_zoo_tpu.ops.pallas.flash_attention import (
+                flash_attention)
+            return flash_attention(q, k, v, kv_mask=kv_mask,
+                                   causal=causal)
         bias = None
         if causal:
             bias = _causal_bias(q.shape[1])
@@ -136,14 +224,16 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
     spec = P(None, "sp", None, None)
     if kv_mask is None:
         fn = jax.shard_map(
-            partial(ring_attention, axis_name="sp", causal=causal),
+            partial(ring_attention, axis_name="sp", causal=causal,
+                    impl=impl),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
         return fn(q, k, v)
     mspec = P(None, "sp")
     fn = jax.shard_map(
         lambda q, k, v, m: ring_attention(q, k, v, axis_name="sp",
-                                          causal=causal, kv_mask=m),
+                                          causal=causal, kv_mask=m,
+                                          impl=impl),
         mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v, kv_mask)

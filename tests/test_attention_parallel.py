@@ -16,7 +16,7 @@ def _ref(q, k, v, causal):
     from analytics_zoo_tpu.ops.pallas.flash_attention import _reference_attn
     b, t, h, d = q.shape
     bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    r = _reference_attn(bh(q), bh(k), bh(v), causal)
+    r, _ = _reference_attn(bh(q), bh(k), bh(v), causal)
     return r.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
@@ -129,7 +129,7 @@ def _ref_masked(q, k, v, causal, mask):
     b, t, h, d = q.shape
     bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     mb = jnp.repeat(mask, h, axis=0)
-    r = _reference_attn(bh(q), bh(k), bh(v), causal, mb)
+    r, _ = _reference_attn(bh(q), bh(k), bh(v), causal, mb)
     return r.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
@@ -340,3 +340,109 @@ def test_remat_encoder_matches_no_remat():
                     jax.tree_util.tree_leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+# -- flash lse + flash-ring composition (r4) --------------------------
+
+def test_flash_return_lse_matches_reference():
+    import jax
+    from analytics_zoo_tpu.ops.pallas.flash_attention import (
+        _reference_attn, flash_attention)
+
+    b, t, h, d = 2, 256, 2, 32
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (b, t, h, d))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, t, h, d))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, t, h, d))
+    mask = jnp.concatenate([jnp.ones((b, t - 40), jnp.int32),
+                            jnp.zeros((b, 40), jnp.int32)], axis=1)
+    out, lse = flash_attention(q, k, v, kv_mask=mask, block_q=128,
+                               block_k=128, return_lse=True)
+    bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    ref_o, ref_lse = _reference_attn(bh(q), bh(k), bh(v), False,
+                                     jnp.repeat(mask, h, axis=0))
+    np.testing.assert_allclose(
+        np.asarray(lse),
+        np.asarray(ref_lse.reshape(b, h, t, 1)[..., 0].transpose(
+            0, 2, 1)), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(ref_o.reshape(b, h, t, d).transpose(0, 2, 1, 3)),
+        atol=1e-5)
+
+
+def test_flash_lse_cotangent_grads_match_reference():
+    """Losses that read BOTH outputs (o, lse) must differentiate
+    correctly — the lse cotangent folds into the kernel backward's
+    delta term."""
+    import jax
+    from analytics_zoo_tpu.ops.pallas.flash_attention import (
+        _reference_attn, flash_attention)
+
+    b, t, h, d = 1, 256, 2, 32
+    rng = jax.random.PRNGKey(3)
+    q = jax.random.normal(rng, (b, t, h, d))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, t, h, d))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, t, h, d))
+    wo = jax.random.normal(jax.random.fold_in(rng, 3), (b, t, h, d))
+    wl = jax.random.normal(jax.random.fold_in(rng, 4), (b, t, h))
+
+    def loss_flash(q, k, v):
+        o, lse = flash_attention(q, k, v, block_q=128, block_k=128,
+                                 return_lse=True)
+        return (o * wo).sum() + (lse * wl).sum()
+
+    def loss_ref(q, k, v):
+        bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        o, lse = _reference_attn(bh(q), bh(k), bh(v), False)
+        o = o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+        lse = lse.reshape(b, h, t).transpose(0, 2, 1)
+        return (o * wo).sum() + (lse * wl).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_ring_einsum(causal):
+    """impl='flash' ring (per-shard Pallas + lse merge) must equal the
+    einsum ring in outputs AND gradients on a 4-device sp mesh
+    (t_local = 128, the kernel's minimum lane-aligned block)."""
+    import jax
+    from jax.sharding import Mesh
+    from analytics_zoo_tpu.parallel.ring_attention import (
+        ring_self_attention)
+
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    b, t, h, d = 2, 512, 2, 32   # t_local = 128 per device
+    rng = jax.random.PRNGKey(7)
+    q = jax.random.normal(rng, (b, t, h, d))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, t, h, d))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, t, h, d))
+    mask = jnp.concatenate([jnp.ones((b, t - 64), jnp.int32),
+                            jnp.zeros((b, 64), jnp.int32)], axis=1)
+
+    def out(impl):
+        return ring_self_attention(q, k, v, mesh=mesh, causal=causal,
+                                   kv_mask=mask, impl=impl)
+
+    np.testing.assert_allclose(np.asarray(out("flash")),
+                               np.asarray(out("einsum")), atol=2e-5)
+
+    w = jax.random.normal(jax.random.fold_in(rng, 5), (b, t, h, d))
+
+    def loss(impl, q, k, v):
+        return (ring_self_attention(q, k, v, mesh=mesh, causal=causal,
+                                    kv_mask=mask, impl=impl) * w).sum()
+
+    gf = jax.grad(lambda *a: loss("flash", *a), argnums=(0, 1, 2))(
+        q, k, v)
+    ge = jax.grad(lambda *a: loss("einsum", *a), argnums=(0, 1, 2))(
+        q, k, v)
+    for a, b_ in zip(gf, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=3e-4)
