@@ -94,8 +94,11 @@ class MultiHeadAttention(nn.Module):
                     "attention_mask or use attn_impl='einsum'/'flash'")
             from analytics_zoo_tpu.parallel.ring_attention import (
                 ring_self_attention)
+            # impl="auto": long per-device shards run the Pallas
+            # kernel per ring step with exact lse merging; short shards
+            # keep the fused einsum (parallel/ring_attention.py)
             out = ring_self_attention(q, k, v, causal=self.causal,
-                                      kv_mask=key_mask)
+                                      kv_mask=key_mask, impl="auto")
         elif impl == "flash":
             from analytics_zoo_tpu.ops.pallas.flash_attention import (
                 flash_attention)

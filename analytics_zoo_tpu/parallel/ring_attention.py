@@ -32,6 +32,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 #: exp(-inf - (-inf)) = NaN that true -inf produces
 NEG_INF = -1e30
 
+#: ring impl="auto" switches to the flash kernel at this per-device
+#: shard length — below it, per-shard [t_local, t_local] einsum scores
+#: are small and XLA's fused path wins (same crossover logic as
+#: MultiHeadAttention's einsum/flash threshold)
+RING_FLASH_MIN_TLOCAL = 2048
+
 
 def _block_attn(q, k, v, bias):
     """One blockwise attention step -> (unnormalized out, running max,
@@ -50,6 +56,16 @@ def _block_attn(q, k, v, bias):
     l = p.sum(axis=-1)                                  # [b, h, q]
     o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
     return o, m, l
+
+
+def _rotate_kv(axis_name, perm, k_cur, v_cur, mask_cur, has_mask):
+    """One ring step of the K/V (+ travelling mask) rotation — the one
+    piece of protocol the einsum and flash rings must share exactly."""
+    k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+    v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+    mask_nxt = (jax.lax.ppermute(mask_cur, axis_name, perm)
+                if has_mask else mask_cur)
+    return k_nxt, v_nxt, mask_nxt
 
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
@@ -108,10 +124,8 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         scale_new = beta.transpose(0, 2, 1)[..., None]
         o_new = o_acc * scale_old + o_blk.astype(jnp.float32) * scale_new
         # rotate K/V (and the mask travelling with them) around the ring
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        mask_nxt = (jax.lax.ppermute(mask_cur, axis_name, perm)
-                    if has_mask else mask_cur)
+        k_nxt, v_nxt, mask_nxt = _rotate_kv(axis_name, perm, k_cur,
+                                            v_cur, mask_cur, has_mask)
         return (o_new, m_new, l_new, k_nxt, v_nxt, mask_nxt), None
 
     o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
@@ -178,10 +192,8 @@ def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
         w_new = jnp.exp(lse_blk - lse_new)[..., None]
         o_new = (o_acc * w_old
                  + o_blk.astype(jnp.float32) * w_new)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        mask_nxt = (jax.lax.ppermute(mask_cur, axis_name, perm)
-                    if has_mask else mask_cur)
+        k_nxt, v_nxt, mask_nxt = _rotate_kv(axis_name, perm, k_cur,
+                                            v_cur, mask_cur, has_mask)
         return (o_new, lse_new, k_nxt, v_nxt, mask_nxt), None
 
     o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
@@ -199,9 +211,23 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
     """Convenience wrapper: takes GLOBAL [batch, t, heads, d] arrays, shards
     the sequence dim over the mesh's "sp" axis with shard_map, and runs
     ring_attention.  kv_mask: optional [batch, t] key-validity mask.  Falls
-    back to one-shot blockwise attention when the mesh has no "sp" axis."""
+    back to one-shot blockwise attention when the mesh has no "sp" axis.
+
+    impl: "einsum" | "flash" | "auto" — auto picks the flash kernel
+    when the per-device shard is at least RING_FLASH_MIN_TLOCAL (long
+    shards are where per-shard scores stop fitting), einsum below."""
     from analytics_zoo_tpu.common.context import OrcaContext
     mesh = mesh or OrcaContext.mesh
+    if impl not in ("einsum", "flash", "auto"):
+        # validate HERE too: the no-'sp' fallback below never reaches
+        # ring_attention's check, and a typo'd impl must not silently
+        # take the score-materializing path
+        raise ValueError("impl must be 'einsum', 'flash' or 'auto'")
+    if impl == "auto":
+        sp = (mesh.shape["sp"] if "sp" in mesh.axis_names else 1)
+        t_local = q.shape[1] // max(sp, 1)
+        impl = ("flash" if t_local >= RING_FLASH_MIN_TLOCAL
+                else "einsum")
     if "sp" not in mesh.axis_names or mesh.shape["sp"] == 1:
         if impl == "flash":
             # honor the requested memory bound on one device too:

@@ -446,3 +446,40 @@ def test_ring_flash_matches_ring_einsum(causal):
     for a, b_ in zip(gf, ge):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    atol=3e-4)
+
+
+def test_ring_auto_impl_selects_by_shard_length(monkeypatch):
+    """impl='auto' picks flash at long per-device shards, einsum below
+    — and both give the same answer (threshold patched so the 4-device
+    CPU mesh crosses it)."""
+    import jax
+    from jax.sharding import Mesh
+    import importlib
+
+    ra = importlib.import_module(
+        "analytics_zoo_tpu.parallel.ring_attention")
+
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    b, t, h, d = 1, 512, 2, 32
+    rng = jax.random.PRNGKey(11)
+    q = jax.random.normal(rng, (b, t, h, d))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, t, h, d))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, t, h, d))
+
+    seen = []
+    real = ra.ring_attention
+
+    def spy(*a, **kw):
+        seen.append(kw.get("impl", "einsum"))
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ra, "ring_attention", spy)
+    monkeypatch.setattr(ra, "RING_FLASH_MIN_TLOCAL", 128)
+    out_flash = ra.ring_self_attention(q, k, v, mesh=mesh, impl="auto")
+    assert seen[-1] == "flash"          # t_local 128 >= patched 128
+    monkeypatch.setattr(ra, "RING_FLASH_MIN_TLOCAL", 100000)
+    out_einsum = ra.ring_self_attention(q, k, v, mesh=mesh, impl="auto")
+    assert seen[-1] == "einsum"
+    np.testing.assert_allclose(np.asarray(out_flash),
+                               np.asarray(out_einsum), atol=2e-5)
