@@ -1,0 +1,88 @@
+"""Long-context attention — the capability the reference lacks
+entirely (SURVEY §5: no sequence parallelism, no blockwise attention).
+
+Three escalating mechanisms on one script:
+1. Pallas flash attention on one chip: seq 16k trains where
+   materialized [T, T] scores cannot even compile (8.6 GB/head-batch).
+2. Ring attention over an "sp" mesh: the sequence shards across
+   devices and K/V rotates around the ring (demonstrated on the
+   8-virtual-device CPU mesh the tests use; on a pod the same code
+   rides ICI).
+3. The two composed: impl="flash" runs the kernel per ring step and
+   merges shards through its differentiable logsumexp — neither
+   global nor per-shard scores ever exist.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from a checkout without install
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops.pallas.flash_attention import (
+        flash_attention)
+
+    platform = jax.devices()[0].platform
+    # CPU runs use tiny shapes (interpret-mode kernels are slow);
+    # a real chip shows the 16k headline
+    t = 16384 if platform == "tpu" else 1024
+    b, h, d = 1, 8, 64
+    rng = jax.random.PRNGKey(0)
+    dt = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    q = jax.random.normal(rng, (b, t, h, d), dt)
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, t, h, d), dt)
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, t, h, d), dt)
+
+    # 1) single-chip flash: O(T*d) memory, fwd+bwd
+    def loss(q, k, v):
+        return flash_attention(q, k, v).astype(jnp.float32).sum()
+
+    grad_fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    g = grad_fn(q, k, v)
+    float(jnp.sum(g[0].astype(jnp.float32)))   # sync
+    t0 = time.perf_counter()
+    g = grad_fn(q, k, v)
+    float(jnp.sum(g[0].astype(jnp.float32)))
+    print(f"flash fwd+bwd seq {t}: "
+          f"{(time.perf_counter() - t0) * 1e3:.1f} ms on {platform} "
+          f"(materialized f32 scores would need "
+          f"{t * t * 4 * h / 1e9:.1f} GB across the {h} heads)")
+
+    # 2+3) ring attention over an sp mesh, einsum vs flash impl
+    from jax.sharding import Mesh
+
+    from analytics_zoo_tpu.parallel.ring_attention import (
+        ring_self_attention)
+
+    n = min(4, len(jax.devices()))
+    while n > 1 and 512 % n:   # sp must divide the demo seq length
+        n -= 1
+    if n > 1:
+        mesh = Mesh(np.array(jax.devices()[:n]), ("sp",))
+        tr = 512  # t_local = tr / n per device
+        qr = jax.random.normal(rng, (2, tr, 2, 32))
+        kr = jax.random.normal(jax.random.fold_in(rng, 3),
+                               (2, tr, 2, 32))
+        vr = jax.random.normal(jax.random.fold_in(rng, 4),
+                               (2, tr, 2, 32))
+        oe = ring_self_attention(qr, kr, vr, mesh=mesh, impl="einsum")
+        of = ring_self_attention(qr, kr, vr, mesh=mesh, impl="flash")
+        diff = float(jnp.max(jnp.abs(oe - of)))
+        print(f"ring over sp={n}: einsum vs flash-impl max diff "
+              f"{diff:.2e} (per-shard scores never exist on the "
+              "flash path)")
+    else:
+        print("one device only: ring demo needs >1 (tests run it on "
+              "the 8-virtual-device CPU mesh)")
+
+
+if __name__ == "__main__":
+    main()
