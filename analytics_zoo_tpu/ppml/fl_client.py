@@ -133,3 +133,69 @@ class FLClient:
 
     def close(self):
         self._ch.close()
+
+
+class SecAggClient:
+    """Secure-aggregation client (ppml/secagg.py): joins a round with a
+    fresh DH pubkey, masks its quantized update against the full
+    roster, and fetches the unmasked SUM once every client uploaded.
+    The server never sees this client's raw update."""
+
+    def __init__(self, target: str, client_id: str,
+                 task_id: str = "secagg", frac_bits: int = 24):
+        from analytics_zoo_tpu.ppml.secagg import dh_keypair
+
+        self._ch = _Channel(target)
+        self.client_id = client_id
+        self.task_id = task_id
+        self.frac_bits = frac_bits
+        self._priv, self.pubkey = dh_keypair()
+        self._roster: Optional[Dict[str, int]] = None
+
+    def join(self) -> "SecAggClient":
+        self._ch.call("SecAggService", "Join",
+                      P.enc_secagg_join(self.task_id, self.client_id,
+                                        self.pubkey, self.frac_bits))
+        return self
+
+    def wait_roster(self, timeout: float = 30.0,
+                    poll: float = 0.05) -> Dict[str, int]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            resp = self._ch.call(
+                "SecAggService", "GetRoster",
+                P.enc_download_intersection_request(self.task_id))
+            roster = P.dec_secagg_roster(resp)
+            if roster:
+                self._roster = roster
+                return roster
+            time.sleep(poll)
+        raise TimeoutError("SecAgg roster never filled")
+
+    def upload(self, tensors: Dict[str, np.ndarray]) -> None:
+        from analytics_zoo_tpu.ppml.secagg import SecAggMasker
+
+        if self._roster is None:
+            self.wait_roster()
+        masker = SecAggMasker(self.client_id, self._priv, self._roster,
+                              frac_bits=self.frac_bits)
+        masked = masker.mask(tensors)
+        self._ch.call("SecAggService", "UploadMasked",
+                      P.enc_masked_table(self.task_id, self.client_id,
+                                         masked))
+
+    def download_sum(self, timeout: float = 30.0,
+                     poll: float = 0.05) -> Dict[str, np.ndarray]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            resp = self._ch.call(
+                "SecAggService", "DownloadSum",
+                P.enc_download_intersection_request(self.task_id))
+            name, _, tensors = P.dec_table(resp)
+            if name != "pending":
+                return tensors
+            time.sleep(poll)
+        raise TimeoutError("SecAgg sum never became ready")
+
+    def close(self):
+        self._ch.close()

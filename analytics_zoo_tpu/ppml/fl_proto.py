@@ -34,18 +34,15 @@ def _enc_i32(fnum: int, v: int) -> bytes:
 
 # -- FloatTensor / Table -----------------------------------------------------
 
-def enc_float_tensor(arr: np.ndarray) -> bytes:
+def _enc_tensor(arr: np.ndarray, dtype: str) -> bytes:
     # bulk tobytes, not per-element struct varargs: FedAvg ships full
     # model tables every round
-    arr = np.ascontiguousarray(arr, "<f4")
-    out = b""
+    arr = np.ascontiguousarray(arr, dtype)
     shape_payload = b"".join(_varint(d) for d in arr.shape)
-    out += _len_delim(1, shape_payload)            # packed shape
-    out += _len_delim(2, arr.tobytes())
-    return out
+    return _len_delim(1, shape_payload) + _len_delim(2, arr.tobytes())
 
 
-def dec_float_tensor(buf: bytes) -> np.ndarray:
+def _dec_tensor(buf: bytes, dtype: str) -> np.ndarray:
     from analytics_zoo_tpu.utils.tf_example import _read_varint
 
     shape: List[int] = []
@@ -61,8 +58,16 @@ def dec_float_tensor(buf: bytes) -> np.ndarray:
                 shape.append(to_signed(v))
         elif fnum == 2:
             chunks.append(v)
-    arr = np.frombuffer(b"".join(chunks), "<f4")
+    arr = np.frombuffer(b"".join(chunks), dtype)
     return arr.reshape(shape) if shape else arr
+
+
+def enc_float_tensor(arr: np.ndarray) -> bytes:
+    return _enc_tensor(arr, "<f4")
+
+
+def dec_float_tensor(buf: bytes) -> np.ndarray:
+    return _dec_tensor(buf, "<f4")
 
 
 def enc_table(name: str, version: int,
@@ -277,3 +282,87 @@ def dec_download_response(buf: bytes):
         elif fnum == 3:
             code = to_signed(v)
     return table, response, code
+
+
+# -- SecAgg messages ---------------------------------------------------------
+
+def enc_int64_tensor(arr: np.ndarray) -> bytes:
+    return _enc_tensor(arr, "<i8")
+
+
+def dec_int64_tensor(buf: bytes) -> np.ndarray:
+    return _dec_tensor(buf, "<i8")
+
+
+def enc_secagg_join(task_id: str, client_id: str, pubkey: int,
+                    frac_bits: int = 24) -> bytes:
+    return (_enc_str(1, task_id) + _enc_str(2, client_id)
+            + _enc_str(3, format(pubkey, "x")) + _enc_i32(4, frac_bits))
+
+
+def dec_secagg_join(buf: bytes):
+    task_id = client_id = pub_hex = ""
+    frac_bits = 24
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            task_id = v.decode()
+        elif fnum == 2:
+            client_id = v.decode()
+        elif fnum == 3:
+            pub_hex = v.decode()
+        elif fnum == 4:
+            frac_bits = to_signed(v)
+    return task_id, client_id, int(pub_hex, 16), frac_bits
+
+
+def enc_secagg_roster(roster: Dict[str, int]) -> bytes:
+    """Empty dict encodes 'pending' (roster not yet full)."""
+    out = b""
+    for cid, pub in roster.items():
+        entry = _enc_str(1, cid) + _enc_str(2, format(pub, "x"))
+        out += _len_delim(1, entry)
+    return out
+
+
+def dec_secagg_roster(buf: bytes) -> Dict[str, int]:
+    roster: Dict[str, int] = {}
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            cid = pub_hex = ""
+            for f2, _, v2 in walk_fields(v):
+                if f2 == 1:
+                    cid = v2.decode()
+                elif f2 == 2:
+                    pub_hex = v2.decode()
+            roster[cid] = int(pub_hex, 16)
+    return roster
+
+
+def enc_masked_table(task_id: str, client_id: str,
+                     tensors: Dict[str, np.ndarray]) -> bytes:
+    out = _enc_str(1, task_id) + _enc_str(2, client_id)
+    for key, arr in tensors.items():
+        entry = _len_delim(1, key.encode()) \
+            + _len_delim(2, enc_int64_tensor(arr))
+        out += _len_delim(3, entry)
+    return out
+
+
+def dec_masked_table(buf: bytes):
+    task_id = client_id = ""
+    tensors: Dict[str, np.ndarray] = {}
+    for fnum, _, v in walk_fields(buf):
+        if fnum == 1:
+            task_id = v.decode()
+        elif fnum == 2:
+            client_id = v.decode()
+        elif fnum == 3:
+            key, tensor = "", None
+            for f2, _, v2 in walk_fields(v):
+                if f2 == 1:
+                    key = v2.decode()
+                elif f2 == 2:
+                    tensor = dec_int64_tensor(v2)
+            if tensor is not None:
+                tensors[key] = tensor
+    return task_id, client_id, tensors

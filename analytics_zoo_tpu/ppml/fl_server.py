@@ -88,12 +88,21 @@ class FLServer:
             "DownloadTrain": unary(self._download_train),
             "UploadEvaluate": unary(self._upload_evaluate),
         }
+        secagg_handlers = {
+            "Join": unary(self._secagg_join),
+            "GetRoster": unary(self._secagg_roster),
+            "UploadMasked": unary(self._secagg_upload),
+            "DownloadSum": unary(self._secagg_sum),
+        }
+        self._secagg: Dict[str, "SecAggRound"] = {}
         self._server = grpc.server(futures.ThreadPoolExecutor(8))
         self._server.add_generic_rpc_handlers((
             grpc.method_handlers_generic_handler("PSIService",
                                                  psi_handlers),
             grpc.method_handlers_generic_handler("ParameterServerService",
                                                  ps_handlers),
+            grpc.method_handlers_generic_handler("SecAggService",
+                                                 secagg_handlers),
         ))
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         self.host = host
@@ -182,6 +191,55 @@ class FLServer:
         return P.enc_code_response("ok", P.SUCCESS)
 
     # -- lifecycle ------------------------------------------------------
+
+    # -- SecAgg (beyond the reference: its FL server sees raw updates
+    # and relies on SGX; here pairwise masks cancel in the sum —
+    # ppml/secagg.py) ---------------------------------------------------
+
+    #: completed rounds retained for late DownloadSum polls; older
+    #: ones are evicted (their masked uploads are already purged at
+    #: aggregation, this bounds the roster/sum dicts too)
+    _SECAGG_KEEP = 8
+
+    def _secagg_round(self, task_id: str, frac_bits: int = None):
+        from analytics_zoo_tpu.ppml.secagg import SecAggRound
+        with self._lock:
+            if task_id not in self._secagg:
+                self._secagg[task_id] = SecAggRound(
+                    self.client_num, frac_bits=frac_bits or 24)
+                done = [t for t, r in self._secagg.items()
+                        if r.sum_if_ready() is not None
+                        and t != task_id]
+                for t in done[:-self._SECAGG_KEEP]:
+                    del self._secagg[t]
+            rnd = self._secagg[task_id]
+            if frac_bits is not None and frac_bits != rnd.frac_bits:
+                raise ValueError(
+                    f"frac_bits mismatch: round uses {rnd.frac_bits}, "
+                    f"client sent {frac_bits} — all clients must agree")
+            return rnd
+
+    def _secagg_join(self, request: bytes, context) -> bytes:
+        task_id, client_id, pub, frac_bits = P.dec_secagg_join(request)
+        self._secagg_round(task_id, frac_bits).join(client_id, pub)
+        return P.enc_status_response(task_id, 0)
+
+    def _secagg_roster(self, request: bytes, context) -> bytes:
+        task_id = P.dec_download_intersection_request(request)
+        roster = self._secagg_round(task_id).roster_if_full()
+        return P.enc_secagg_roster(roster or {})
+
+    def _secagg_upload(self, request: bytes, context) -> bytes:
+        task_id, client_id, tensors = P.dec_masked_table(request)
+        self._secagg_round(task_id).upload(client_id, tensors)
+        return P.enc_status_response(task_id, 0)
+
+    def _secagg_sum(self, request: bytes, context) -> bytes:
+        task_id = P.dec_download_intersection_request(request)
+        total = self._secagg_round(task_id).sum_if_ready()
+        if total is None:
+            return P.enc_table("pending", -1, {})
+        return P.enc_table("secagg_sum", 0, total)
 
     def start(self) -> "FLServer":
         self._server.start()

@@ -1,0 +1,209 @@
+"""Secure aggregation for federated learning — pairwise-mask
+cancellation (the Bonawitz et al. SecAgg recipe, single-round
+all-participants variant).
+
+Reference context: the reference's FL stack uploads RAW client updates
+(`FLProto` tables) and gets its privacy from running the server inside
+SGX (`ppml/trusted-big-data-ml/`).  TPU hosts have no enclave, so
+privacy moves into the protocol instead: the server only ever sees
+per-client updates offset by pairwise masks that cancel exactly in the
+sum.
+
+Mechanics:
+* Key agreement: classic Diffie-Hellman over the RFC 3526 group-14
+  2048-bit MODP prime (generator 2), pure-python `pow` — no external
+  crypto dependency.  Client i and j both derive
+  seed_ij = SHA256(g^(x_i * x_j) mod p).
+* Masks: a SHA256-counter PRG expands seed_ij into int64 words;
+  client i ADDS mask_ij for every j > i and SUBTRACTS it for j < i,
+  so the server-side sum over all clients telescopes to zero.
+* Exactness: floats don't cancel, so updates are fixed-point-quantized
+  (`frac_bits`, default 24) into int64 with wrapping arithmetic; after
+  summation the server unquantizes.  Quantization error is bounded by
+  n_clients * 2^-frac_bits per element.
+
+Limitations (stated, not hidden): this is the all-or-nothing round —
+if a client drops after joining, the round cannot complete (the full
+protocol's Shamir-share recovery of dropped clients' masks is not
+implemented).  Threat model: honest-but-curious server; colluding
+clients j can of course cancel their own masks with i's.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# RFC 3526 group 14 (2048-bit MODP)
+DH_PRIME = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD1"
+    "29024E088A67CC74020BBEA63B139B22514A08798E3404DD"
+    "EF9519B3CD3A431B302B0A6DF25F14374FE1356D6D51C245"
+    "E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3D"
+    "C2007CB8A163BF0598DA48361C55D39A69163FA8FD24CF5F"
+    "83655D23DCA3AD961C62F356208552BB9ED529077096966D"
+    "670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9"
+    "DE2BCBF6955817183995497CEA956AE515D2261898FA0510"
+    "15728E5A8AACAA68FFFFFFFFFFFFFFFF", 16)
+DH_GENERATOR = 2
+
+
+def dh_keypair():
+    priv = secrets.randbits(256)
+    return priv, pow(DH_GENERATOR, priv, DH_PRIME)
+
+
+def pair_seed(priv: int, peer_pub: int) -> bytes:
+    shared = pow(peer_pub, priv, DH_PRIME)
+    return hashlib.sha256(
+        shared.to_bytes((DH_PRIME.bit_length() + 7) // 8, "big")).digest()
+
+
+def _prg_int64(seed: bytes, label: str, n: int) -> np.ndarray:
+    """Deterministic int64 stream from SHA256(seed || label || ctr)."""
+    out = np.empty(n, np.uint64)
+    words_per_block = 4                     # 32 bytes -> 4 uint64
+    blocks = (n + words_per_block - 1) // words_per_block
+    buf = bytearray()
+    base = seed + label.encode()
+    for c in range(blocks):
+        buf += hashlib.sha256(base + c.to_bytes(8, "big")).digest()
+    out[:] = np.frombuffer(bytes(buf), "<u8")[:n]
+    return out.view(np.int64)
+
+
+def quantize(arr: np.ndarray, frac_bits: int = 24) -> np.ndarray:
+    arr = np.asarray(arr, np.float64)
+    # int64 headroom check: values past this silently wrap in the cast
+    # and masks would still "cancel" around garbage — refuse loudly
+    limit = 2.0 ** (62 - frac_bits)
+    mx = float(np.abs(arr).max()) if arr.size else 0.0
+    if mx >= limit:
+        raise ValueError(
+            f"update magnitude {mx:.3g} exceeds the fixed-point range "
+            f"2^(62-{frac_bits}) = {limit:.3g}; clip the update or "
+            "lower frac_bits")
+    return np.round(arr * (1 << frac_bits)).astype(np.int64)
+
+
+def unquantize(arr: np.ndarray, frac_bits: int = 24) -> np.ndarray:
+    return (arr.astype(np.float64) / (1 << frac_bits)).astype(np.float32)
+
+
+class SecAggMasker:
+    """Client-side masking: given MY id, MY private key and the full
+    roster {client_id: pubkey}, offset a quantized update so the sum
+    over the roster telescopes the masks away."""
+
+    def __init__(self, client_id: str, priv: int,
+                 roster: Dict[str, int], frac_bits: int = 24):
+        if client_id not in roster:
+            raise ValueError(f"{client_id!r} not in the roster")
+        self.client_id = client_id
+        self.frac_bits = frac_bits
+        self._pair_seeds = {
+            peer: pair_seed(priv, pub)
+            for peer, pub in roster.items() if peer != client_id}
+
+    def mask(self, tensors: Dict[str, np.ndarray]
+             ) -> Dict[str, np.ndarray]:
+        out = {}
+        for key, arr in tensors.items():
+            arr = np.asarray(arr)
+            q = quantize(arr, self.frac_bits).ravel()
+            with np.errstate(over="ignore"):
+                for peer, seed in self._pair_seeds.items():
+                    m = _prg_int64(seed, key, q.size)
+                    # canonical sign: the lexicographically smaller id
+                    # adds, the larger subtracts — both sides agree
+                    if self.client_id < peer:
+                        q = q + m
+                    else:
+                        q = q - m
+            out[key] = q.reshape(arr.shape)
+        return out
+
+
+def aggregate_masked(uploads: List[Dict[str, np.ndarray]],
+                     frac_bits: int = 24) -> Dict[str, np.ndarray]:
+    """Server-side: wrap-sum the masked int64 uploads (masks cancel
+    exactly), then unquantize."""
+    if not uploads:
+        return {}
+    keys = uploads[0].keys()
+    out = {}
+    with np.errstate(over="ignore"):
+        for key in keys:
+            acc = np.zeros_like(np.asarray(uploads[0][key], np.int64))
+            for up in uploads:
+                acc = acc + np.asarray(up[key], np.int64)
+            out[key] = unquantize(acc, frac_bits)
+    return out
+
+
+class SecAggRound:
+    """Server-side round state: roster of pubkeys, masked uploads,
+    aggregate released when every joined client has uploaded."""
+
+    def __init__(self, client_num: int, frac_bits: int = 24):
+        self.client_num = client_num
+        self.frac_bits = frac_bits
+        self.roster: Dict[str, int] = {}
+        self.uploads: Dict[str, Dict[str, np.ndarray]] = {}
+        self._sum: Optional[Dict[str, np.ndarray]] = None
+        self._lock = threading.Lock()
+
+    def join(self, client_id: str, pubkey: int) -> bool:
+        with self._lock:
+            if self._sum is not None or self.uploads:
+                raise RuntimeError("round already uploading; too late "
+                                   "to join (all-or-nothing round)")
+            if client_id in self.roster:
+                if self.roster[client_id] != pubkey:
+                    # a replaced pubkey would desync every peer's masks
+                    raise RuntimeError(
+                        f"{client_id!r} already joined with a "
+                        "different pubkey; one keypair per round")
+                return len(self.roster) >= self.client_num
+            if len(self.roster) >= self.client_num:
+                # peers may already have fetched the full roster and
+                # masked against it — a late member breaks cancellation
+                raise RuntimeError(
+                    "roster is full; a late join would desync the "
+                    "pairwise masks (all-or-nothing round)")
+            self.roster[client_id] = pubkey
+            return len(self.roster) >= self.client_num
+
+    def roster_if_full(self) -> Optional[Dict[str, int]]:
+        with self._lock:
+            return (dict(self.roster)
+                    if len(self.roster) >= self.client_num else None)
+
+    def upload(self, client_id: str, masked: Dict[str, np.ndarray]):
+        with self._lock:
+            if client_id not in self.roster:
+                raise ValueError(f"{client_id!r} never joined the round")
+            if self._sum is not None:
+                raise RuntimeError(
+                    "round already aggregated; clients may have "
+                    "fetched the sum — start a new task_id")
+            if client_id in self.uploads:
+                raise RuntimeError(
+                    f"{client_id!r} already uploaded this round")
+            self.uploads[client_id] = masked
+            if len(self.uploads) == len(self.roster):
+                self._sum = aggregate_masked(list(self.uploads.values()),
+                                             self.frac_bits)
+                # masked uploads are dead weight once summed (and the
+                # privacy posture is better without retaining them)
+                self.uploads = {c: {} for c in self.uploads}
+
+    def sum_if_ready(self) -> Optional[Dict[str, np.ndarray]]:
+        with self._lock:
+            return self._sum

@@ -126,3 +126,147 @@ def test_federated_linear_regression_converges(server):
 
     np.testing.assert_allclose(results["p1"], results["p2"], atol=1e-5)
     np.testing.assert_allclose(results["p1"], true_w, atol=0.15)
+
+
+# -- secure aggregation (beyond the reference: its FL privacy came from
+# SGX; here pairwise masks cancel in the sum — ppml/secagg.py) --------
+
+def test_secagg_masks_cancel_exactly():
+    from analytics_zoo_tpu.ppml.secagg import (
+        SecAggMasker, aggregate_masked, dh_keypair)
+
+    rng = np.random.default_rng(0)
+    n = 3
+    keys = [dh_keypair() for _ in range(n)]
+    roster = {f"c{i}": keys[i][1] for i in range(n)}
+    updates = [{"w": rng.normal(size=(4, 5)).astype(np.float32),
+                "b": rng.normal(size=7).astype(np.float32)}
+               for _ in range(n)]
+    masked = [SecAggMasker(f"c{i}", keys[i][0], roster).mask(updates[i])
+              for i in range(n)]
+    # an individual masked upload reveals nothing recognizable: the
+    # int64 masks dwarf the quantized signal by many orders
+    from analytics_zoo_tpu.ppml.secagg import quantize
+    raw_q = quantize(updates[0]["w"])
+    assert np.abs(masked[0]["w"] - raw_q).min() > 2**40
+    total = aggregate_masked(masked)
+    want = {k: sum(u[k] for u in updates) for k in ("w", "b")}
+    for k in ("w", "b"):
+        np.testing.assert_allclose(total[k], want[k], atol=1e-5)
+
+
+def test_secagg_pair_seeds_agree_and_prg_is_stable():
+    from analytics_zoo_tpu.ppml.secagg import (
+        _prg_int64, dh_keypair, pair_seed)
+
+    pa, ga = dh_keypair()
+    pb, gb = dh_keypair()
+    assert pair_seed(pa, gb) == pair_seed(pb, ga)
+    s = pair_seed(pa, gb)
+    np.testing.assert_array_equal(_prg_int64(s, "w", 10),
+                                  _prg_int64(s, "w", 10))
+    assert not np.array_equal(_prg_int64(s, "w", 10),
+                              _prg_int64(s, "b", 10))
+
+
+def test_secagg_grpc_round_end_to_end():
+    """3 clients over real gRPC: the server aggregates without ever
+    seeing a raw update."""
+    import threading
+
+    from analytics_zoo_tpu.ppml.fl_client import SecAggClient
+    from analytics_zoo_tpu.ppml.fl_server import FLServer
+    from analytics_zoo_tpu.ppml.secagg import quantize
+
+    server = FLServer(client_num=3).start()
+    try:
+        target = f"{server.host}:{server.port}"
+        rng = np.random.default_rng(1)
+        updates = [{"w": rng.normal(size=(3, 4)).astype(np.float32)}
+                   for _ in range(3)]
+        sums = [None] * 3
+
+        def run(i):
+            c = SecAggClient(target, f"client{i}", task_id="round0")
+            c.join()
+            c.wait_roster()
+            c.upload(updates[i])
+            sums[i] = c.download_sum()
+            c.close()
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        want = sum(u["w"] for u in updates)
+        for s in sums:
+            assert s is not None
+            np.testing.assert_allclose(s["w"], want, atol=1e-5)
+        # masked uploads are purged once the round aggregates (the
+        # server retains only the sum); rawness was asserted in the
+        # local masking test
+        stored = server._secagg["round0"].uploads
+        assert all(v == {} for v in stored.values())
+    finally:
+        server.stop()
+
+
+def test_secagg_round_rejects_late_join_and_unknown_upload():
+    from analytics_zoo_tpu.ppml.secagg import SecAggRound, dh_keypair
+
+    r = SecAggRound(client_num=2)
+    (pa, ga), (pb, gb) = dh_keypair(), dh_keypair()
+    r.join("a", ga)
+    r.join("b", gb)
+    with pytest.raises(ValueError, match="never joined"):
+        r.upload("ghost", {"w": np.zeros(2, np.int64)})
+    r.upload("a", {"w": np.zeros(2, np.int64)})
+    with pytest.raises(RuntimeError, match="all-or-nothing"):
+        r.join("c", ga)
+
+
+def test_secagg_guards_and_overflow():
+    from analytics_zoo_tpu.ppml.secagg import (
+        SecAggRound, dh_keypair, quantize)
+
+    r = SecAggRound(client_num=2)
+    (pa, ga), (pb, gb), (pc, gc) = (dh_keypair() for _ in range(3))
+    r.join("a", ga)
+    # idempotent re-join with the SAME key is fine; a NEW key is not
+    r.join("a", ga)
+    with pytest.raises(RuntimeError, match="different pubkey"):
+        r.join("a", gc)
+    r.join("b", gb)
+    with pytest.raises(RuntimeError, match="roster is full"):
+        r.join("c", gc)
+    r.upload("a", {"w": np.zeros(2, np.int64)})
+    with pytest.raises(RuntimeError, match="already uploaded"):
+        r.upload("a", {"w": np.ones(2, np.int64)})
+    r.upload("b", {"w": np.zeros(2, np.int64)})
+    assert r.sum_if_ready() is not None
+    with pytest.raises(RuntimeError, match="already aggregated"):
+        r.upload("b", {"w": np.zeros(2, np.int64)})
+    # fixed-point overflow refuses loudly instead of wrapping silently
+    with pytest.raises(ValueError, match="fixed-point range"):
+        quantize(np.array([1e30]))
+
+
+def test_secagg_frac_bits_must_agree():
+    from analytics_zoo_tpu.ppml.fl_server import FLServer
+
+    server = FLServer(client_num=2).start()
+    try:
+        import grpc
+
+        from analytics_zoo_tpu.ppml.fl_client import SecAggClient
+
+        target = f"{server.host}:{server.port}"
+        SecAggClient(target, "a", task_id="fb",
+                     frac_bits=24).join()
+        with pytest.raises(grpc.RpcError):
+            SecAggClient(target, "b", task_id="fb",
+                         frac_bits=16).join()
+    finally:
+        server.stop()
