@@ -161,7 +161,7 @@ class SecAggClient:
     def wait_roster(self, timeout: float = 30.0,
                     poll: float = 0.05) -> Dict[str, int]:
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        while True:   # at-least-once poll, like the PSI/FedAvg clients
             resp = self._ch.call(
                 "SecAggService", "GetRoster",
                 P.enc_download_intersection_request(self.task_id))
@@ -169,8 +169,9 @@ class SecAggClient:
             if roster:
                 self._roster = roster
                 return roster
+            if time.monotonic() >= deadline:
+                raise TimeoutError("SecAgg roster never filled")
             time.sleep(poll)
-        raise TimeoutError("SecAgg roster never filled")
 
     def upload(self, tensors: Dict[str, np.ndarray]) -> None:
         from analytics_zoo_tpu.ppml.secagg import SecAggMasker
@@ -187,15 +188,20 @@ class SecAggClient:
     def download_sum(self, timeout: float = 30.0,
                      poll: float = 0.05) -> Dict[str, np.ndarray]:
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        while True:   # at-least-once poll, like the PSI/FedAvg clients
             resp = self._ch.call(
                 "SecAggService", "DownloadSum",
                 P.enc_download_intersection_request(self.task_id))
             name, _, tensors = P.dec_table(resp)
+            if name == "unknown-round":
+                raise RuntimeError(
+                    f"SecAgg round {self.task_id!r} is unknown to the "
+                    "server (never joined, or evicted)")
             if name != "pending":
                 return tensors
+            if time.monotonic() >= deadline:
+                raise TimeoutError("SecAgg sum never became ready")
             time.sleep(poll)
-        raise TimeoutError("SecAgg sum never became ready")
 
     def close(self):
         self._ch.close()

@@ -196,22 +196,38 @@ class FLServer:
     # and relies on SGX; here pairwise masks cancel in the sum —
     # ppml/secagg.py) ---------------------------------------------------
 
-    #: completed rounds retained for late DownloadSum polls; older
-    #: ones are evicted (their masked uploads are already purged at
-    #: aggregation, this bounds the roster/sum dicts too)
+    #: completed rounds retained for late DownloadSum polls beyond the
+    #: active one; and a hard cap on TOTAL retained rounds so abandoned
+    #: (never-completed) rounds — the all-or-nothing dropout mode —
+    #: cannot accrete forever either
     _SECAGG_KEEP = 8
+    _SECAGG_TOTAL = 64
 
-    def _secagg_round(self, task_id: str, frac_bits: int = None):
+    def _secagg_round(self, task_id: str, frac_bits: int = None,
+                      create: bool = False):
+        """Round lookup.  Only Join creates rounds (`create=True`):
+        read-only polls for unknown/evicted task_ids must not allocate
+        phantom state.  Returns None when absent and not creating."""
         from analytics_zoo_tpu.ppml.secagg import SecAggRound
         with self._lock:
             if task_id not in self._secagg:
+                if not create:
+                    return None
                 self._secagg[task_id] = SecAggRound(
-                    self.client_num, frac_bits=frac_bits or 24)
+                    self.client_num,
+                    frac_bits=24 if frac_bits is None else frac_bits)
+                # evict completed rounds beyond the keep-window first,
+                # then (if a runaway client is minting task_ids or
+                # abandoning rounds) the oldest rounds outright
                 done = [t for t, r in self._secagg.items()
                         if r.sum_if_ready() is not None
                         and t != task_id]
                 for t in done[:-self._SECAGG_KEEP]:
                     del self._secagg[t]
+                while len(self._secagg) > self._SECAGG_TOTAL:
+                    oldest = next(t for t in self._secagg
+                                  if t != task_id)
+                    del self._secagg[oldest]
             rnd = self._secagg[task_id]
             if frac_bits is not None and frac_bits != rnd.frac_bits:
                 raise ValueError(
@@ -221,22 +237,33 @@ class FLServer:
 
     def _secagg_join(self, request: bytes, context) -> bytes:
         task_id, client_id, pub, frac_bits = P.dec_secagg_join(request)
-        self._secagg_round(task_id, frac_bits).join(client_id, pub)
+        self._secagg_round(task_id, frac_bits,
+                           create=True).join(client_id, pub)
         return P.enc_status_response(task_id, 0)
 
     def _secagg_roster(self, request: bytes, context) -> bytes:
         task_id = P.dec_download_intersection_request(request)
-        roster = self._secagg_round(task_id).roster_if_full()
+        rnd = self._secagg_round(task_id)
+        roster = rnd.roster_if_full() if rnd is not None else None
         return P.enc_secagg_roster(roster or {})
 
     def _secagg_upload(self, request: bytes, context) -> bytes:
         task_id, client_id, tensors = P.dec_masked_table(request)
-        self._secagg_round(task_id).upload(client_id, tensors)
+        rnd = self._secagg_round(task_id)
+        if rnd is None:
+            raise ValueError(f"unknown SecAgg round {task_id!r}; "
+                             "Join first")
+        rnd.upload(client_id, tensors)
         return P.enc_status_response(task_id, 0)
 
     def _secagg_sum(self, request: bytes, context) -> bytes:
         task_id = P.dec_download_intersection_request(request)
-        total = self._secagg_round(task_id).sum_if_ready()
+        rnd = self._secagg_round(task_id)
+        if rnd is None:
+            # distinguish never-existed/evicted from not-yet-ready so
+            # clients fail fast instead of polling a phantom forever
+            return P.enc_table("unknown-round", -1, {})
+        total = rnd.sum_if_ready()
         if total is None:
             return P.enc_table("pending", -1, {})
         return P.enc_table("secagg_sum", 0, total)

@@ -59,36 +59,44 @@ def dh_keypair():
     return priv, pow(DH_GENERATOR, priv, DH_PRIME)
 
 
+def check_pubkey(pub: int) -> int:
+    """Reject degenerate public keys (0, 1, p-1, out of range): a
+    malicious pub=1 makes the pair seed publicly computable — in a
+    2-client round that fully unmasks the honest client."""
+    if not (1 < pub < DH_PRIME - 1):
+        raise ValueError("degenerate DH public key rejected")
+    return pub
+
+
 def pair_seed(priv: int, peer_pub: int) -> bytes:
+    check_pubkey(peer_pub)
     shared = pow(peer_pub, priv, DH_PRIME)
     return hashlib.sha256(
         shared.to_bytes((DH_PRIME.bit_length() + 7) // 8, "big")).digest()
 
 
 def _prg_int64(seed: bytes, label: str, n: int) -> np.ndarray:
-    """Deterministic int64 stream from SHA256(seed || label || ctr)."""
-    out = np.empty(n, np.uint64)
-    words_per_block = 4                     # 32 bytes -> 4 uint64
-    blocks = (n + words_per_block - 1) // words_per_block
-    buf = bytearray()
-    base = seed + label.encode()
-    for c in range(blocks):
-        buf += hashlib.sha256(base + c.to_bytes(8, "big")).digest()
-    out[:] = np.frombuffer(bytes(buf), "<u8")[:n]
-    return out.view(np.int64)
+    """Deterministic int64 stream: one SHAKE-256 XOF call (a single C
+    call for the whole mask — a per-32-byte python sha256 loop would
+    dominate round time at real model sizes)."""
+    stream = hashlib.shake_256(seed + label.encode()).digest(8 * n)
+    return np.frombuffer(stream, "<u8").view(np.int64).copy()
 
 
-def quantize(arr: np.ndarray, frac_bits: int = 24) -> np.ndarray:
+def quantize(arr: np.ndarray, frac_bits: int = 24,
+             n_clients: int = 1) -> np.ndarray:
     arr = np.asarray(arr, np.float64)
-    # int64 headroom check: values past this silently wrap in the cast
-    # and masks would still "cancel" around garbage — refuse loudly
-    limit = 2.0 ** (62 - frac_bits)
+    # int64 headroom check: values past this silently wrap — in the
+    # cast, or later when n_clients quantized values SUM — and masks
+    # would still "cancel" around garbage, so refuse loudly.  NaN/inf
+    # would sail through a plain >= comparison and cast to int64 min.
+    limit = 2.0 ** (62 - frac_bits) / max(n_clients, 1)
     mx = float(np.abs(arr).max()) if arr.size else 0.0
-    if mx >= limit:
+    if not np.isfinite(mx) or mx >= limit:
         raise ValueError(
-            f"update magnitude {mx:.3g} exceeds the fixed-point range "
-            f"2^(62-{frac_bits}) = {limit:.3g}; clip the update or "
-            "lower frac_bits")
+            f"update magnitude {mx:.3g} is non-finite or exceeds the "
+            f"fixed-point range 2^(62-{frac_bits})/{n_clients} = "
+            f"{limit:.3g}; clip the update or lower frac_bits")
     return np.round(arr * (1 << frac_bits)).astype(np.int64)
 
 
@@ -116,7 +124,8 @@ class SecAggMasker:
         out = {}
         for key, arr in tensors.items():
             arr = np.asarray(arr)
-            q = quantize(arr, self.frac_bits).ravel()
+            q = quantize(arr, self.frac_bits,
+                         n_clients=len(self._pair_seeds) + 1).ravel()
             with np.errstate(over="ignore"):
                 for peer, seed in self._pair_seeds.items():
                     m = _prg_int64(seed, key, q.size)
@@ -160,6 +169,7 @@ class SecAggRound:
         self._lock = threading.Lock()
 
     def join(self, client_id: str, pubkey: int) -> bool:
+        check_pubkey(pubkey)
         with self._lock:
             if self._sum is not None or self.uploads:
                 raise RuntimeError("round already uploading; too late "
@@ -196,6 +206,16 @@ class SecAggRound:
             if client_id in self.uploads:
                 raise RuntimeError(
                     f"{client_id!r} already uploaded this round")
+            if self.uploads:
+                # uniform schema or the round wedges at aggregation /
+                # silently drops keys absent from the first upload
+                ref = next(iter(self.uploads.values()))
+                if (set(masked) != set(ref)
+                        or any(masked[k].shape != ref[k].shape
+                               for k in ref)):
+                    raise ValueError(
+                        f"{client_id!r} uploaded a different tensor "
+                        "schema than its peers")
             self.uploads[client_id] = masked
             if len(self.uploads) == len(self.roster):
                 self._sum = aggregate_masked(list(self.uploads.values()),

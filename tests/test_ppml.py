@@ -270,3 +270,54 @@ def test_secagg_frac_bits_must_agree():
                          frac_bits=16).join()
     finally:
         server.stop()
+
+
+def test_secagg_hardening_regressions():
+    from analytics_zoo_tpu.ppml.secagg import (
+        SecAggRound, aggregate_masked, dh_keypair, pair_seed, quantize)
+
+    # NaN and headroom-for-n refusals
+    with pytest.raises(ValueError, match="non-finite|fixed-point"):
+        quantize(np.array([np.nan, 1.0]))
+    # 2.5e11 fits a single client's range but 3 of them would wrap
+    with pytest.raises(ValueError, match="fixed-point"):
+        quantize(np.array([2.5e11]), n_clients=3)
+
+    # degenerate DH pubkeys rejected everywhere
+    priv, _ = dh_keypair()
+    for bad in (0, 1):
+        with pytest.raises(ValueError, match="degenerate"):
+            pair_seed(priv, bad)
+    r = SecAggRound(client_num=2)
+    with pytest.raises(ValueError, match="degenerate"):
+        r.join("evil", 1)
+
+    # schema mismatch refused at upload, not wedged at aggregation
+    (pa, ga), (pb, gb) = dh_keypair(), dh_keypair()
+    r = SecAggRound(client_num=2)
+    r.join("a", ga)
+    r.join("b", gb)
+    r.upload("a", {"w": np.zeros(3, np.int64)})
+    with pytest.raises(ValueError, match="schema"):
+        r.upload("b", {"b": np.zeros(3, np.int64)})
+    with pytest.raises(ValueError, match="schema"):
+        r.upload("b", {"w": np.zeros(4, np.int64)})
+    r.upload("b", {"w": np.zeros(3, np.int64)})
+    assert r.sum_if_ready() is not None
+
+
+def test_secagg_unknown_round_fails_fast():
+    from analytics_zoo_tpu.ppml.fl_client import SecAggClient
+    from analytics_zoo_tpu.ppml.fl_server import FLServer
+
+    server = FLServer(client_num=1).start()
+    try:
+        target = f"{server.host}:{server.port}"
+        c = SecAggClient(target, "x", task_id="never-joined")
+        with pytest.raises(RuntimeError, match="unknown"):
+            c.download_sum(timeout=1.0)
+        # the read-only poll must NOT have allocated a phantom round
+        assert "never-joined" not in server._secagg
+        c.close()
+    finally:
+        server.stop()
