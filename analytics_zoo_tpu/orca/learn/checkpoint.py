@@ -5,6 +5,18 @@ and the DP-1 retry-restore loop, Topology.scala:1255-1310).
 Multi-host note: orbax writes a sharded checkpoint cooperatively from all
 processes, which is the TPU-native analog of the reference's rank-0
 authoritative state save (torch_runner.py:369-410).
+
+Saves are DELIBERATELY synchronous.  Async writes were implemented twice
+in r4 (orbax StandardCheckpointer driven from a daemon thread, then
+orbax AsyncCheckpointer per save, closed by a finisher thread): both
+variants left the process in a state where a LATER multi-device
+`jit` dispatch with collectives aborted inside XLA:CPU
+(SIGABRT in pxla `__call__`, reproducible with
+tests/test_failure_handling.py + tests/_fsdp_cases.py in ONE process
+— the shipped tests/test_fsdp.py wrapper isolates the cases in child
+processes precisely because of this class of abort).
+Until orbax/XLA coexist off-thread, the blocking save is the correct
+trade — a checkpoint costs one pause; an abort costs the job.
 """
 
 from __future__ import annotations

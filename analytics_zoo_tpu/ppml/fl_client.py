@@ -166,6 +166,10 @@ class SecAggClient:
                 "SecAggService", "GetRoster",
                 P.enc_download_intersection_request(self.task_id))
             roster = P.dec_secagg_roster(resp)
+            if "__unknown_round__" in roster:
+                raise RuntimeError(
+                    f"SecAgg round {self.task_id!r} is unknown to the "
+                    "server (never joined, or evicted)")
             if roster:
                 self._roster = roster
                 return roster

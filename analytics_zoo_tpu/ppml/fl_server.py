@@ -244,8 +244,11 @@ class FLServer:
     def _secagg_roster(self, request: bytes, context) -> bytes:
         task_id = P.dec_download_intersection_request(request)
         rnd = self._secagg_round(task_id)
-        roster = rnd.roster_if_full() if rnd is not None else None
-        return P.enc_secagg_roster(roster or {})
+        if rnd is None:
+            # same fast-fail sentinel as DownloadSum: an unknown/
+            # evicted round must not look like a still-filling roster
+            return P.enc_secagg_roster({"__unknown_round__": 1})
+        return P.enc_secagg_roster(rnd.roster_if_full() or {})
 
     def _secagg_upload(self, request: bytes, context) -> bytes:
         task_id, client_id, tensors = P.dec_masked_table(request)

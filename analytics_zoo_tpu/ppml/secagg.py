@@ -14,7 +14,8 @@ Mechanics:
   2048-bit MODP prime (generator 2), pure-python `pow` — no external
   crypto dependency.  Client i and j both derive
   seed_ij = SHA256(g^(x_i * x_j) mod p).
-* Masks: a SHA256-counter PRG expands seed_ij into int64 words;
+* Masks: a SHAKE-256 XOF (one call per tensor) expands seed_ij ||
+  tensor-name into int64 words;
   client i ADDS mask_ij for every j > i and SUBTRACTS it for j < i,
   so the server-side sum over all clients telescopes to zero.
 * Exactness: floats don't cancel, so updates are fixed-point-quantized

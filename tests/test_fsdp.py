@@ -1,156 +1,61 @@
-"""FSDP (ZeRO-3-style full parameter sharding over the "fsdp" mesh axis).
+"""FSDP tests, each executed in an isolated child process with
+signal-death retry.
 
-The reference's only parallelism is data-parallel (SURVEY.md §2.3); fsdp
-is a TPU-native extension: parameters AND optimizer state are sharded
-over "fsdp" by `infer_param_shardings` rules, the batch is sharded over
-("dp", "fsdp") (DATA_AXES), and XLA inserts the all-gather (forward /
-backward) and reduce-scatter (grad) collectives — the scaling-playbook
-recipe, no hand-written comms.
-
-Parity contract: an fsdp run is numerically the SAME training trajectory
-as pure DP — sharding is layout, not math (analog of the reference's
-`compareOutputAndGradInput` golden tests, ZooSpecHelper.scala:34).
-"""
+The cases themselves live in tests/_fsdp_cases.py (not collected
+directly).  Why the indirection: XLA:CPU emulates collectives with a
+thread rendezvous that can — rarely, under this 1-core sandbox's load —
+miss its ~40s terminate timeout and SIGABRT the entire process (the
+same emulation artifact __graft_entry__._spawn_child retries around;
+raising the timeout via --xla_cpu_collective_call_terminate_timeout_
+seconds was tried and converts the abort into an unbounded hang, so
+fail-fast + retry is the right shape).  The fsdp cases are the
+suite's most collective-heavy (ZeRO-3 all-gather/reduce-scatter on
+every step plus resharded restores) and were the observed crash site
+in four separate full-suite runs; isolating them keeps a flake from
+killing the other 400+ tests.  The TPU path has no such rendezvous."""
 
 import os
+import subprocess
+import sys
 
-import jax
-import numpy as np
-import optax
 import pytest
-from jax.sharding import Mesh
 
-from analytics_zoo_tpu.models.bert import BERT_SHARD_RULES, BERTClassifier
-from analytics_zoo_tpu.orca.learn.checkpoint import (
-    load_checkpoint,
-    save_checkpoint,
-)
-from analytics_zoo_tpu.orca.learn.flax_adapter import flax_apply_fn, init_flax
-from analytics_zoo_tpu.orca.learn.losses import (
-    sparse_categorical_crossentropy,
-)
-from analytics_zoo_tpu.orca.learn.spmd import SPMDEngine
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _mesh(*axes):
-    """Mesh over the 8 virtual CPU devices, e.g. _mesh(("dp",2),("fsdp",4))."""
-    names = tuple(a for a, _ in axes)
-    shape = tuple(n for _, n in axes)
-    n = int(np.prod(shape))
-    return Mesh(np.asarray(jax.devices()[:n]).reshape(shape), names)
+def _collect_cases():
+    """Scan the cases file textually (importing it would pull jax into
+    this wrapper process) so new cases can never be silently skipped."""
+    import re
+
+    src = open(os.path.join(_REPO, "tests", "_fsdp_cases.py")).read()
+    cases = re.findall(r"^def (test_\w+)", src, re.M)
+    assert cases, "no cases found in tests/_fsdp_cases.py"
+    return cases
 
 
-def _bert_mini(seq=16):
-    return BERTClassifier(num_classes=2, vocab=64, hidden_size=32,
-                          n_block=2, n_head=4, intermediate_size=64,
-                          max_position_len=seq, hidden_drop=0.0,
-                          attn_drop=0.0, attn_impl="einsum")
+_CASES = _collect_cases()
 
 
-def _data(n=32, seq=16, vocab=64, seed=0):
-    rng = np.random.default_rng(seed)
-    ids = rng.integers(0, vocab, (n, seq)).astype(np.int32)
-    seg = np.zeros((n, seq), np.int32)
-    msk = np.ones((n, seq), np.int32)
-    y = rng.integers(0, 2, n).astype(np.int32)
-    return ids, seg, msk, y
-
-
-def _engine(mesh, seq=16):
-    model = _bert_mini(seq)
-    ids, seg, msk, _ = _data(n=1, seq=seq)
-    params, model_state = init_flax(model, (ids, seg, msk))
-    return SPMDEngine(
-        apply_fn=flax_apply_fn(model),
-        params=params,
-        optimizer=optax.adamw(1e-3),
-        loss_fn=sparse_categorical_crossentropy,
-        model_state=model_state,
-        mesh=mesh,
-        shard_rules=dict(BERT_SHARD_RULES))
-
-
-def _train_epochs(engine, epochs=2, batch_size=8):
-    ids, seg, msk, y = _data()
-    dds = engine.cache_dataset((ids, seg, msk), (y,), batch_size)
-    return [engine.run_epoch_device(dds, train=True)["loss"]
-            for _ in range(epochs)]
-
-
-def _specs(tree):
-    return jax.tree_util.tree_map(
-        lambda a: str(getattr(a.sharding, "spec", "")), tree)
-
-
-def test_fsdp_shards_params_and_opt_state():
-    """Every weight matrix (incl. non-tp heads) is sharded over "fsdp";
-    so is the optimizer state (ZeRO: the adam moments follow the
-    params' sharding via optax zeros_like init)."""
-    engine = _engine(_mesh(("dp", 2), ("fsdp", 4)))
-    specs = _specs(engine.state.params)
-    flat = jax.tree_util.tree_flatten_with_path(specs)[0]
-    kernel_specs = ["/".join(str(getattr(k, "key", k)) for k in path)
-                    for path, s in flat if "fsdp" in s]
-    assert any("qkv" in p for p in kernel_specs), kernel_specs
-    assert any("pooler" in p or "head" in p or "classif" in p.lower()
-               for p in kernel_specs), \
-        f"non-tp kernels not fsdp-sharded: {kernel_specs}"
-    # optimizer state (adam mu/nu) carries the same sharding
-    opt_specs = [s for _, s in jax.tree_util.tree_flatten_with_path(
-        _specs(engine.state.opt_state))[0]]
-    assert any("fsdp" in s for s in opt_specs), opt_specs
-
-
-def test_fsdp_loss_parity_with_pure_dp():
-    """Same seeds/data: a dp2×fsdp4 run reproduces the dp8 trajectory —
-    sharding changes the layout and collectives, not the math."""
-    losses_fsdp = _train_epochs(_engine(_mesh(("dp", 2), ("fsdp", 4))))
-    losses_dp = _train_epochs(_engine(_mesh(("dp", 8))))
-    np.testing.assert_allclose(losses_fsdp, losses_dp, rtol=2e-3)
-    # the loss must actually go down for the parity to mean anything
-    assert losses_dp[-1] < losses_dp[0]
-
-
-def test_checkpoint_restores_across_mesh_shapes(tmp_path):
-    """Save from dp2×fsdp4, restore onto dp8 AND dp4×fsdp2: the orbax
-    checkpoint is layout-free — each target reshards on read (the pod
-    story the reference's rank-0 pickle couldn't tell,
-    torch_runner.py:369-410)."""
-    src = _engine(_mesh(("dp", 2), ("fsdp", 4)))
-    _train_epochs(src, epochs=1)
-    path = save_checkpoint(str(tmp_path / "ckpt"), src.state)
-    want = jax.device_get(src.state.params)
-
-    for axes in [(("dp", 8),), (("dp", 4), ("fsdp", 2))]:
-        dst = _engine(_mesh(*axes))
-        dst.state = load_checkpoint(path, dst.state)
-        got = jax.device_get(dst.state.params)
-        jax.tree_util.tree_map(
-            lambda a, b: np.testing.assert_array_equal(a, b), want, got)
-        # restored state must keep the TARGET mesh's shardings…
-        qkv = dst.state.params["bert"]["blocks"]["attn"]["qkv"]["kernel"]
-        assert qkv.sharding.mesh.axis_names == dst.mesh.axis_names
-        # …and still train.  One guarded step, not another epoch scan:
-        # the scan path is covered by the parity test, and XLA:CPU's
-        # thread-rendezvous collective emulation gets fragile as scan
-        # programs accumulate in one process (see tests/conftest.py).
-        ids, seg, msk, y = _data(n=8)
-        batch = dst.put_batch({"features": (ids, seg, msk),
-                               "labels": (y,),
-                               "mask": np.ones(8, np.float32)})
-        dst.state, stats = dst._train_step(dst.state, batch)
-        assert np.isfinite(float(stats["loss"]))
-
-
-def test_checkpoint_files_are_sharded_not_pickled(tmp_path):
-    """The on-disk form is an orbax sharded store (per-shard writes from
-    each host), not a single whole-tree pickle."""
-    engine = _engine(_mesh(("dp", 2), ("fsdp", 4)))
-    path = save_checkpoint(str(tmp_path / "ckpt"), engine.state)
-    names = set()
-    for root, _dirs, files in os.walk(path):
-        names.update(files)
-    assert not any(n.endswith((".pkl", ".pickle")) for n in names), names
-    assert any("ocdbt" in n or n == "manifest.ocdbt" or "zarr" in n.lower()
-               or n == "_METADATA" for n in names) or "d" in os.listdir(path), \
-        sorted(names)
+@pytest.mark.parametrize("case", _CASES)
+def test_fsdp_case_in_child(case):
+    last_rc = None
+    for attempt in range(3):
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             f"tests/_fsdp_cases.py::{case}", "-q",
+             "-p", "no:cacheprovider"],
+            cwd=_REPO, capture_output=True, text=True)
+        last_rc = proc.returncode
+        if last_rc == 0:
+            return
+        if 0 < last_rc < 128:
+            # a real test failure/collection error — show it, no retry
+            raise AssertionError(
+                f"{case} failed in child (rc={last_rc}):\n"
+                + proc.stdout[-4000:] + proc.stderr[-2000:])
+        # signal death (rc<0 from direct kill, or 128+sig via shells):
+        # the XLA:CPU rendezvous abort — retry in a fresh process
+    raise AssertionError(
+        f"{case} died on a signal in 3 consecutive children "
+        f"(last rc={last_rc}) — beyond rendezvous-flake odds")
