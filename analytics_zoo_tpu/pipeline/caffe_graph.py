@@ -46,9 +46,17 @@ def _parse_blob(buf: bytes) -> np.ndarray:
                     shape.extend(_packed_ints(v2, wt2))
         elif fnum == 5:   # data (packed float)
             data.extend(_packed_floats(val, wt))
-        elif fnum == 9:   # double_data
+        elif fnum == 8:   # double_data (packed, or one fixed64 per tag)
             if wt == 2:
                 data.extend(np.frombuffer(val, "<f8").tolist())
+            elif wt == 1:
+                data.append(float(np.frombuffer(val, "<f8")[0]))
+            else:
+                raise ValueError(
+                    f"double_data with unexpected wire type {wt}; "
+                    "dropping it would silently truncate the blob")
+        # 6 (diff) and 9 (double_diff) are solver gradient state —
+        # deliberately ignored, never mistaken for weights
         elif fnum in (1, 2, 3, 4):  # legacy num/channels/height/width
             legacy[fnum] = val
     if not shape and legacy:

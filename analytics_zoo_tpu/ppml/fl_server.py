@@ -224,10 +224,28 @@ class FLServer:
                         and t != task_id]
                 for t in done[:-self._SECAGG_KEEP]:
                     del self._secagg[t]
-                while len(self._secagg) > self._SECAGG_TOTAL:
-                    oldest = next(t for t in self._secagg
-                                  if t != task_id)
-                    del self._secagg[oldest]
+                if len(self._secagg) > self._SECAGG_TOTAL:
+                    # preference order: completed (sum already
+                    # fetchable), then idle rosters (joined but nothing
+                    # uploaded), then — only as a last resort against a
+                    # task_id-minting client — active mid-protocol
+                    # rounds, oldest first within each class
+                    def _evict_class(r):
+                        if r.sum_if_ready() is not None:
+                            return 0
+                        if not r.uploads:
+                            # a FULL roster with no uploads yet is mid-
+                            # protocol (peers are computing masks), not
+                            # abandoned — rank it behind partial rosters
+                            # so a task_id-minting client can't flush it
+                            return 1 if r.roster_if_full() is None else 2
+                        return 3
+                    victims = sorted(
+                        (t for t in self._secagg if t != task_id),
+                        key=lambda t: _evict_class(self._secagg[t]))
+                    for t in victims[:len(self._secagg)
+                                     - self._SECAGG_TOTAL]:
+                        del self._secagg[t]
             rnd = self._secagg[task_id]
             if frac_bits is not None and frac_bits != rnd.frac_bits:
                 raise ValueError(
@@ -237,6 +255,11 @@ class FLServer:
 
     def _secagg_join(self, request: bytes, context) -> bytes:
         task_id, client_id, pub, frac_bits = P.dec_secagg_join(request)
+        if not client_id or client_id == "__unknown_round__":
+            # empty ids can't be addressed in the roster, and the
+            # literal sentinel would make honest peers mistake a full
+            # roster for an evicted round (see _secagg_roster)
+            raise ValueError(f"reserved/empty client_id {client_id!r}")
         self._secagg_round(task_id, frac_bits,
                            create=True).join(client_id, pub)
         return P.enc_status_response(task_id, 0)

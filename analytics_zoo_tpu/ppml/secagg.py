@@ -198,6 +198,14 @@ class SecAggRound:
 
     def upload(self, client_id: str, masked: Dict[str, np.ndarray]):
         with self._lock:
+            if len(self.roster) < self.client_num:
+                # an upload before the roster fills would finalize a
+                # partial round: a lone client's masks have no peers to
+                # cancel against, so its raw quantized update would be
+                # published as the sum and later joins rejected
+                raise RuntimeError(
+                    f"roster has {len(self.roster)}/{self.client_num} "
+                    "members; uploads open only once the roster is full")
             if client_id not in self.roster:
                 raise ValueError(f"{client_id!r} never joined the round")
             if self._sum is not None:

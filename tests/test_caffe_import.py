@@ -210,3 +210,26 @@ def test_inplace_terminal_layer_output():
     assert net.output_names == ["fc"]
     x = np.array([[2.0, -3.0]], np.float32)
     assert np.allclose(net.predict(x), np.maximum(x @ w.T, 0))
+
+
+def test_double_data_blob_packed_and_unpacked():
+    """double_data (BlobProto field 8) arrives packed (wire 2) from
+    caffe's own serializer but one-fixed64-per-tag (wire 1) from strict
+    encoders; both must decode, not silently truncate the blob.  Field
+    9 is double_DIFF — solver gradient state — and must be ignored,
+    never parsed as weights."""
+    from analytics_zoo_tpu.pipeline.caffe_graph import _parse_blob
+
+    vals = np.array([1.5, -2.25, 3.0], np.float64)
+    shape = b"".join(_tag(1, 0) + _varint(d) for d in (3,))
+    packed = (_len_delim(7, shape)
+              + _len_delim(8, vals.astype("<f8").tobytes()))
+    unpacked = _len_delim(7, shape) + b"".join(
+        _tag(8, 1) + v.astype("<f8").tobytes() for v in vals)
+    assert np.allclose(_parse_blob(packed), vals)
+    assert np.allclose(_parse_blob(unpacked), vals)
+    # a snapshot carrying double_diff alongside double_data keeps only
+    # the weights
+    diffs = np.array([9.0, 9.0, 9.0], np.float64)
+    with_diff = (packed + _len_delim(9, diffs.astype("<f8").tobytes()))
+    assert np.allclose(_parse_blob(with_diff), vals)

@@ -321,3 +321,62 @@ def test_secagg_unknown_round_fails_fast():
         c.close()
     finally:
         server.stop()
+
+
+def test_secagg_upload_requires_full_roster():
+    """A client that joins and uploads before the roster fills must be
+    refused: with no peers joined, its pairwise masks have nothing to
+    cancel against, so finalizing would publish its RAW quantized
+    update as the round sum and wedge every later join."""
+    from analytics_zoo_tpu.ppml.secagg import SecAggRound, dh_keypair
+
+    r = SecAggRound(client_num=2)
+    (pa, ga), (pb, gb) = dh_keypair(), dh_keypair()
+    r.join("a", ga)
+    with pytest.raises(RuntimeError, match="roster has 1/2"):
+        r.upload("a", {"w": np.zeros(2, np.int64)})
+    assert r.sum_if_ready() is None
+    # once the roster fills, the same upload goes through
+    r.join("b", gb)
+    r.upload("a", {"w": np.zeros(2, np.int64)})
+    r.upload("b", {"w": np.zeros(2, np.int64)})
+    assert r.sum_if_ready() is not None
+
+
+def test_secagg_eviction_prefers_idle_and_reserved_id_rejected():
+    from analytics_zoo_tpu.ppml.fl_server import FLServer
+    from analytics_zoo_tpu.ppml.secagg import dh_keypair
+
+    server = FLServer(client_num=2)
+    try:
+        server._SECAGG_TOTAL = 4
+        (pa, ga), (pb, gb) = dh_keypair(), dh_keypair()
+        # ACTIVE rounds: a full roster whose peers are still computing
+        # masks, and one with a masked upload already in flight
+        armed = server._secagg_round("armed", create=True)
+        armed.join("a", ga)
+        armed.join("b", gb)
+        active = server._secagg_round("active", create=True)
+        active.join("a", ga)
+        active.join("b", gb)
+        active.upload("a", {"w": np.zeros(2, np.int64)})
+        # idle rounds (joined-only) fill the table past the cap
+        for i in range(5):
+            server._secagg_round(f"idle{i}", create=True)
+        # the cap evicted idle partial rosters, never the mid-protocol
+        # rounds — including the full-but-not-yet-uploading one
+        assert "active" in server._secagg
+        assert "armed" in server._secagg
+        assert len(server._secagg) <= 4
+        # the roster sentinel and empty ids are refused at Join
+        import grpc
+
+        server.start()
+        from analytics_zoo_tpu.ppml.fl_client import SecAggClient
+
+        target = f"{server.host}:{server.port}"
+        for bad in ("__unknown_round__", ""):
+            with pytest.raises(grpc.RpcError):
+                SecAggClient(target, bad, task_id="t-bad").join()
+    finally:
+        server.stop()
