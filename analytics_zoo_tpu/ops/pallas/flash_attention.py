@@ -18,11 +18,20 @@ Masking / biasing / dropout (so real training configs can select flash —
 VERDICT r3 weak #4):
   * `kv_mask` [batch, t] key-validity 1/0 mask, broadcast over heads;
     fully-masked rows return zeros, not NaN.
-  * `bias` [batch, 1|heads, t, t] additive attention bias, streamed
-    blockwise (it is already materialized by the caller; flash just never
-    materializes p).  The bias is treated as a constant: no gradient flows
-    to it (padding/causal biases have none; for a LEARNABLE bias — T5
-    relative positions — use the einsum path).
+  * `bias` [1|batch, 1|heads, t, t] additive attention bias, streamed
+    blockwise; broadcast batch/head dims are resolved by the kernel's
+    index maps, so e.g. a T5-style [1, h, t, t] bias occupies one copy in
+    HBM no matter the batch.  The bias is DIFFERENTIABLE (r5): dbias_ij =
+    ds_ij = p_ij*(dp_ij - delta_i);
+    a dedicated backward pass (`_bwd_dbias_kernel`) recomputes and writes
+    each [bq, bk] tile once into a per-head [bh, t, t] gradient, then
+    broadcast dims are sum-reduced outside the kernel.  Learnable biases
+    (T5 relative positions) therefore no longer force the einsum path.
+    The dbias pass is a separate pallas_call precisely so that CONSTANT
+    biases (padding/causal masks) never pay for it: their cotangent is
+    dead code and jax/XLA eliminate the whole call, keeping the r4 cost.
+    When it does run, the gradient is O(bh*t^2) HBM transiently — same
+    order as einsum's materialized scores.
   * `dropout_rate`: attention-probability dropout via a counter-based
     hash RNG (xorshift-multiply of the global (row, col, batch*head, seed)
     position).  A pure function of position means the forward and both
@@ -175,31 +184,36 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q: int, block_k: int,
         lse_ref[0] = m_scr[:, 0:1] + jnp.log(l)
 
 
-def _bias_spec(block_q, block_k, per_head, h, qk_order):
-    """BlockSpec for the streamed [bh|b, t, t] bias.  qk_order=True means
-    grid axes are (b, qi, ki); False means (b, ki, qi)."""
+def _bias_spec(block_q, block_k, per_head, batched, h, qk_order):
+    """BlockSpec for the streamed bias.  The grid's axis 0 is bh =
+    batch*h + head; the primal bias may broadcast over batch, heads or
+    both, so the leading index projects bh accordingly — the kernel
+    reads the same HBM block for every broadcast replica instead of the
+    caller materializing copies.  qk_order=True means grid axes are
+    (bh, qi, ki); False means (bh, ki, qi)."""
+    if per_head and batched:
+        lead = lambda b: b              # [b*h, t, t]
+    elif per_head:
+        lead = lambda b: b % h          # [h, t, t]
+    elif batched:
+        lead = lambda b: b // h         # [b, t, t]
+    else:
+        lead = lambda b: 0              # [1, t, t]
     if qk_order:
-        if per_head:
-            return pl.BlockSpec((1, block_q, block_k),
-                                lambda b, i, j: (b, i, j),
-                                memory_space=pltpu.VMEM)
         return pl.BlockSpec((1, block_q, block_k),
-                            lambda b, i, j: (b // h, i, j),
-                            memory_space=pltpu.VMEM)
-    if per_head:
-        return pl.BlockSpec((1, block_q, block_k),
-                            lambda b, i, j: (b, j, i),
+                            lambda b, i, j: (lead(b), i, j),
                             memory_space=pltpu.VMEM)
     return pl.BlockSpec((1, block_q, block_k),
-                        lambda b, i, j: (b // h, j, i),
+                        lambda b, i, j: (lead(b), j, i),
                         memory_space=pltpu.VMEM)
 
 
 def _flash_fwd(q, k, v, kv_mask, bias, seed, *, block_q: int, block_k: int,
                causal: bool, dropout: float, h: int, bias_per_head: bool,
-               interpret: bool):
-    """q, k, v: [bh, t, d]; kv_mask: [bh, t] or None; bias: [bh|b, t, t]
-    or None; seed: [1] int32 -> (out [bh, t, d], lse [bh, t, 1])."""
+               bias_batched: bool, interpret: bool):
+    """q, k, v: [bh, t, d]; kv_mask: [bh, t] or None; bias:
+    [bh|b|h|1, t, t] or None (leading dim per the broadcast flags);
+    seed: [1] int32 -> (out [bh, t, d], lse [bh, t, 1])."""
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
     num_k = t // block_k
@@ -223,8 +237,8 @@ def _flash_fwd(q, k, v, kv_mask, bias, seed, *, block_q: int, block_k: int,
         args.append(jnp.broadcast_to(
             kv_mask.astype(jnp.int32)[:, None, :], (bh, 8, t)))
     if has_bias:
-        in_specs.append(_bias_spec(block_q, block_k, bias_per_head, h,
-                                   qk_order=True))
+        in_specs.append(_bias_spec(block_q, block_k, bias_per_head,
+                                   bias_batched, h, qk_order=True))
         args.append(bias)
     if dropout > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
@@ -336,6 +350,54 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
+def _bwd_dbias_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                      *rest, block_q: int, block_k: int, causal: bool,
+                      has_mask: bool, dropout: float, scale: float):
+    # Standalone dbias pass: d s / d bias = 1, so the bias cotangent IS
+    # ds = p*(dp - delta), recomputed here exactly as in the dQ kernel.
+    # It is a SEPARATE pallas_call (not an extra dQ output) on purpose:
+    # when nothing differentiates the bias (constant additive masks),
+    # this whole call is dead code and jax/XLA eliminate it, so the
+    # O(bh*t^2) gradient is only ever materialized for genuinely
+    # learnable biases.  Grid (bh, qi, ki); each tile written once.
+    rest = list(rest)
+    mask_ref = rest.pop(0) if has_mask else None
+    bias_ref = rest.pop(0)
+    seed_ref = rest.pop(0) if dropout > 0.0 else None
+    (dbias_ref,) = rest
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+    live = (k_start <= q_start + block_q - 1) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _compute():
+        p = _recompute_p(q_ref, k_ref, bias_ref, mask_ref, lse_ref,
+                         q_start=q_start, k_start=k_start,
+                         block_q=block_q, block_k=block_k,
+                         causal=causal, scale=scale)
+        g = g_ref[0]
+        v = v_ref[0]
+        prec = (jax.lax.Precision.HIGHEST
+                if v.dtype == jnp.float32 else None)
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            precision=prec,
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        if dropout > 0.0:
+            keep_d = _drop_keep(seed_ref[0], b, q_start, k_start,
+                                block_q, block_k, dropout)
+            dp = jnp.where(keep_d, dp * (1.0 / (1.0 - dropout)), 0.0)
+        dbias_ref[0] = (p * (dp - delta_ref[0])).astype(dbias_ref.dtype)
+
+    @pl.when(jnp.logical_not(live))
+    def _dead():
+        # causal-skipped tiles still own their dbias block: zero it
+        dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
+
+
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
                     block_q: int, block_k: int, num_q: int, causal: bool,
                     has_mask: bool, has_bias: bool, dropout: float,
@@ -401,8 +463,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
 
 def _flash_bwd(q, k, v, kv_mask, bias, seed, out, lse, g, dlse, *,
                block_q: int, block_k: int, causal: bool, dropout: float,
-               h: int, bias_per_head: bool, interpret: bool):
-    """Pallas backward: returns (dq, dk, dv)."""
+               h: int, bias_per_head: bool, bias_batched: bool,
+               interpret: bool):
+    """Pallas backward: returns (dq, dk, dv, dbias-or-None).  dbias is
+    emitted per-head-per-batch [bh, t, t] by the dedicated
+    `_bwd_dbias_kernel` pass (DCE'd when unused); biases that broadcast
+    over heads and/or batch get the matching sum-reduction here,
+    outside the kernel (the vjp of the broadcast)."""
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
     num_q = t // block_q
@@ -448,8 +515,8 @@ def _flash_bwd(q, k, v, kv_mask, bias, seed, out, lse, g, dlse, *,
                                       memory_space=pltpu.VMEM))
             args.append(mask_arg)
         if has_bias:
-            specs.append(_bias_spec(block_q, block_k, bias_per_head, h,
-                                    qk_order=qk_order))
+            specs.append(_bias_spec(block_q, block_k, bias_per_head,
+                                    bias_batched, h, qk_order=qk_order))
             args.append(bias)
         if dropout > 0.0:
             specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
@@ -470,6 +537,30 @@ def _flash_bwd(q, k, v, kv_mask, bias, seed, out, lse, g, dlse, *,
         interpret=interpret,
     )(*args)
 
+    dbias = None
+    if has_bias:
+        # separate call so it DCEs away when the bias cotangent is
+        # unused (see _bwd_dbias_kernel)
+        dbias = pl.pallas_call(
+            partial(_bwd_dbias_kernel, block_q=block_q, block_k=block_k,
+                    causal=causal, has_mask=has_mask, dropout=dropout,
+                    scale=scale),
+            out_shape=jax.ShapeDtypeStruct((bh, t, t), bias.dtype),
+            grid=(bh, num_q, num_k),
+            in_specs=specs,
+            out_specs=pl.BlockSpec((1, block_q, block_k),
+                                   lambda b, i, j: (b, i, j),
+                                   memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(*args)
+        dbias = dbias.reshape(bh // h, h, t, t)
+        if not bias_batched:
+            dbias = dbias.sum(axis=0, keepdims=True)
+        if not bias_per_head:
+            dbias = dbias.sum(axis=1, keepdims=True)
+        # back to the primal bias_arr's collapsed leading dim
+        dbias = dbias.reshape(-1, t, t)
+
     specs, args = common_specs(qk_order=False)
     dk, dv = pl.pallas_call(
         partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
@@ -488,7 +579,7 @@ def _flash_bwd(q, k, v, kv_mask, bias, seed, out, lse, g, dlse, *,
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(*args)
-    return dq, dk, dv
+    return dq, dk, dv, dbias
 
 
 def _reference_attn(q, k, v, causal: bool, kv_mask=None, bias=None,
@@ -533,9 +624,11 @@ def _reference_attn(q, k, v, causal: bool, kv_mask=None, bias=None,
     return _einsum("bts,bsd->btd", p.astype(v.dtype), v), lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13, 14))
+@partial(jax.custom_vjp,
+         nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13, 14, 15))
 def _flash(q, k, v, kv_mask, bias, seed, block_q, block_k, causal,
-           dropout, h, bias_per_head, interpret, bwd_block_q, bwd_block_k):
+           dropout, h, bias_per_head, bias_batched, interpret,
+           bwd_block_q, bwd_block_k):
     """Returns (out, lse [bh, t, 1]).  Differentiable in BOTH outputs:
     the lse cotangent folds into the backward's delta term
     (d lse_i / d s_ij = p_ij, so ds += p * dlse — i.e. delta -= dlse),
@@ -544,32 +637,31 @@ def _flash(q, k, v, kv_mask, bias, seed, block_q, block_k, causal,
     return _flash_fwd(
         q, k, v, kv_mask, bias, seed, block_q=block_q, block_k=block_k,
         causal=causal, dropout=dropout, h=h, bias_per_head=bias_per_head,
-        interpret=interpret)
+        bias_batched=bias_batched, interpret=interpret)
 
 
 def _flash_vjp_fwd(q, k, v, kv_mask, bias, seed, block_q, block_k, causal,
-                   dropout, h, bias_per_head, interpret, bwd_block_q,
-                   bwd_block_k):
+                   dropout, h, bias_per_head, bias_batched, interpret,
+                   bwd_block_q, bwd_block_k):
     out, lse = _flash_fwd(
         q, k, v, kv_mask, bias, seed, block_q=block_q, block_k=block_k,
         causal=causal, dropout=dropout, h=h, bias_per_head=bias_per_head,
-        interpret=interpret)
+        bias_batched=bias_batched, interpret=interpret)
     return (out, lse), (q, k, v, kv_mask, bias, seed, out, lse)
 
 
 def _flash_vjp_bwd(block_q, block_k, causal, dropout, h, bias_per_head,
-                   interpret, bwd_block_q, bwd_block_k, res, g):
+                   bias_batched, interpret, bwd_block_q, bwd_block_k,
+                   res, g):
     q, k, v, kv_mask, bias, seed, out, lse = res
     do, dlse = g
-    dq, dk, dv = _flash_bwd(
+    dq, dk, dv, dbias = _flash_bwd(
         q, k, v, kv_mask, bias, seed, out, lse, do, dlse,
         block_q=bwd_block_q, block_k=bwd_block_k, causal=causal,
         dropout=dropout, h=h, bias_per_head=bias_per_head,
-        interpret=interpret)
-    # bias is a constant in this kernel (padding/causal biases have no
-    # gradient; learnable biases go through the einsum path) and the
-    # seed is integral — zero/None cotangents
-    dbias = jnp.zeros_like(bias) if bias is not None else None
+        bias_batched=bias_batched, interpret=interpret)
+    # mask and seed are integral — None cotangents; dbias comes from the
+    # dedicated _bwd_dbias_kernel pass (None when no bias was passed)
     return dq, dk, dv, None, dbias, None
 
 
@@ -588,9 +680,12 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
 
     kv_mask: optional [batch, t] key-validity mask (1 = attend, 0 = pad),
     broadcast over heads.
-    bias: optional additive attention bias [batch, 1|heads, t, t],
-    streamed blockwise; treated as a constant (no gradient — use the
-    einsum path for learnable biases).
+    bias: optional additive attention bias [1|batch, 1|heads, t, t]
+    (broadcast dims are streamed in place, never copied), blockwise and
+    DIFFERENTIABLE — learnable biases (T5 relative positions, see
+    keras.layers.self_attention.RelativePositionBias) train through the
+    kernel; the per-head gradient tiles are reduced over broadcast dims
+    outside the kernel.
     dropout_rate / dropout_rng: attention-probability dropout; the rng
     key is folded into an int32 seed for the positional hash RNG, so the
     forward and backward kernels agree on the keep mask without a [T, T]
@@ -633,19 +728,21 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
                 "(BTHD), not BHTD")
         mask_bh = jnp.repeat(kv_mask.astype(jnp.int32), h, axis=0)  # [b*h, t]
 
-    bias_per_head = False
+    bias_per_head = bias_batched = False
     bias_arr = None
     if bias is not None:
-        if bias.ndim != 4 or bias.shape[0] != b or bias.shape[2:] != (t, t) \
-                or bias.shape[1] not in (1, h):
+        if bias.ndim != 4 or bias.shape[0] not in (1, b) \
+                or bias.shape[2:] != (t, t) or bias.shape[1] not in (1, h):
             raise ValueError(
-                f"bias shape {bias.shape} != (batch, 1|heads, t, t) = "
-                f"({b}, 1|{h}, {t}, {t})")
+                f"bias shape {bias.shape} != (1|batch, 1|heads, t, t) = "
+                f"(1|{b}, 1|{h}, {t}, {t})")
         bias_per_head = bias.shape[1] == h
-        # [bh, t, t] when per-head; [b, t, t] when broadcast (the kernel
-        # index map divides the grid's bh index by h — no h-fold copy)
-        bias_arr = (bias.reshape(b * h, t, t) if bias_per_head
-                    else bias.reshape(b, t, t))
+        bias_batched = bias.shape[0] == b
+        # collapse to [lead, t, t]; the kernel index maps project the
+        # grid's bh index onto whichever dims the bias actually carries
+        # (b % h, b // h, or 0) — broadcasting never copies in HBM, so a
+        # T5-style [1, h, t, t] bias streams one head's tile per step
+        bias_arr = bias.reshape(-1, t, t)
 
     def fit_block(blk: int) -> int:
         # shrink to a divisor of t (lane-aligned) rather than bouncing
@@ -673,8 +770,10 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
     if untiled or mask_unaligned:
         bias_ref = None
         if bias is not None:
-            bias_ref = jax.lax.stop_gradient(
-                jnp.broadcast_to(bias, (b, h, t, t)).reshape(b * h, t, t))
+            # plain autodiff through the broadcast sums the per-head
+            # cotangents back to the caller's [b, 1|h, t, t] shape
+            bias_ref = jnp.broadcast_to(bias, (b, h, t, t)) \
+                .reshape(b * h, t, t)
         out_bh, lse_bh = _reference_attn(
             to_bh(q), to_bh(k), to_bh(v), causal, mask_bh, bias_ref,
             dropout_rate, seed)
@@ -683,6 +782,6 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
     out_bh, lse_bh = _flash(
         to_bh(q), to_bh(k), to_bh(v), mask_bh, bias_arr, seed,
         block_q, block_k, causal, dropout_rate, h, bias_per_head,
-        interpret, bwd_block_q, bwd_block_k)
+        bias_batched, interpret, bwd_block_q, bwd_block_k)
     out = from_bh(out_bh)
     return (out, lse_bthd(lse_bh)) if return_lse else out

@@ -225,24 +225,38 @@ class FLServer:
                 for t in done[:-self._SECAGG_KEEP]:
                     del self._secagg[t]
                 if len(self._secagg) > self._SECAGG_TOTAL:
-                    # preference order: completed (sum already
-                    # fetchable), then idle rosters (joined but nothing
-                    # uploaded), then — only as a last resort against a
-                    # task_id-minting client — active mid-protocol
-                    # rounds, oldest first within each class
-                    def _evict_class(r):
+                    # Overflow cap.  Join is unauthenticated, so EVERY
+                    # eviction class is attacker-mintable (two Joins
+                    # forge a full roster; one more upload forges an
+                    # in-flight round) — no preference order alone can
+                    # protect honest state.  The one guarantee the
+                    # window exists for — "a freshly aggregated sum
+                    # stays fetchable for late DownloadSum polls" — is
+                    # therefore made UNCONDITIONAL: the _SECAGG_KEEP
+                    # most recent completed rounds are exempt from the
+                    # cap.  The rest drain in preference order: idle
+                    # partial rosters, stale completed sums, full
+                    # rosters, then in-flight rounds; oldest first
+                    # within each class.  (Hard DoS resistance needs
+                    # authenticated transport, out of scope here.)
+                    done = [t for t, r in self._secagg.items()
+                            if r.sum_if_ready() is not None]
+                    protected = set(done[-self._SECAGG_KEEP:])
+                    protected.add(task_id)
+
+                    def _evict_class(t):
+                        r = self._secagg[t]
+                        # NB: aggregation leaves uploads as {id: {}} —
+                        # check the sum before treating uploads as
+                        # in-flight state
                         if r.sum_if_ready() is not None:
-                            return 0
-                        if not r.uploads:
-                            # a FULL roster with no uploads yet is mid-
-                            # protocol (peers are computing masks), not
-                            # abandoned — rank it behind partial rosters
-                            # so a task_id-minting client can't flush it
-                            return 1 if r.roster_if_full() is None else 2
-                        return 3
+                            return 1
+                        if r.uploads:
+                            return 3
+                        return 0 if r.roster_if_full() is None else 2
                     victims = sorted(
-                        (t for t in self._secagg if t != task_id),
-                        key=lambda t: _evict_class(self._secagg[t]))
+                        (t for t in self._secagg if t not in protected),
+                        key=_evict_class)
                     for t in victims[:len(self._secagg)
                                      - self._SECAGG_TOTAL]:
                         del self._secagg[t]

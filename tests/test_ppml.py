@@ -360,13 +360,22 @@ def test_secagg_eviction_prefers_idle_and_reserved_id_rejected():
         active.join("a", ga)
         active.join("b", gb)
         active.upload("a", {"w": np.zeros(2, np.int64)})
-        # idle rounds (joined-only) fill the table past the cap
-        for i in range(5):
+        # a COMPLETED round whose sum late pollers may still fetch
+        done = server._secagg_round("done", create=True)
+        done.join("a", ga)
+        done.join("b", gb)
+        done.upload("a", {"w": np.zeros(2, np.int64)})
+        done.upload("b", {"w": np.zeros(2, np.int64)})
+        assert done.sum_if_ready() is not None
+        # an attacker minting idle partial rosters past the cap
+        for i in range(6):
             server._secagg_round(f"idle{i}", create=True)
-        # the cap evicted idle partial rosters, never the mid-protocol
-        # rounds — including the full-but-not-yet-uploading one
+        # the cap drained the attacker's partial rosters FIRST; the
+        # mid-protocol rounds (mask-computing and mid-upload) and the
+        # fetchable completed sum all survive
         assert "active" in server._secagg
         assert "armed" in server._secagg
+        assert "done" in server._secagg
         assert len(server._secagg) <= 4
         # the roster sentinel and empty ids are refused at Join
         import grpc
