@@ -30,15 +30,17 @@ class MultiHeadAttention(nn.Module):
         real training configs can select it)
       * "ring"   — parallel.ring_attention over the mesh "sp" axis
         (sequence parallelism for long context; key-padding masks rotate
-        with K/V; dropout/additive unsupported)
+        with K/V; since r5 attention dropout and additive biases compose
+        with the ring too — same positional-hash dropout stream as
+        flash, bias K-columns sliced per ring step)
       * "auto"   — flash beyond the einsum HBM cliff (t >= 4096), else
         einsum
 
     `mask` is a [batch, t] key-validity mask (1 = attend, 0 = padding),
-    understood by every impl.  A pre-built additive [b, 1|h, tq, tk]
-    float mask is accepted by einsum and flash; since r5 flash's bias is
+    understood by every impl.  A pre-built additive [1|b, 1|h, tq, tk]
+    float mask is accepted by every impl; since r5 flash's bias is
     differentiable (blockwise dbias kernel), so learnable biases train
-    through either; ring raises (ADVICE r1: never drop a mask silently).
+    through any of them.
     """
     hidden_size: int
     n_head: int
@@ -79,22 +81,18 @@ class MultiHeadAttention(nn.Module):
             # so length alone decides.
             impl = "flash" if t >= 4096 else "einsum"
         if impl == "ring":
-            if dropout > 0:
-                raise ValueError(
-                    "attn_impl='ring' does not support attention dropout; "
-                    "set attn_dropout=0 or use attn_impl='einsum'/'flash'")
-            if additive_mask is not None and key_mask is None:
-                raise ValueError(
-                    "attn_impl='ring' only supports [batch, t] key-"
-                    "validity masks, not additive bias masks; pass the raw "
-                    "attention_mask or use attn_impl='einsum'/'flash'")
             from analytics_zoo_tpu.parallel.ring_attention import (
                 ring_self_attention)
+            drop_rng = (self.make_rng("dropout") if dropout > 0 else None)
             # impl="auto": long per-device shards run the Pallas
             # kernel per ring step with exact lse merging; short shards
-            # keep the fused einsum (parallel/ring_attention.py)
-            out = ring_self_attention(q, k, v, causal=self.causal,
-                                      kv_mask=key_mask, impl="auto")
+            # keep the fused einsum (parallel/ring_attention.py);
+            # prefer the factored [b, t] mask (it rotates with K/V)
+            # over streaming the additive form derived from it
+            out = ring_self_attention(
+                q, k, v, causal=self.causal, kv_mask=key_mask,
+                bias=(None if key_mask is not None else additive_mask),
+                dropout_rate=dropout, dropout_rng=drop_rng, impl="auto")
         elif impl == "flash":
             from analytics_zoo_tpu.ops.pallas.flash_attention import (
                 flash_attention)

@@ -23,15 +23,15 @@ VERDICT r3 weak #4):
     index maps, so e.g. a T5-style [1, h, t, t] bias occupies one copy in
     HBM no matter the batch.  The bias is DIFFERENTIABLE (r5): dbias_ij =
     ds_ij = p_ij*(dp_ij - delta_i);
-    a dedicated backward pass (`_bwd_dbias_kernel`) recomputes and writes
-    each [bq, bk] tile once into a per-head [bh, t, t] gradient, then
-    broadcast dims are sum-reduced outside the kernel.  Learnable biases
-    (T5 relative positions) therefore no longer force the einsum path.
-    The dbias pass is a separate pallas_call precisely so that CONSTANT
-    biases (padding/causal masks) never pay for it: their cotangent is
-    dead code and jax/XLA eliminate the whole call, keeping the r4 cost.
-    When it does run, the gradient is O(bh*t^2) HBM transiently — same
-    order as einsum's materialized scores.
+    a dedicated backward pass (`_bwd_dbias_kernel`) recomputes ds
+    blockwise and ACCUMULATES broadcast replicas in VMEM (rep-innermost
+    grid), so the gradient lands in HBM at the PRIMAL bias's own shape —
+    a T5 [1, h, t, t] bias gets an [h, t, t] f32 gradient, never
+    [b*h, t, t].  Learnable biases therefore no longer force the einsum
+    path.  The dbias pass is a separate pallas_call precisely so that
+    CONSTANT biases (padding/causal masks) never pay for it: their
+    cotangent is dead code and jax/XLA eliminate the whole call, keeping
+    the r4 cost.
   * `dropout_rate`: attention-probability dropout via a counter-based
     hash RNG (xorshift-multiply of the global (row, col, batch*head, seed)
     position).  A pure function of position means the forward and both
@@ -80,12 +80,29 @@ def _hash_bits(seed, bh, q_pos, k_pos):
     return h
 
 
-def _drop_keep(seed, bh, q_start, k_start, bq, bk, rate):
-    """[bq, bk] bool keep-mask for dropout at `rate` (static python float)."""
-    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+def drop_keep_mask(seed, bh, q_pos, k_pos, rate: float):
+    """THE keep-mask derivation (hash -> threshold) for attention
+    dropout, shared by the Pallas kernels, the reference fallback and
+    the ring impls (parallel/ring_attention.py) — a single definition
+    is what keeps their bit-parity contract honest.  `seed` scalar,
+    `bh`/`q_pos`/`k_pos` broadcastable int32 coordinate arrays, `rate`
+    a static python float."""
     bits = _hash_bits(seed, bh, q_pos, k_pos) & jnp.int32(0x7FFFFFFF)
     return bits >= jnp.int32(int(rate * 0x7FFFFFFF))
+
+
+def _drop_keep(seed_ref, bh, q_start, k_start, bq, bk, rate):
+    """[bq, bk] bool keep-mask for dropout at `rate` (static python
+    float).  seed_ref is the [3] SMEM scalar block (seed, global q
+    offset, global k offset): the offsets shift the hash coordinates to
+    GLOBAL sequence positions, which is what makes the mask identical
+    whether a row/column is computed locally or as a rotated ring shard
+    (parallel/ring_attention.py)."""
+    q_pos = (seed_ref[1] + q_start
+             + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+    k_pos = (seed_ref[2] + k_start
+             + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
+    return drop_keep_mask(seed_ref[0], bh, q_pos, k_pos, rate)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q: int, block_k: int,
@@ -94,7 +111,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q: int, block_k: int,
     # q_ref: [1, block_q, d]; k_ref/v_ref: [1, block_k, d];
     # (mask_ref: [1, 8, block_k] when has_mask — kv mask broadcast over 8
     # sublanes); (bias_ref: [1, block_q, block_k] when has_bias);
-    # (seed_ref: [1] SMEM when dropout); outputs o_ref [1, block_q, d],
+    # (seed_ref: [3] SMEM (seed, q_off, k_off) when dropout); outputs
+    # o_ref [1, block_q, d],
     # lse_ref [1, block_q, 1];
     # scratch: o_scr [block_q, d] f32, m_scr/l_scr [block_q, 128] f32.
     rest = list(rest)
@@ -162,7 +180,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q: int, block_k: int,
         # masked and rescaled
         l_new = l_prev * alpha + p.sum(axis=1, keepdims=True)
         if dropout > 0.0:
-            keep_d = _drop_keep(seed_ref[0], b, q_start, k_start,
+            keep_d = _drop_keep(seed_ref, b, q_start, k_start,
                                 block_q, block_k, dropout)
             p = jnp.where(keep_d, p * (1.0 / (1.0 - dropout)), 0.0)
         # HIGHEST on bf16 operands fails Mosaic lowering ("Bad lhs type");
@@ -336,7 +354,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
             precision=prec,
             preferred_element_type=jnp.float32)            # [bq, bk]
         if dropout > 0.0:
-            keep_d = _drop_keep(seed_ref[0], b, q_start, k_start,
+            keep_d = _drop_keep(seed_ref, b, q_start, k_start,
                                 block_q, block_k, dropout)
             dp = jnp.where(keep_d, dp * (1.0 / (1.0 - dropout)), 0.0)
         ds = p * (dp - delta_ref[0])                       # delta [bq, 1]
@@ -352,25 +370,39 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
 
 def _bwd_dbias_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                       *rest, block_q: int, block_k: int, causal: bool,
-                      has_mask: bool, dropout: float, scale: float):
+                      has_mask: bool, dropout: float, scale: float,
+                      mul_l: int, mul_r: int):
     # Standalone dbias pass: d s / d bias = 1, so the bias cotangent IS
     # ds = p*(dp - delta), recomputed here exactly as in the dQ kernel.
     # It is a SEPARATE pallas_call (not an extra dQ output) on purpose:
     # when nothing differentiates the bias (constant additive masks),
-    # this whole call is dead code and jax/XLA eliminate it, so the
-    # O(bh*t^2) gradient is only ever materialized for genuinely
-    # learnable biases.  Grid (bh, qi, ki); each tile written once.
+    # this whole call is dead code and jax/XLA eliminate it — the
+    # gradient is only ever materialized for genuinely learnable biases.
+    # Grid (lead, qi, ki, rep): `lead` walks the PRIMAL bias's leading
+    # dim and `rep` its broadcast replicas (bh = mul_l*lead + mul_r*rep)
+    # — rep is innermost, so consecutive steps revisit the same output
+    # block and the broadcast reduction ACCUMULATES in VMEM instead of
+    # materializing [b*h, t, t] in HBM (a T5 [1, h, t, t] bias gets an
+    # [h, t, t] f32 gradient, b-fold smaller).
     rest = list(rest)
     mask_ref = rest.pop(0) if has_mask else None
     bias_ref = rest.pop(0)
     seed_ref = rest.pop(0) if dropout > 0.0 else None
     (dbias_ref,) = rest
-    b = pl.program_id(0)
+    lead = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    rep = pl.program_id(3)
+    bh = mul_l * lead + mul_r * rep
     q_start = qi * block_q
     k_start = ki * block_k
     live = (k_start <= q_start + block_q - 1) if causal else (ki >= 0)
+
+    @pl.when(rep == 0)
+    def _init():
+        # first replica owns the tile: zero it (also covers causal-dead
+        # tiles, which skip the accumulation below entirely)
+        dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
 
     @pl.when(live)
     def _compute():
@@ -387,15 +419,10 @@ def _bwd_dbias_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             precision=prec,
             preferred_element_type=jnp.float32)            # [bq, bk]
         if dropout > 0.0:
-            keep_d = _drop_keep(seed_ref[0], b, q_start, k_start,
+            keep_d = _drop_keep(seed_ref, bh, q_start, k_start,
                                 block_q, block_k, dropout)
             dp = jnp.where(keep_d, dp * (1.0 / (1.0 - dropout)), 0.0)
-        dbias_ref[0] = (p * (dp - delta_ref[0])).astype(dbias_ref.dtype)
-
-    @pl.when(jnp.logical_not(live))
-    def _dead():
-        # causal-skipped tiles still own their dbias block: zero it
-        dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
+        dbias_ref[0] = dbias_ref[0] + p * (dp - delta_ref[0])
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
@@ -438,7 +465,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
             precision=prec,
             preferred_element_type=jnp.float32)            # [bq, bk]
         if dropout > 0.0:
-            keep_d = _drop_keep(seed_ref[0], b, q_start, k_start,
+            keep_d = _drop_keep(seed_ref, b, q_start, k_start,
                                 block_q, block_k, dropout)
             inv = 1.0 / (1.0 - dropout)
             p_v = jnp.where(keep_d, p * inv, 0.0)
@@ -465,11 +492,10 @@ def _flash_bwd(q, k, v, kv_mask, bias, seed, out, lse, g, dlse, *,
                block_q: int, block_k: int, causal: bool, dropout: float,
                h: int, bias_per_head: bool, bias_batched: bool,
                interpret: bool):
-    """Pallas backward: returns (dq, dk, dv, dbias-or-None).  dbias is
-    emitted per-head-per-batch [bh, t, t] by the dedicated
-    `_bwd_dbias_kernel` pass (DCE'd when unused); biases that broadcast
-    over heads and/or batch get the matching sum-reduction here,
-    outside the kernel (the vjp of the broadcast)."""
+    """Pallas backward: returns (dq, dk, dv, dbias-or-None).  dbias
+    comes from the dedicated `_bwd_dbias_kernel` pass (DCE'd when
+    unused), which accumulates broadcast replicas in VMEM and emits the
+    gradient at the collapsed primal shape [lead, t, t]."""
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
     num_q = t // block_q
@@ -540,26 +566,70 @@ def _flash_bwd(q, k, v, kv_mask, bias, seed, out, lse, g, dlse, *,
     dbias = None
     if has_bias:
         # separate call so it DCEs away when the bias cotangent is
-        # unused (see _bwd_dbias_kernel)
+        # unused; grid (lead, qi, ki, rep) accumulates broadcast
+        # replicas in VMEM so the gradient is [lead, t, t], never
+        # [b*h, t, t] (see _bwd_dbias_kernel)
+        if bias_per_head and bias_batched:
+            lead, reps, mul_l, mul_r = bh, 1, 1, 0
+        elif bias_batched:                       # [b, t, t]
+            lead, reps, mul_l, mul_r = bh // h, h, h, 1
+        elif bias_per_head:                      # [h, t, t]
+            lead, reps, mul_l, mul_r = h, bh // h, 1, h
+        else:                                    # [1, t, t]
+            lead, reps, mul_l, mul_r = 1, bh, 0, 1
+
+        def _bh_of(l, r):
+            return mul_l * l + mul_r * r
+
+        dspecs = [
+            pl.BlockSpec((1, block_q, d),
+                         lambda l, i, j, r: (_bh_of(l, r), i, 0),
+                         memory_space=pltpu.VMEM),          # q
+            pl.BlockSpec((1, block_k, d),
+                         lambda l, i, j, r: (_bh_of(l, r), j, 0),
+                         memory_space=pltpu.VMEM),          # k
+            pl.BlockSpec((1, block_k, d),
+                         lambda l, i, j, r: (_bh_of(l, r), j, 0),
+                         memory_space=pltpu.VMEM),          # v
+            pl.BlockSpec((1, block_q, d),
+                         lambda l, i, j, r: (_bh_of(l, r), i, 0),
+                         memory_space=pltpu.VMEM),          # g
+            pl.BlockSpec((1, block_q, 1),
+                         lambda l, i, j, r: (_bh_of(l, r), i, 0),
+                         memory_space=pltpu.VMEM),          # lse
+            pl.BlockSpec((1, block_q, 1),
+                         lambda l, i, j, r: (_bh_of(l, r), i, 0),
+                         memory_space=pltpu.VMEM),          # delta
+        ]
+        dargs = [q, k, v, g, lse, delta]
+        if has_mask:
+            dspecs.append(pl.BlockSpec(
+                (1, 8, block_k),
+                lambda l, i, j, r: (_bh_of(l, r), 0, j),
+                memory_space=pltpu.VMEM))
+            dargs.append(mask_arg)
+        # the bias itself: one block per (lead, i, j), shared by reps
+        dspecs.append(pl.BlockSpec((1, block_q, block_k),
+                                   lambda l, i, j, r: (l, i, j),
+                                   memory_space=pltpu.VMEM))
+        dargs.append(bias)
+        if dropout > 0.0:
+            dspecs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+            dargs.append(seed)
         dbias = pl.pallas_call(
             partial(_bwd_dbias_kernel, block_q=block_q, block_k=block_k,
                     causal=causal, has_mask=has_mask, dropout=dropout,
-                    scale=scale),
-            out_shape=jax.ShapeDtypeStruct((bh, t, t), bias.dtype),
-            grid=(bh, num_q, num_k),
-            in_specs=specs,
+                    scale=scale, mul_l=mul_l, mul_r=mul_r),
+            out_shape=jax.ShapeDtypeStruct((lead, t, t), jnp.float32),
+            grid=(lead, num_q, num_k, reps),
+            in_specs=dspecs,
             out_specs=pl.BlockSpec((1, block_q, block_k),
-                                   lambda b, i, j: (b, i, j),
+                                   lambda l, i, j, r: (l, i, j),
                                    memory_space=pltpu.VMEM),
             interpret=interpret,
-        )(*args)
-        dbias = dbias.reshape(bh // h, h, t, t)
-        if not bias_batched:
-            dbias = dbias.sum(axis=0, keepdims=True)
-        if not bias_per_head:
-            dbias = dbias.sum(axis=1, keepdims=True)
-        # back to the primal bias_arr's collapsed leading dim
-        dbias = dbias.reshape(-1, t, t)
+        )(*dargs)
+        # f32 accumulation in-kernel; cotangent dtype must match primal
+        dbias = dbias.astype(bias.dtype)
 
     specs, args = common_specs(qk_order=False)
     dk, dv = pl.pallas_call(
@@ -614,12 +684,10 @@ def _reference_attn(q, k, v, causal: bool, kv_mask=None, bias=None,
     p = p / l
     if dropout > 0.0:
         bh = q.shape[0]
-        q_pos = jnp.arange(t)[None, :, None]
-        k_pos = jnp.arange(t)[None, None, :]
+        q_pos = seed[1] + jnp.arange(t)[None, :, None]
+        k_pos = seed[2] + jnp.arange(t)[None, None, :]
         b_idx = jnp.arange(bh)[:, None, None]
-        bits = _hash_bits(seed[0], b_idx, q_pos, k_pos) \
-            & jnp.int32(0x7FFFFFFF)
-        keep_d = bits >= jnp.int32(int(dropout * 0x7FFFFFFF))
+        keep_d = drop_keep_mask(seed[0], b_idx, q_pos, k_pos, dropout)
         p = jnp.where(keep_d, p * (1.0 / (1.0 - dropout)), 0.0)
     return _einsum("bts,bsd->btd", p.astype(v.dtype), v), lse
 
@@ -670,6 +738,7 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
                     dropout_rate: float = 0.0, dropout_rng=None,
+                    dropout_seed=None, dropout_pos=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     bwd_block_q: int = DEFAULT_BLOCK_Q_BWD,
@@ -684,12 +753,19 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
     (broadcast dims are streamed in place, never copied), blockwise and
     DIFFERENTIABLE — learnable biases (T5 relative positions, see
     keras.layers.self_attention.RelativePositionBias) train through the
-    kernel; the per-head gradient tiles are reduced over broadcast dims
-    outside the kernel.
+    kernel; broadcast replicas accumulate in-kernel so the gradient has
+    the primal bias's own shape.
     dropout_rate / dropout_rng: attention-probability dropout; the rng
     key is folded into an int32 seed for the positional hash RNG, so the
     forward and backward kernels agree on the keep mask without a [T, T]
-    mask ever existing.
+    mask ever existing.  `dropout_seed` (an int32 [1] array) may be
+    passed INSTEAD of dropout_rng when the caller manages seeds itself —
+    ring attention derives one seed outside shard_map so every device
+    hashes the same stream.  `dropout_pos=(q_off, k_off)` (python or
+    traced int32 scalars) shifts the hash coordinates to global sequence
+    positions, making the keep mask shard-invariant: a ring device
+    passes its Q-shard offset and the rotating K-shard's offset and gets
+    bit-identical dropout to an unsharded call.
 
     return_lse=True additionally returns the per-row logsumexp
     [batch, t, heads] (pre-dropout, matching the kernel's online-softmax
@@ -708,10 +784,20 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
         raise ValueError(f"dropout_rate {dropout_rate} not in [0, 1)")
     seed = None
     if dropout_rate > 0.0:
-        if dropout_rng is None:
-            raise ValueError("dropout_rate > 0 needs dropout_rng")
-        seed = jax.random.randint(dropout_rng, (1,), -2**31, 2**31 - 1,
-                                  dtype=jnp.int32)
+        if dropout_seed is not None:
+            seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+        elif dropout_rng is not None:
+            seed = jax.random.randint(dropout_rng, (1,), -2**31,
+                                      2**31 - 1, dtype=jnp.int32)
+        else:
+            raise ValueError(
+                "dropout_rate > 0 needs dropout_rng or dropout_seed")
+        q_off, k_off = dropout_pos if dropout_pos is not None else (0, 0)
+        # [3] SMEM block: (seed, global q offset, global k offset)
+        seed = jnp.concatenate([
+            seed,
+            jnp.asarray(q_off, jnp.int32).reshape(1),
+            jnp.asarray(k_off, jnp.int32).reshape(1)])
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)

@@ -234,26 +234,22 @@ class FLServer:
                     # stays fetchable for late DownloadSum polls" — is
                     # therefore made UNCONDITIONAL: the _SECAGG_KEEP
                     # most recent completed rounds are exempt from the
-                    # cap.  The rest drain in preference order: idle
-                    # partial rosters, stale completed sums, full
-                    # rosters, then in-flight rounds; oldest first
-                    # within each class.  (Hard DoS resistance needs
-                    # authenticated transport, out of scope here.)
-                    done = [t for t, r in self._secagg.items()
-                            if r.sum_if_ready() is not None]
-                    protected = set(done[-self._SECAGG_KEEP:])
+                    # cap (the keep-window trim above already removed
+                    # any older completed ones, so no completed round
+                    # is ever a victim here).  The rest drain idle
+                    # partial rosters first, then full rosters, then
+                    # in-flight rounds; oldest first within each class.
+                    # (Hard DoS resistance needs authenticated
+                    # transport, out of scope here.)
+                    protected = {t for t, r in self._secagg.items()
+                                 if r.sum_if_ready() is not None}
                     protected.add(task_id)
 
                     def _evict_class(t):
                         r = self._secagg[t]
-                        # NB: aggregation leaves uploads as {id: {}} —
-                        # check the sum before treating uploads as
-                        # in-flight state
-                        if r.sum_if_ready() is not None:
-                            return 1
                         if r.uploads:
-                            return 3
-                        return 0 if r.roster_if_full() is None else 2
+                            return 2
+                        return 0 if r.roster_if_full() is None else 1
                     victims = sorted(
                         (t for t in self._secagg if t not in protected),
                         key=_evict_class)
