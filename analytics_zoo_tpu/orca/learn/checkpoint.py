@@ -36,8 +36,10 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-#: the at-most-one outstanding AsyncCheckpointer (see wait_for_checkpoints)
-_ASYNC_INFLIGHT = []
+#: ONE long-lived AsyncCheckpointer (orbax's intended usage: save,
+#: wait_until_finished before the next save/restore, close at exit) —
+#: created lazily on the first async save
+_ASYNC_CKPTR = None
 
 
 def async_save_enabled() -> bool:
@@ -63,12 +65,18 @@ def async_save_enabled() -> bool:
 
 
 def wait_for_checkpoints():
-    """Block until any in-flight async save has committed, then release
-    its resources.  Called before a new async save (bounds in-flight
-    state copies at one), before any restore (read-your-write), and at
-    interpreter exit (no torn checkpoints on clean shutdown)."""
-    while _ASYNC_INFLIGHT:
-        ckptr = _ASYNC_INFLIGHT.pop()
+    """Block until any in-flight async save has committed.  Called
+    before a new async save (bounds in-flight state copies at one),
+    before any restore (read-your-write), and at interpreter exit (no
+    torn checkpoints on clean shutdown)."""
+    if _ASYNC_CKPTR is not None:
+        _ASYNC_CKPTR.wait_until_finished()
+
+
+def _close_async():
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is not None:
+        ckptr, _ASYNC_CKPTR = _ASYNC_CKPTR, None
         try:
             ckptr.wait_until_finished()
         finally:
@@ -77,7 +85,7 @@ def wait_for_checkpoints():
             ckptr.close()
 
 
-atexit.register(wait_for_checkpoints)
+atexit.register(_close_async)
 
 
 def save_checkpoint(path: str, state, block: Optional[bool] = None) -> str:
@@ -94,10 +102,14 @@ def save_checkpoint(path: str, state, block: Optional[bool] = None) -> str:
         ckptr.wait_until_finished()
         ckptr.close()
         return path
-    wait_for_checkpoints()
-    ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
-    ckptr.save(path, args=ocp.args.StandardSave(state), force=True)
-    _ASYNC_INFLIGHT.append(ckptr)
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is None:
+        _ASYNC_CKPTR = ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler())
+    else:
+        wait_for_checkpoints()
+    _ASYNC_CKPTR.save(path, args=ocp.args.StandardSave(state),
+                      force=True)
     return path
 
 
@@ -184,6 +196,31 @@ def _stack_block_subtrees(tree):
 
 
 
+def _is_committed(path: str) -> bool:
+    """False for a checkpoint directory whose (async) write never
+    finalized — e.g. the job was preempted mid-save.  Local-fs orbax
+    saves commit via atomic tmp-dir rename, but GCS-style destinations
+    mark completion with a commit file instead; `find_latest` must skip
+    torn directories or an elastic restart crashes on its newest
+    checkpoint instead of resuming from the intact previous one."""
+    try:
+        from orbax.checkpoint.utils import is_checkpoint_finalized
+        if not is_checkpoint_finalized(path):
+            return False
+    except Exception:
+        # predicate unavailable/errored: fall through to the metadata
+        # check rather than refusing every checkpoint
+        pass
+    # on local fs the predicate is name-based (atomic-rename world) and
+    # passes ANY directory; orbax writes _CHECKPOINT_METADATA during
+    # finalize, so its absence marks a torn/foreign directory there too
+    try:
+        return any(n in ("_CHECKPOINT_METADATA", "_METADATA")
+                   for n in os.listdir(path))
+    except OSError:
+        return False
+
+
 def find_latest_checkpoint(model_dir: str,
                            version: Optional[int] = None) -> str:
     wait_for_checkpoints()          # an in-flight save IS the latest
@@ -200,4 +237,9 @@ def find_latest_checkpoint(model_dir: str,
             if v == version:
                 return p
         raise FileNotFoundError(f"no checkpoint version {version}")
-    return max(candidates)[1]
+    committed = [c for c in candidates if _is_committed(c[1])]
+    if not committed:
+        raise FileNotFoundError(
+            f"only uncommitted (torn) checkpoints under {model_dir}: "
+            f"{sorted(p for _, p in candidates)}")
+    return max(committed)[1]

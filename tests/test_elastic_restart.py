@@ -1,0 +1,219 @@
+"""Elastic restart across a (virtual) pod: a worker process is
+SIGKILLed mid-epoch, the supervisor reaps the gang, and a restarted job
+resumes from the latest cooperatively-written sharded checkpoint — with
+loss parity against an uninterrupted run (VERDICT r4 missing #1;
+reference analogs: the DP-1 retry-restore loop Topology.scala:1255-1310
+and Spark task re-execution + ray_daemon.py orphan reaping).
+
+Division of labor the test encodes (documented in docs/orca-guide.md):
+  * WHO DETECTS: the job supervisor (here: the test harness; on a real
+    pod: GKE/the job scheduler).  A dead member leaves the survivors
+    blocked in their next collective — jax.distributed gangs are
+    all-or-nothing, so the supervisor kills and restarts the JOB, not
+    the process.
+  * WHO RE-INITS: the restarted workers' `init_orca_context
+    (cluster_mode="tpu_pod")` re-runs jax.distributed.initialize with
+    the same coordinator; `find_latest_checkpoint` + `load_checkpoint`
+    reshard the orbax store onto whatever mesh the new job has — the
+    restart below comes back as ONE process with 2 local devices (a
+    re-sliced pod) and still reproduces the 2-process trajectory.
+  * WHAT failure_retry_* DOES: the IN-process layer — transient step
+    failures (NaN replay, estimator retry-from-checkpoint) — it cannot
+    and does not try to survive gang-member death.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+
+_WORKER = textwrap.dedent("""
+    import os, sys, signal
+    mode = sys.argv[1]            # full | crash | resume
+    pid_arg = int(sys.argv[2])    # process id in the gang
+    nproc = int(sys.argv[3])
+    port = sys.argv[4]
+    ckpt_dir = sys.argv[5]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if nproc == 1:
+        os.environ["XLA_FLAGS"] = \\
+            "--xla_force_host_platform_device_count=2"
+    else:
+        os.environ.pop("XLA_FLAGS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.orca.learn.checkpoint import (
+        find_latest_checkpoint, load_checkpoint, save_checkpoint)
+
+    if nproc > 1:
+        mesh = init_orca_context(
+            cluster_mode="tpu_pod",
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=nproc, process_id=pid_arg)
+    else:
+        mesh = init_orca_context(cluster_mode="local",
+                                 mesh_shape={"dp": 2})
+    assert mesh.devices.size == 2
+
+    GLOBAL_B, DIM, EPOCHS, STEPS = 16, 8, 6, 4
+    rngp = np.random.default_rng(7)
+    w_true = rngp.normal(size=(DIM, 1)).astype(np.float32)
+
+    def global_batch(epoch, step):
+        r = np.random.default_rng(1000 * epoch + step)
+        x = r.normal(size=(GLOBAL_B, DIM)).astype(np.float32)
+        y = x @ w_true + 0.01 * r.normal(size=(GLOBAL_B, 1)) \\
+            .astype(np.float32)
+        return x, y
+
+    params = {
+        "w1": np.zeros((DIM, 16), np.float32),
+        "b1": np.zeros((16,), np.float32),
+        "w2": np.zeros((16, 1), np.float32),
+    }
+    # deterministic nonzero init shared by every mode
+    ri = np.random.default_rng(3)
+    params = {k: (0.1 * ri.normal(size=v.shape)).astype(np.float32)
+              for k, v in params.items()}
+    opt = optax.adam(1e-2)
+    state = {"params": params, "opt": opt.init(params)}
+    rep = NamedSharding(mesh, P())
+    state = jax.device_put(state, rep)
+    bsh = NamedSharding(mesh, P("dp"))
+
+    def put(x, y):
+        if jax.process_count() == 1:
+            return (jax.device_put(x, bsh), jax.device_put(y, bsh))
+        half = GLOBAL_B // jax.process_count()
+        lo = jax.process_index() * half
+        return tuple(
+            jax.make_array_from_process_local_data(bsh, a[lo:lo + half])
+            for a in (x, y))
+
+    @jax.jit
+    def train_step(state, x, y):
+        def loss_fn(p):
+            h = jnp.tanh(x @ p["w1"] + p["b1"])
+            pred = h @ p["w2"]
+            return jnp.mean((pred - y) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+        updates, new_opt = opt.update(grads, state["opt"],
+                                      state["params"])
+        return {"params": optax.apply_updates(state["params"], updates),
+                "opt": new_opt}, loss
+
+    start_epoch = 0
+    if mode == "resume":
+        latest = find_latest_checkpoint(ckpt_dir)
+        state = load_checkpoint(latest, state)
+        start_epoch = int(latest.rsplit("-", 1)[1]) + 1
+        print(f"resumed from {latest} -> epoch {start_epoch}",
+              flush=True)
+
+    loss = None
+    for epoch in range(start_epoch, EPOCHS):
+        for step in range(STEPS):
+            if (mode == "crash" and pid_arg == 1 and epoch == 2
+                    and step == 1):
+                # a preempted pod member: no cleanup, no goodbye
+                os.kill(os.getpid(), signal.SIGKILL)
+            x, y = put(*global_batch(epoch, step))
+            state, loss = train_step(state, x, y)
+        save_checkpoint(os.path.join(ckpt_dir, f"ckpt-{epoch}"), state)
+        print(f"proc{pid_arg} epoch {epoch} loss {float(loss):.6f}",
+              flush=True)
+    print(f"proc{pid_arg} final {float(loss):.8f}", flush=True)
+""")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    import analytics_zoo_tpu
+    repo_root = os.path.dirname(os.path.dirname(analytics_zoo_tpu.__file__))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return env, repo_root
+
+
+def _launch(script, mode, nproc, port, ckpt_dir):
+    env, repo_root = _env()
+    return [subprocess.Popen(
+        [sys.executable, str(script), mode, str(i), str(nproc),
+         str(port), str(ckpt_dir)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=repo_root) for i in range(nproc)]
+
+
+def _final_loss(out: str):
+    for line in out.splitlines():
+        if " final " in line:
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"no final loss in:\n{out}")
+
+
+def test_elastic_restart_kill_resume_loss_parity(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+
+    # 1) the uninterrupted control gang (2 processes)
+    full_dir = tmp_path / "full"
+    full_dir.mkdir()
+    procs = _launch(script, "full", 2, _free_port(), full_dir)
+    outs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    want = _final_loss(outs[0])
+
+    # 2) the victim gang: proc1 SIGKILLs itself mid-epoch-2 (after the
+    #    epoch-1 checkpoint committed); proc0 blocks in the next
+    #    collective until the supervisor — this test — reaps it
+    crash_dir = tmp_path / "crash"
+    crash_dir.mkdir()
+    procs = _launch(script, "crash", 2, _free_port(), crash_dir)
+    t0 = time.time()
+    procs[1].wait(timeout=240)
+    assert procs[1].returncode == -signal.SIGKILL
+    # supervisor role: give the survivor a moment, observe it has NOT
+    # exited (gang collectives are all-or-nothing), then kill the job
+    try:
+        procs[0].wait(timeout=5)
+        survived_alone = True
+    except subprocess.TimeoutExpired:
+        survived_alone = False
+        procs[0].kill()
+    out0 = procs[0].communicate()[0].decode()
+    assert not survived_alone, (
+        "survivor exited on its own — gang death went undetected?\n"
+        + out0)
+    assert "epoch 1" in out0, out0       # ckpt-1 was written pre-crash
+    assert (crash_dir / "ckpt-1").exists()
+    detect_s = time.time() - t0
+    assert detect_s < 120
+
+    # 3) restart AS A DIFFERENT TOPOLOGY: one process, two local devices
+    #    (a re-sliced pod) resumes from the gang's sharded checkpoint
+    procs = _launch(script, "resume", 1, _free_port(), crash_dir)
+    out = procs[0].communicate(timeout=240)[0].decode()
+    assert procs[0].returncode == 0, out
+    assert "resumed from" in out and "ckpt-1" in out, out
+    got = _final_loss(out)
+
+    # 4) parity: the resumed trajectory replays epochs 2..5 exactly
+    np.testing.assert_allclose(got, want, rtol=1e-5)
