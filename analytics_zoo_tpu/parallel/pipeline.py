@@ -28,13 +28,43 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-#: estimator shard_rules entry for stacked stage parameters
-PIPELINE_SHARD_RULES = {"stages_": "pp:0"}
+#: estimator shard_rules for pipelined models: stage stacks pin dim 0
+#: to "pp" and (when the mesh has it) fully-shard their largest weight
+#: dim over "fsdp"; embed/head shard over "fsdp" too.  On a plain
+#: dp x pp mesh the fsdp entries are no-ops (absent axes are skipped),
+#: so one table serves both.  The ZeRO-style composition: persistent
+#: params + adam moments live (pp, fsdp)-sharded; the schedule's
+#: shard_map declares P("pp"), so XLA all-gathers over "fsdp" on entry
+#: (gather-on-use) and the grads reduce-scatter back into the fsdp
+#: layout at the optimizer update.
+PIPELINE_SHARD_RULES = {"stages_": "pp:0,fsdp",
+                        "embed": "fsdp", "head": "fsdp"}
 
 
 def _pp_size(mesh) -> int:
     return (mesh.shape["pp"] if (mesh is not None
                                  and "pp" in mesh.axis_names) else 1)
+
+
+#: gate dead schedule ticks with lax.cond (True) instead of computing
+#: them and discarding via jnp.where (False).  Measured on the 8-device
+#: CPU mesh (docs/parallelism-and-performance.md): cond recovers most of
+#: the dead-tick compute at small M where the (2pp-1)/(M+2pp-1) overhead
+#: fraction is largest; both paths are kept because `where` has no
+#: branch overhead and XLA:TPU can overlap its dead work with the
+#: ppermutes at large M.
+GATE_DEAD_TICKS = True
+
+
+def _maybe_cond(gate, pred, live_fn, dead_fn):
+    """lax.cond when gating, else compute live and where-select — the
+    two dead-tick policies share one call site."""
+    if gate:
+        return jax.lax.cond(pred, live_fn, dead_fn)
+    live = live_fn()
+    dead = dead_fn()
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), live, dead)
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x,
@@ -106,10 +136,15 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
             # each stage sees microbatch t - idx at tick t; gather the
             # matching extras slice (dynamic per device, clipped — the
             # result is only consumed for valid (t, idx) pairs)
-            m_idx = jnp.clip(t - idx, 0, microbatches - 1)
+            m_f = t - idx
+            f_active = (m_f >= 0) & (m_f < microbatches)
+            m_idx = jnp.clip(m_f, 0, microbatches - 1)
             e_t = tuple(jax.lax.dynamic_index_in_dim(
                 e, m_idx, 0, keepdims=False) for e in em)
-            y = stage_fn(p_local, x_in, *e_t)
+            y = _maybe_cond(
+                GATE_DEAD_TICKS, f_active,
+                lambda x_in=x_in, e_t=e_t: stage_fn(p_local, x_in, *e_t),
+                lambda x_in=x_in: jnp.zeros_like(x_in))
             if t >= pp - 1:
                 # the LAST stage's output at tick t is microbatch
                 # t - (pp - 1); other stages contribute zeros
@@ -224,7 +259,14 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
             inject = xm[min(t, M - 1)]
             x_in = jnp.where(is_first & (t < M), inject, f_state)
             e_f = e_at(m_f)
-            y = stage_fn(p_local, x_in, *e_f)
+            # inactive ticks skip the stage compute entirely under
+            # GATE_DEAD_TICKS (lax.cond); the ppermutes stay OUTSIDE
+            # the conditional — a collective inside a branch some
+            # devices skip would deadlock the ring
+            y = _maybe_cond(
+                GATE_DEAD_TICKS, f_active,
+                lambda x_in=x_in, e_f=e_f: stage_fn(p_local, x_in, *e_f),
+                lambda x_in=x_in: jnp.zeros_like(x_in))
             slot_f = jnp.mod(m_f, B)
             act_buf = jnp.where(
                 f_active,
@@ -232,15 +274,17 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
                     act_buf, x_in, slot_f, 0),
                 act_buf)
             # last stage: microbatch m_f's loss + backward seed, the
-            # moment its forward completes
+            # moment its forward completes — only that one device on
+            # those ticks pays for the loss grad
             lab = jax.lax.dynamic_index_in_dim(
                 lm, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
-
-            def mb_loss(yy):
-                return jnp.sum(loss_fn(yy, lab)) / batch
-            lval, g_seed = jax.value_and_grad(mb_loss)(y)
-            loss_acc = loss_acc + jnp.where(is_last & f_active,
-                                            lval, 0.0)
+            lval, g_seed = _maybe_cond(
+                GATE_DEAD_TICKS, is_last & f_active,
+                lambda y=y, lab=lab: jax.value_and_grad(
+                    lambda yy: jnp.sum(loss_fn(yy, lab)) / batch)(y),
+                lambda y=y: (jnp.zeros((), jnp.float32),
+                             jnp.zeros_like(y)))
+            loss_acc = loss_acc + lval
             seed_buf = jnp.where(
                 is_last & f_active,
                 jax.lax.dynamic_update_index_in_dim(
@@ -259,12 +303,20 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
                                              keepdims=False),
                 b_state)
             e_b = e_at(m_b)
-            _, vjp_fn = jax.vjp(
-                lambda p, xx: stage_fn(p, xx, *e_b), p_local, x_saved)
-            dp_m, dx_m = vjp_fn(g_in.astype(x_saved.dtype))
+
+            def run_vjp(x_saved=x_saved, g_in=g_in, e_b=e_b):
+                _, vjp_fn = jax.vjp(
+                    lambda p, xx: stage_fn(p, xx, *e_b), p_local,
+                    x_saved)
+                return vjp_fn(g_in.astype(x_saved.dtype))
+
+            dp_m, dx_m = _maybe_cond(
+                GATE_DEAD_TICKS, b_active, run_vjp,
+                lambda x_saved=x_saved: (
+                    jax.tree_util.tree_map(jnp.zeros_like, p_local),
+                    jnp.zeros_like(x_saved)))
             grads = jax.tree_util.tree_map(
-                lambda acc, g: acc + jnp.where(b_active, g, 0.0),
-                grads, dp_m)
+                lambda acc, g: acc + g, grads, dp_m)
             # the FIRST stage's dx is d loss / d x for microbatch m_b
             dx_out = jnp.where(
                 is_first & b_active,

@@ -115,9 +115,12 @@ def logical_to_sharding(rules: Dict[str, Optional[str]],
     dimension (dim 0) over "ep" regardless of size ordering (expert-
     parallel tables must split on the expert axis, not their largest).
 
-    A rule may name several axes — ``"tp,fsdp"`` — applied in order,
-    each to the largest still-unsharded divisible dim.  Axes absent from
-    the mesh (or of size 1) are skipped, so one rule table serves every
+    A rule may name several comma-separated entries — ``"tp,fsdp"`` —
+    applied in order, each to the largest still-unsharded divisible
+    dim; each entry may independently pin its dim — ``"pp:0,fsdp"``
+    stacks pipeline stages on dim 0 AND fully-shards the largest
+    remaining dim (the dp×pp×fsdp composition).  Axes absent from the
+    mesh (or of size 1) are skipped, so one rule table serves every
     mesh: on a dp×tp mesh the "fsdp" part is a no-op, on a dp×fsdp mesh
     the "tp" part is, and on dp×fsdp×tp the param is sharded 2-D — the
     scaling-playbook composition of tensor + fully-sharded layouts."""
@@ -128,26 +131,23 @@ def logical_to_sharding(rules: Dict[str, Optional[str]],
             continue
         if ndim == 0:
             continue
-        axis_part, _, dim_s = rule.partition(":")
-        axes = [a for a in axis_part.split(",") if a]
-        if dim_s:
-            # pinned-dim form (single axis): "ep:0"
-            axis = axes[0]
-            if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
-                continue
-            dim = int(dim_s)
-            if dim >= ndim:
-                continue   # rule pins a dim this leaf doesn't have
-            if shape[dim] % mesh.shape[axis] == 0:
-                spec = [None] * ndim
-                spec[dim] = axis
-                return NamedSharding(mesh, P(*spec))
-            continue
-        # each axis shards the largest still-unsharded dim it divides
         spec = [None] * ndim
-        for axis in axes:
+        for entry in rule.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            axis, _, dim_s = entry.partition(":")
             if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
                 continue
+            if dim_s:
+                # pinned-dim form: "ep:0" / the "pp:0" part of
+                # "pp:0,fsdp"
+                dim = int(dim_s)
+                if (dim < ndim and spec[dim] is None
+                        and shape[dim] % mesh.shape[axis] == 0):
+                    spec[dim] = axis
+                continue
+            # shard the largest still-unsharded dim this axis divides
             order = sorted((i for i in range(ndim) if spec[i] is None),
                            key=lambda i: -shape[i])
             for dim in order:

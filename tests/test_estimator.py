@@ -194,9 +194,19 @@ def test_async_checkpoint_gate_and_roundtrip(tmp_path, monkeypatch):
                   "b": np.ones(3, np.float32)}}
         p = C.save_checkpoint(r"{tmp_path}/async-ckpt", state)
         assert C._ASYNC_CKPTR is not None, "async path not taken"
-        # load drains the in-flight save first (read-your-write)
-        got = C.load_checkpoint(p, jax.tree_util.tree_map(
-            np.zeros_like, state))
+        # spy on the drain: value equality alone is probabilistic (a
+        # tiny state's background write wins the race anyway), so
+        # assert load_checkpoint actually CALLED wait_for_checkpoints
+        calls = []
+        real_wait = C.wait_for_checkpoints
+        C.wait_for_checkpoints = lambda: (calls.append(1),
+                                          real_wait())[-1]
+        try:
+            got = C.load_checkpoint(p, jax.tree_util.tree_map(
+                np.zeros_like, state))
+        finally:
+            C.wait_for_checkpoints = real_wait
+        assert calls, "load_checkpoint skipped the read-your-write drain"
         np.testing.assert_array_equal(got["w"], state["w"])
         np.testing.assert_array_equal(got["b"], state["b"])
         print("ASYNC_OK")

@@ -260,3 +260,79 @@ def test_1f1b_trains_regression(pp_mesh):
         params, opt, loss = step(params, opt, x, y_true)
         losses.append(float(loss))
     assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+
+
+def test_dead_tick_gating_policies_agree(pp_mesh):
+    """r5: inactive schedule ticks are lax.cond-gated by default
+    (GATE_DEAD_TICKS).  The cond and where policies must produce
+    identical losses and gradients — gating is scheduling, not math."""
+    import analytics_zoo_tpu.parallel.pipeline as PL
+    from analytics_zoo_tpu.parallel.pipeline import (
+        pipeline_value_and_grad_1f1b)
+
+    params = _stacked_params()
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    lab = rng.normal(size=(16, 8)).astype(np.float32)
+
+    def loss_fn(y, l):
+        return jnp.sum((y - l) ** 2, axis=-1)
+
+    outs = {}
+    assert PL.GATE_DEAD_TICKS is True      # the shipped default
+    try:
+        for gate in (True, False):
+            PL.GATE_DEAD_TICKS = gate
+            outs[gate] = jax.jit(
+                lambda p, x, l: pipeline_value_and_grad_1f1b(
+                    _stage_fn, loss_fn, p, x, l, microbatches=4))(
+                params, x, lab)
+    finally:
+        PL.GATE_DEAD_TICKS = True
+    np.testing.assert_allclose(float(outs[True][0]),
+                               float(outs[False][0]), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(outs[True][1]),
+                    jax.tree_util.tree_leaves(outs[False][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(outs[True][2]),
+                               np.asarray(outs[False][2]), atol=1e-6)
+
+
+def test_pipeline_fsdp_composition_shards_and_trains():
+    """r5 (VERDICT ask 5): dp x pp x fsdp — stage stacks shard
+    "pp:0,fsdp", embed/head shard "fsdp", and the pipelined estimator
+    still trains (the dryrun-gate stage 5 shape)."""
+    from analytics_zoo_tpu.models.pipelined_bert import (
+        PipelinedBERTClassifier)
+
+    stop_orca_context()
+    init_orca_context(cluster_mode="local",
+                      mesh_shape={"dp": 2, "pp": 2, "fsdp": 2})
+    try:
+        model = PipelinedBERTClassifier(
+            num_classes=2, vocab=64, hidden_size=16, n_head=2,
+            n_block=4, n_stages=2, microbatches=2, max_position_len=8)
+        est = model.estimator(learning_rate=1e-3)
+        rng = np.random.default_rng(5)
+        ids = rng.integers(0, 64, (16, 8)).astype(np.int32)
+        seg = np.zeros((16, 8), np.int32)
+        msk = np.ones((16, 8), np.int32)
+        y = rng.integers(0, 2, 16).astype(np.int32)
+        est.fit({"x": [ids, seg, msk], "y": y}, epochs=1, batch_size=16)
+        qkv = est._engine.state.params["stages_"]["block0"]["attn"][
+            "qkv"]["kernel"]
+        spec = str(qkv.sharding.spec)
+        assert "pp" in spec and "fsdp" in spec, spec
+        emb = est._engine.state.params["embed"]["token_embed"]["embedding"]
+        assert "fsdp" in str(emb.sharding.spec), emb.sharding.spec
+        # adam moments follow the params' (pp, fsdp) layout
+        opt_specs = [str(getattr(l.sharding, "spec", ""))
+                     for l in jax.tree_util.tree_leaves(
+                         est._engine.state.opt_state)
+                     if hasattr(l, "sharding")]
+        assert any("fsdp" in s for s in opt_specs), opt_specs[:4]
+        stats = est.evaluate({"x": [ids, seg, msk], "y": y})
+        assert np.isfinite(stats["loss"])
+    finally:
+        stop_orca_context()
