@@ -47,6 +47,33 @@ class TSPipeline:
             ARIMAForecaster)
         return isinstance(self.forecaster, ARIMAForecaster)
 
+    def _is_prophet(self) -> bool:
+        from analytics_zoo_tpu.chronos.forecaster.prophet_forecaster \
+            import ProphetForecaster
+        return isinstance(self.forecaster, ProphetForecaster)
+
+    @staticmethod
+    def _frame(data) -> "pd.DataFrame":
+        """ds/y frame for the Prophet path (a TSDataset's datetime col +
+        first target, or a frame already carrying ds/y).  Scaled
+        TSDatasets are rejected for the same reason as `_series`."""
+        import pandas as pd
+
+        if isinstance(data, TSDataset):
+            if getattr(data, "scaler", None) is not None:
+                raise ValueError(
+                    "the Prophet pipeline operates on the raw series — "
+                    "don't scale() the TSDataset (classical models fit "
+                    "their own level/variance)")
+            return pd.DataFrame({
+                "ds": pd.to_datetime(data.df[data.dt_col]),
+                "y": data.df[data.target_col[0]].to_numpy(np.float64)})
+        if not {"ds", "y"} <= set(getattr(data, "columns", ())):
+            raise ValueError(
+                "prophet data must be a TSDataset or a frame with "
+                "'ds'/'y' columns")
+        return data
+
     @staticmethod
     def _series(data) -> np.ndarray:
         """1-D target series for the ARIMA path (a TSDataset's first
@@ -67,15 +94,21 @@ class TSPipeline:
         if self._is_arima():
             self.forecaster.fit(self._series(data))
             return self
+        if self._is_prophet():
+            self.forecaster.fit(self._frame(data))
+            return self
         x, y = self._xy(data)
         self.forecaster.fit((x, y), epochs=epochs, batch_size=batch_size)
         return self
 
     def predict(self, data, batch_size: int = 32):
         """Predictions in ORIGINAL units when the training TSDataset was
-        scaled.  For an ARIMA pipeline `data` is the horizon (int)."""
+        scaled.  For an ARIMA/Prophet pipeline `data` is the horizon
+        (int)."""
         if self._is_arima():
             return self.forecaster.predict(int(data))
+        if self._is_prophet():
+            return self.forecaster.predict(horizon=int(data))
         x, _ = self._xy(data)
         preds = self.forecaster.predict((x, None), batch_size=batch_size)
         return self._unscale(preds)
@@ -83,9 +116,14 @@ class TSPipeline:
     def evaluate(self, data, batch_size: int = 32):
         """Metrics in original units (predictions and targets unscaled
         before comparison).  For an ARIMA pipeline `data` is the
-        held-out continuation series."""
+        held-out continuation series; for Prophet, a ds/y frame (or
+        TSDataset) covering the held-out span."""
         if self._is_arima():
             mse, mae = self.forecaster.evaluate(self._series(data),
+                                                metrics=["mse", "mae"])
+            return {"mse": mse, "mae": mae}
+        if self._is_prophet():
+            mse, mae = self.forecaster.evaluate(self._frame(data),
                                                 metrics=["mse", "mae"])
             return {"mse": mse, "mae": mae}
         x, y = self._xy(data)

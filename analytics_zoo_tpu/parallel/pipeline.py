@@ -56,9 +56,15 @@ def _pp_size(mesh) -> int:
 GATE_DEAD_TICKS = True
 
 
-def _maybe_cond(gate, pred, live_fn, dead_fn):
-    """lax.cond when gating, else compute live and where-select — the
-    two dead-tick policies share one call site."""
+def _maybe_cond(gate, pred, live_fn):
+    """Run `live_fn` gated by `pred`: lax.cond against a zeros branch
+    when gating, else compute live and where-select.  The dead branch
+    is derived with `jax.eval_shape`, so its shapes AND dtypes match
+    the live branch exactly (hardcoding f32 zeros would trace-crash
+    any stage/loss that computes in bf16/f64)."""
+    shapes = jax.eval_shape(live_fn)
+    dead_fn = lambda: jax.tree_util.tree_map(   # noqa: E731
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
     if gate:
         return jax.lax.cond(pred, live_fn, dead_fn)
     live = live_fn()
@@ -143,8 +149,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
                 e, m_idx, 0, keepdims=False) for e in em)
             y = _maybe_cond(
                 GATE_DEAD_TICKS, f_active,
-                lambda x_in=x_in, e_t=e_t: stage_fn(p_local, x_in, *e_t),
-                lambda x_in=x_in: jnp.zeros_like(x_in))
+                lambda x_in=x_in, e_t=e_t: stage_fn(p_local, x_in,
+                                                    *e_t))
             if t >= pp - 1:
                 # the LAST stage's output at tick t is microbatch
                 # t - (pp - 1); other stages contribute zeros
@@ -265,8 +271,8 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
             # devices skip would deadlock the ring
             y = _maybe_cond(
                 GATE_DEAD_TICKS, f_active,
-                lambda x_in=x_in, e_f=e_f: stage_fn(p_local, x_in, *e_f),
-                lambda x_in=x_in: jnp.zeros_like(x_in))
+                lambda x_in=x_in, e_f=e_f: stage_fn(p_local, x_in,
+                                                    *e_f))
             slot_f = jnp.mod(m_f, B)
             act_buf = jnp.where(
                 f_active,
@@ -281,9 +287,7 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
             lval, g_seed = _maybe_cond(
                 GATE_DEAD_TICKS, is_last & f_active,
                 lambda y=y, lab=lab: jax.value_and_grad(
-                    lambda yy: jnp.sum(loss_fn(yy, lab)) / batch)(y),
-                lambda y=y: (jnp.zeros((), jnp.float32),
-                             jnp.zeros_like(y)))
+                    lambda yy: jnp.sum(loss_fn(yy, lab)) / batch)(y))
             loss_acc = loss_acc + lval
             seed_buf = jnp.where(
                 is_last & f_active,
@@ -310,11 +314,7 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
                     x_saved)
                 return vjp_fn(g_in.astype(x_saved.dtype))
 
-            dp_m, dx_m = _maybe_cond(
-                GATE_DEAD_TICKS, b_active, run_vjp,
-                lambda x_saved=x_saved: (
-                    jax.tree_util.tree_map(jnp.zeros_like, p_local),
-                    jnp.zeros_like(x_saved)))
+            dp_m, dx_m = _maybe_cond(GATE_DEAD_TICKS, b_active, run_vjp)
             grads = jax.tree_util.tree_map(
                 lambda acc, g: acc + g, grads, dp_m)
             # the FIRST stage's dx is d loss / d x for microbatch m_b
