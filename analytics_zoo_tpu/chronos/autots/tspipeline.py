@@ -108,6 +108,8 @@ class TSPipeline:
         if self._is_arima():
             return self.forecaster.predict(int(data))
         if self._is_prophet():
+            # freq defaults to the trained cadence inside the
+            # forecaster, so hourly pipelines forecast hours, not days
             return self.forecaster.predict(horizon=int(data))
         x, _ = self._xy(data)
         preds = self.forecaster.predict((x, None), batch_size=batch_size)

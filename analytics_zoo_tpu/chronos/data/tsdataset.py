@@ -5,6 +5,19 @@ Reference: /root/reference/pyzoo/zoo/chronos/data/tsdataset.py:45
 `scale/unscale :467`, `roll :707`, `to_numpy`) plus `data/utils/*`
 (roll/impute/resample/split).  Pure pandas/numpy — identical semantics on
 TPU hosts; the output of `.roll().to_numpy()` feeds the SPMD engine.
+
+>>> import numpy as np, pandas as pd
+>>> from analytics_zoo_tpu.chronos.data.tsdataset import TSDataset
+>>> df = pd.DataFrame({
+...     "dt": pd.date_range("2021-01-01", periods=6, freq="D"),
+...     "value": [1.0, 2.0, np.nan, 4.0, 5.0, 6.0]})
+>>> ts = TSDataset.from_pandas(df, dt_col="dt", target_col="value")
+>>> x, y = ts.impute(mode="last").roll(lookback=3,
+...                                    horizon=1).to_numpy()
+>>> x.shape, y.shape
+((3, 3, 1), (3, 1, 1))
+>>> float(x[1, 1, 0])    # the imputed gap carried the last value
+2.0
 """
 
 from __future__ import annotations

@@ -61,7 +61,8 @@ class ProphetForecaster:
             raise ValueError(
                 f"seasonality_mode {seasonality_mode!r} not in "
                 "('additive', 'multiplicative')")
-        if holidays is not None and "holiday" not in holidays.columns:
+        if holidays is not None and not {"holiday", "ds"} <= set(
+                holidays.columns):
             raise ValueError(
                 "holidays must be a frame with 'holiday' and 'ds' "
                 "columns (optional lower_window/upper_window) — the "
@@ -243,17 +244,24 @@ class ProphetForecaster:
             lower, upper = np.exp(lower), np.exp(upper)
         return yhat, trend, lower, upper
 
-    def predict(self, horizon: int = 24, freq: str = "D") -> pd.DataFrame:
-        """Forecast `horizon` periods past the training end at `freq`
-        (reference prophet_forecaster.py predict contract: a frame with
-        yhat columns)."""
+    def predict(self, horizon: int = 24,
+                freq: Optional[str] = None) -> pd.DataFrame:
+        """Forecast `horizon` periods past the training end (reference
+        prophet_forecaster.py predict contract: a frame with yhat
+        columns).  `freq=None` (default) steps at the TRAINED cadence —
+        an hourly series forecasts the next `horizon` hours; pass a
+        pandas freq string ("D", "H", ...) to override."""
         if self._state is None:
             raise RuntimeError(
                 "You must call fit or restore first before calling "
                 "predict!")
         st = self._state
-        last = pd.Timestamp(st["t0"]) + pd.to_timedelta(st["t_last"],
-                                                        unit="D")
+        if freq is None:
+            # cadence is a float-days median; round off the nanosecond
+            # dust so hourly data steps exactly 1h
+            freq = pd.to_timedelta(st["cadence"], unit="D").round("ms")
+        last = (pd.Timestamp(st["t0"])
+                + pd.to_timedelta(st["t_last"], unit="D")).round("ms")
         ds = pd.date_range(last, periods=int(horizon) + 1,
                            freq=freq)[1:]
         t_days = (ds.to_numpy() - st["t0"]) / np.timedelta64(1, "D")

@@ -1,6 +1,20 @@
 """Hyperparameter search-space DSL (reference:
 /root/reference/pyzoo/zoo/orca/automl/hp.py — thin wrappers over Ray Tune's
-sample spaces; here self-contained samplers)."""
+sample spaces; here self-contained samplers).
+
+>>> import random
+>>> from analytics_zoo_tpu.orca.automl import hp
+>>> rng = random.Random(0)
+>>> hp.choice([16, 32, 64]).sample(rng) in (16, 32, 64)
+True
+>>> hp.choice([16, 32, 64]).grid_values()
+[16, 32, 64]
+>>> 1e-3 <= hp.loguniform(1e-3, 1e-1).sample(rng) <= 1e-1
+True
+>>> # randint's upper bound is EXCLUSIVE (randrange semantics)
+>>> {hp.randint(5, 8).sample(rng) for _ in range(64)} == {5, 6, 7}
+True
+"""
 
 from __future__ import annotations
 

@@ -15,6 +15,17 @@ service and no serialization boundary.  Shard transforms run on a thread pool
 mirroring the reference FeatureSet's DRAM/DISK storage levels,
 zoo/src/main/scala/.../feature/FeatureSet.scala:557) spills shards to pickle
 files and loads them lazily.
+
+>>> import numpy as np
+>>> from analytics_zoo_tpu.orca.data import XShards
+>>> shards = XShards.partition({"x": np.arange(10),
+...                             "y": np.arange(10) % 2}, num_shards=3)
+>>> shards.num_partitions()
+3
+>>> doubled = shards.transform_shard(
+...     lambda s: {"x": s["x"] * 2, "y": s["y"]})
+>>> sorted(np.concatenate([s["x"] for s in doubled.collect()]).tolist())
+[0, 2, 4, 6, 8, 10, 12, 14, 16, 18]
 """
 
 from __future__ import annotations

@@ -56,13 +56,17 @@ def _pp_size(mesh) -> int:
 GATE_DEAD_TICKS = True
 
 
-def _maybe_cond(gate, pred, live_fn):
+def _maybe_cond(gate, pred, live_fn, shapes=None):
     """Run `live_fn` gated by `pred`: lax.cond against a zeros branch
     when gating, else compute live and where-select.  The dead branch
-    is derived with `jax.eval_shape`, so its shapes AND dtypes match
-    the live branch exactly (hardcoding f32 zeros would trace-crash
-    any stage/loss that computes in bf16/f64)."""
-    shapes = jax.eval_shape(live_fn)
+    is derived from `shapes` (a jax.eval_shape of the live branch), so
+    its shapes AND dtypes match exactly — hardcoding f32 zeros would
+    trace-crash any stage/loss that computes in bf16/f64.  Callers in
+    the unrolled tick loops eval_shape ONCE and reuse it (the abstract
+    trace of a big stage_fn is not free, and the output types are
+    tick-invariant); shapes=None derives them here."""
+    if shapes is None:
+        shapes = jax.eval_shape(live_fn)
     dead_fn = lambda: jax.tree_util.tree_map(   # noqa: E731
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
     if gate:
@@ -135,6 +139,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
         is_last = idx == pp - 1
         state = jnp.zeros_like(xm[0])
         outs = []
+        y_shapes = None
         for t in range(microbatches + pp - 1):
             inject = xm[min(t, microbatches - 1)]
             x_in = jnp.where(is_first & (t < microbatches),
@@ -147,10 +152,11 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
             m_idx = jnp.clip(m_f, 0, microbatches - 1)
             e_t = tuple(jax.lax.dynamic_index_in_dim(
                 e, m_idx, 0, keepdims=False) for e in em)
-            y = _maybe_cond(
-                GATE_DEAD_TICKS, f_active,
-                lambda x_in=x_in, e_t=e_t: stage_fn(p_local, x_in,
-                                                    *e_t))
+            live_f = lambda x_in=x_in, e_t=e_t: stage_fn(  # noqa: E731
+                p_local, x_in, *e_t)
+            if y_shapes is None:
+                y_shapes = jax.eval_shape(live_f)
+            y = _maybe_cond(GATE_DEAD_TICKS, f_active, live_f, y_shapes)
             if t >= pp - 1:
                 # the LAST stage's output at tick t is microbatch
                 # t - (pp - 1); other stages contribute zeros
@@ -253,6 +259,8 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
                 e, jnp.clip(m_idx, 0, M - 1), 0, keepdims=False)
                 for e in em)
 
+        fwd_shapes = loss_shapes = bwd_shapes = None
+
         # drained after M + 2*pp - 1 ticks: the last forward (stage
         # pp-1, mb M-1) fires at tick M+pp-2 and the last backward
         # (stage 0, mb M-1) at tick M+2pp-2 — any more ticks would be
@@ -269,10 +277,12 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
             # GATE_DEAD_TICKS (lax.cond); the ppermutes stay OUTSIDE
             # the conditional — a collective inside a branch some
             # devices skip would deadlock the ring
-            y = _maybe_cond(
-                GATE_DEAD_TICKS, f_active,
-                lambda x_in=x_in, e_f=e_f: stage_fn(p_local, x_in,
-                                                    *e_f))
+            live_f = lambda x_in=x_in, e_f=e_f: stage_fn(  # noqa: E731
+                p_local, x_in, *e_f)
+            if fwd_shapes is None:
+                fwd_shapes = jax.eval_shape(live_f)
+            y = _maybe_cond(GATE_DEAD_TICKS, f_active, live_f,
+                            fwd_shapes)
             slot_f = jnp.mod(m_f, B)
             act_buf = jnp.where(
                 f_active,
@@ -284,10 +294,13 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
             # those ticks pays for the loss grad
             lab = jax.lax.dynamic_index_in_dim(
                 lm, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
+            live_l = lambda y=y, lab=lab: jax.value_and_grad(  # noqa: E731
+                lambda yy: jnp.sum(loss_fn(yy, lab)) / batch)(y)
+            if loss_shapes is None:
+                loss_shapes = jax.eval_shape(live_l)
             lval, g_seed = _maybe_cond(
-                GATE_DEAD_TICKS, is_last & f_active,
-                lambda y=y, lab=lab: jax.value_and_grad(
-                    lambda yy: jnp.sum(loss_fn(yy, lab)) / batch)(y))
+                GATE_DEAD_TICKS, is_last & f_active, live_l,
+                loss_shapes)
             loss_acc = loss_acc + lval
             seed_buf = jnp.where(
                 is_last & f_active,
@@ -314,7 +327,10 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
                     x_saved)
                 return vjp_fn(g_in.astype(x_saved.dtype))
 
-            dp_m, dx_m = _maybe_cond(GATE_DEAD_TICKS, b_active, run_vjp)
+            if bwd_shapes is None:
+                bwd_shapes = jax.eval_shape(run_vjp)
+            dp_m, dx_m = _maybe_cond(GATE_DEAD_TICKS, b_active, run_vjp,
+                                     bwd_shapes)
             grads = jax.tree_util.tree_map(
                 lambda acc, g: acc + g, grads, dp_m)
             # the FIRST stage's dx is d loss / d x for microbatch m_b

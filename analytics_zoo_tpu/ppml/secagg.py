@@ -28,6 +28,16 @@ if a client drops after joining, the round cannot complete (the full
 protocol's Shamir-share recovery of dropped clients' masks is not
 implemented).  Threat model: honest-but-curious server; colluding
 clients j can of course cancel their own masks with i's.
+
+>>> import numpy as np
+>>> from analytics_zoo_tpu.ppml.secagg import (
+...     dh_keypair, pair_seed, quantize, unquantize)
+>>> (xa, ga), (xb, gb) = dh_keypair(), dh_keypair()
+>>> pair_seed(xa, gb) == pair_seed(xb, ga)   # DH agreement
+True
+>>> v = np.array([1.25, -3.5], np.float32)
+>>> np.allclose(unquantize(quantize(v)), v, atol=2**-24)
+True
 """
 
 from __future__ import annotations

@@ -15,6 +15,14 @@ hand-rolled wire-format codec is small and dependency-free:
 Used by the TFRecord image datasets (reference:
 pyzoo/zoo/orca/data/image/tfrecord_dataset.py writes tf.train.Examples);
 files written here are readable by TensorFlow and vice versa.
+
+>>> from analytics_zoo_tpu.utils.tf_example import (
+...     _len_delim, _read_varint, _tag, _varint, walk_fields)
+>>> _read_varint(_varint(300), 0)[0]
+300
+>>> msg = _tag(1, 0) + _varint(7) + _len_delim(2, b"hi")
+>>> [(f, w, v) for f, w, v in walk_fields(msg)]
+[(1, 0, 7), (2, 2, b'hi')]
 """
 
 from __future__ import annotations

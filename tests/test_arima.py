@@ -405,3 +405,25 @@ def test_autots_prophet_rejects_unsampled_hp_extras():
                       "n_changepoints": hp.randint(5, 50)})
     with pytest.raises(ValueError, match="n_changepoints"):
         est.fit(_prophet_frame(n=100), n_sampling=1)
+
+
+def test_prophet_predict_steps_at_trained_cadence():
+    """predict(freq=None) steps at the TRAINED cadence: an hourly
+    series forecasts the next hours, not days."""
+    import pandas as pd
+
+    from analytics_zoo_tpu.chronos.forecaster.prophet_forecaster import (
+        ProphetForecaster)
+
+    n = 240
+    t = np.arange(n, dtype=np.float64)
+    y = 10 + 0.01 * t + 2 * np.sin(2 * np.pi * t / 24)
+    df = pd.DataFrame({"ds": pd.date_range("2021-01-01", periods=n,
+                                           freq="h"), "y": y})
+    fc = ProphetForecaster(daily_seasonality=True)
+    fc.fit(df.iloc[:-24], df.iloc[-24:])
+    out = fc.predict(horizon=6)
+    step = (out["ds"].iloc[1] - out["ds"].iloc[0])
+    assert step == pd.Timedelta(hours=1), step
+    # forecasts start one cadence step past the TRAINING end
+    assert out["ds"].iloc[0] == df["ds"].iloc[-25] + pd.Timedelta(hours=1)

