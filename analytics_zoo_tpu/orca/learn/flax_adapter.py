@@ -10,6 +10,18 @@ import jax
 import numpy as np
 
 
+def declares_param(fn, name: str) -> bool:
+    """True when callable `fn` declares a parameter called `name` —
+    THE introspection behind the engine's opt-in threading (loss
+    `mask`, apply_fn `mask`, module `token_mask`); one definition so
+    the adapter and the engine can never disagree on the rule."""
+    import inspect
+    try:
+        return name in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 def _mode_kwarg(module) -> Tuple[str, bool]:
     """Find the module's train-mode kwarg: 'training'/'train' (True when
     training) or 'deterministic' (inverted).  Returns (name, invert)."""
@@ -44,10 +56,15 @@ def init_flax(module, sample_features: Tuple[np.ndarray, ...], seed: int = 0):
 
 def flax_apply_fn(module):
     kw, invert = _mode_kwarg(module)
+    # modules that declare `token_mask` (e.g. MoE-bearing models whose
+    # router statistics must not see padded rows) get the engine's
+    # per-example padding mask forwarded — the apply_fn's own `mask`
+    # parameter is what SPMDEngine detects (spmd.py _forward)
+    takes_token_mask = declares_param(type(module).__call__,
+                                      "token_mask")
 
-    def apply_fn(params, model_state, features, rng, training):
+    def _apply(params, model_state, features, rng, training, kwargs):
         variables = {"params": params, **model_state}
-        kwargs: Dict[str, Any] = {}
         if kw:
             kwargs[kw] = (not training) if invert else training
         mutable = list(model_state.keys()) if (training and model_state) else False
@@ -58,5 +75,18 @@ def flax_apply_fn(module):
             return preds, dict(updated)
         preds = module.apply(variables, *features, rngs=rngs, **kwargs)
         return preds, model_state
+
+    if takes_token_mask:
+        def apply_fn(params, model_state, features, rng, training,
+                     mask=None):
+            kwargs: Dict[str, Any] = {}
+            if mask is not None:
+                kwargs["token_mask"] = mask
+            return _apply(params, model_state, features, rng, training,
+                          kwargs)
+    else:
+        def apply_fn(params, model_state, features, rng, training):
+            return _apply(params, model_state, features, rng, training,
+                          {})
 
     return apply_fn

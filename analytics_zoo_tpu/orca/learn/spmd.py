@@ -104,23 +104,22 @@ class SPMDEngine:
         self.loss_fn = loss_fn
         #: set when the model returns (predictions, aux_scalar) — e.g.
         #: a Switch-MoE load-balancing loss; the train loss adds
-        #: weight * aux, metrics see only the predictions.  Caveat: the
-        #: aux is computed by the MODEL, which also sees the zero-
-        #: padded rows of a ragged tail batch (the engine's mask only
-        #: gates the primary loss) — keep batch_size dividing the
-        #: dataset, or accept slight aux noise on the tail batch
+        #: weight * aux, metrics see only the predictions.  The engine
+        #: threads the padding mask to any apply_fn that declares a
+        #: `mask` parameter (r5 — flax_apply_fn forwards it as
+        #: `token_mask` to modules that accept one, and SwitchMoE
+        #: excludes masked rows from both its balance statistics and
+        #: its capacity buckets), so a ragged tail batch no longer
+        #: biases the router
         self.aux_loss_weight = aux_loss_weight
+        from analytics_zoo_tpu.orca.learn.flax_adapter import (
+            declares_param)
+        self._apply_takes_mask = declares_param(apply_fn, "mask")
         # pairwise losses (rank_hinge) need the padding mask INSIDE the
         # loss — a padded member must zero its pair — so the engine
         # threads it to any loss that declares a `mask` parameter
-        self._loss_takes_mask = False
-        if loss_fn is not None:
-            try:
-                import inspect
-                self._loss_takes_mask = (
-                    "mask" in inspect.signature(loss_fn).parameters)
-            except (TypeError, ValueError):
-                pass
+        self._loss_takes_mask = (loss_fn is not None
+                                 and declares_param(loss_fn, "mask"))
         self.metric_fns = dict(metric_fns or {})
         self.shard_rules = shard_rules or {}
         #: extra batch-divisibility constraint beyond data parallelism —
@@ -270,7 +269,11 @@ class SPMDEngine:
     # jitted step functions
     # ------------------------------------------------------------------
 
-    def _forward(self, params, model_state, features, rng, training):
+    def _forward(self, params, model_state, features, rng, training,
+                 mask=None):
+        if self._apply_takes_mask and mask is not None:
+            return self.apply_fn(params, model_state, features, rng,
+                                 training, mask=mask)
         return self.apply_fn(params, model_state, features, rng, training)
 
     def _split_aux(self, preds, mask=None):
@@ -295,7 +298,8 @@ class SPMDEngine:
 
         def loss_of(params):
             preds, new_ms = self._forward(
-                params, state.model_state, batch["features"], rng, True)
+                params, state.model_state, batch["features"], rng, True,
+                mask=batch["mask"])
             preds, aux = self._split_aux(preds, batch["mask"])
             per_ex = self._per_example_loss(preds, batch["labels"],
                                             batch["mask"])
@@ -347,7 +351,8 @@ class SPMDEngine:
 
     def _eval_step_impl(self, state: TrainState, batch):
         preds, _ = self._forward(state.params, state.model_state,
-                                 batch["features"], state.rng, False)
+                                 batch["features"], state.rng, False,
+                                 mask=batch["mask"])
         preds, aux = self._split_aux(preds, batch["mask"])
         stats = {}
         if aux is not None:

@@ -41,20 +41,32 @@ def _capacity(n_tokens: int, num_experts: int,
         capacity_factor * n_tokens / num_experts)))
 
 
-def _route(logits, num_experts: int, capacity: int):
+def _route(logits, num_experts: int, capacity: int, mask=None):
     """Top-1 routing -> (dispatch [n, E, C] one-hot, combine [n, E, C]
-    gate-weighted, aux load-balance loss).  n = flattened tokens."""
+    gate-weighted, aux load-balance loss).  n = flattened tokens.
+    `mask` ([n], 1 = real): padded tokens are excluded from the balance
+    statistics AND never claim capacity slots (r5 — a ragged tail batch
+    used to bias the router toward whatever expert argmaxes on zeros,
+    and its phantom rows could displace real tokens)."""
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     expert = jnp.argmax(probs, axis=-1)                     # [n]
     gate = jnp.take_along_axis(probs, expert[:, None],
                                axis=-1)[:, 0]               # [n]
     assigned = jax.nn.one_hot(expert, num_experts,
                               dtype=jnp.float32)            # [n, E]
-    # Switch aux loss from PRE-drop assignments over ALL n tokens: with
-    # tight capacity the kept counts saturate uniformly and a post-drop
-    # fraction would report "balanced" exactly when the router isn't
-    frac = assigned.mean(axis=0)
-    mean_prob = probs.mean(axis=0)
+    if mask is not None:
+        assigned = assigned * mask[:, None]
+    # Switch aux loss from PRE-drop assignments over ALL real tokens:
+    # with tight capacity the kept counts saturate uniformly and a
+    # post-drop fraction would report "balanced" exactly when the
+    # router isn't
+    if mask is None:
+        frac = assigned.mean(axis=0)
+        mean_prob = probs.mean(axis=0)
+    else:
+        denom = jnp.maximum(mask.sum(), 1.0)
+        frac = assigned.sum(axis=0) / denom
+        mean_prob = (probs * mask[:, None]).sum(axis=0) / denom
     aux = (frac * mean_prob).sum() * num_experts
     # position of each token within its expert's bucket
     pos = (jnp.cumsum(assigned, axis=0) - 1.0) * assigned   # [n, E]
@@ -94,7 +106,7 @@ class SwitchMoE(nn.Module):
     compute_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x, training: bool = False):
+    def __call__(self, x, training: bool = False, token_mask=None):
         from analytics_zoo_tpu.common.context import OrcaContext
         from analytics_zoo_tpu.keras.layers.core import get_activation
 
@@ -105,6 +117,23 @@ class SwitchMoE(nn.Module):
         lead = x.shape[:-1]
         n = int(np.prod(lead))
         xf = x.reshape(n, H)
+        mflat = None
+        if token_mask is not None:
+            token_mask = jnp.asarray(token_mask, jnp.float32)
+            if token_mask.shape == lead:
+                mflat = token_mask.reshape(n)
+            elif token_mask.shape == (lead[0],):
+                # per-EXAMPLE mask (the engine's padding mask):
+                # broadcast over the example's remaining lead dims
+                mflat = jnp.broadcast_to(
+                    token_mask.reshape((lead[0],) + (1,) *
+                                       (len(lead) - 1)),
+                    lead).reshape(n)
+            else:
+                raise ValueError(
+                    f"token_mask shape {token_mask.shape} matches "
+                    f"neither the token dims {lead} nor the batch dim "
+                    f"({lead[0]},)")
 
         rkern = self.param("router_kernel",
                            nn.initializers.lecun_normal(), (H, E))
@@ -134,7 +163,7 @@ class SwitchMoE(nn.Module):
         if ep <= 1:
             cap = _capacity(n, E, self.capacity_factor)
             logits = xf.astype(jnp.float32) @ rkern + rbias
-            dispatch, combine, aux = _route(logits, E, cap)
+            dispatch, combine, aux = _route(logits, E, cap, mask=mflat)
             buckets = jnp.einsum("nec,nh->ech", dispatch.astype(
                 self.compute_dtype), xd)                    # [E, C, H]
             out_b = _expert_ffn(buckets, w1.astype(self.compute_dtype),
@@ -153,13 +182,13 @@ class SwitchMoE(nn.Module):
                 b1.astype(self.compute_dtype),
                 w2.astype(self.compute_dtype),
                 b2.astype(self.compute_dtype),
-                act, mesh)
+                act, mesh, mflat)
         return y.reshape(*lead, H).astype(x.dtype), aux
 
 
 def _ep_dispatch(xd, xf32, rkern, rbias, num_experts: int,
                  capacity_factor: float, w1, b1, w2, b2, activation,
-                 mesh: Mesh):
+                 mesh: Mesh, mflat=None):
     """shard_map expert-parallel dispatch with GShard grouped routing:
     tokens shard over the data axes, experts over "ep" (dim 0).  Each
     data shard is a routing GROUP — it routes its own tokens with a
@@ -183,11 +212,13 @@ def _ep_dispatch(xd, xf32, rkern, rbias, num_experts: int,
     ep = mesh.shape["ep"]
     e_local = num_experts // ep
 
-    def local(xd, xf32, rkern, rbias, w1, b1, w2, b2):
+    def local(xd, xf32, mloc, rkern, rbias, w1, b1, w2, b2):
         n_local = xd.shape[0]
         cap = _capacity(n_local, num_experts, capacity_factor)
         logits = xf32 @ rkern + rbias
-        dispatch, combine, aux = _route(logits, num_experts, cap)
+        masked = mflat is not None
+        dispatch, combine, aux = _route(
+            logits, num_experts, cap, mask=(mloc if masked else None))
         off = jax.lax.axis_index("ep") * e_local
         disp = jax.lax.dynamic_slice_in_dim(
             dispatch.astype(xd.dtype), off, e_local, axis=1)
@@ -199,16 +230,23 @@ def _ep_dispatch(xd, xf32, rkern, rbias, num_experts: int,
         # every ep shard contributes its local experts' outputs; tokens
         # routed elsewhere contribute zero here — sum over the axis
         y = jax.lax.psum(y_part, "ep")
-        if daxes:                         # mean aux over routing groups
-            aux = jax.lax.pmean(aux, daxes)
+        if daxes:
+            # aux over routing groups, weighted by each group's REAL
+            # token count: an all-padded tail group must not drag the
+            # mean toward "balanced" (unmasked groups weigh n_local)
+            w = mloc.sum() if masked else jnp.float32(n_local)
+            aux = (jax.lax.psum(aux * w, daxes)
+                   / jnp.maximum(jax.lax.psum(w, daxes), 1.0))
         return y, aux
 
     espec = P("ep")                       # expert-dim sharded operands
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(tok), P(tok), P(), P(),
+        in_specs=(P(tok), P(tok), P(tok), P(), P(),
                   espec, espec, espec, espec),
         out_specs=(P(tok), P()),
         check_vma=False)
-    return fn(xd, xf32.astype(jnp.float32), rkern, rbias,
+    m_arg = (mflat if mflat is not None
+             else jnp.ones((xd.shape[0],), jnp.float32))
+    return fn(xd, xf32.astype(jnp.float32), m_arg, rkern, rbias,
               w1, b1, w2, b2)
