@@ -369,8 +369,12 @@ class SPMDEngine:
         return stats
 
     def _predict_step_impl(self, state: TrainState, batch):
+        # the mask matters at inference too: a MoE's padded phantom
+        # rows would otherwise claim capacity slots and displace real
+        # tokens' expert outputs
         preds, _ = self._forward(state.params, state.model_state,
-                                 batch["features"], state.rng, False)
+                                 batch["features"], state.rng, False,
+                                 mask=batch["mask"])
         preds, _aux = self._split_aux(preds)
         return preds
 

@@ -41,6 +41,11 @@ class InferenceModel:
         self.supported_concurrent_num = supported_concurrent_num
         self.max_batch_size = max_batch_size
         self._predict_fn: Optional[Callable] = None
+        #: set by loaders whose model declares a padding-mask input —
+        #: predict() then tells the model which bucket rows are real,
+        #: so e.g. a MoE's phantom rows cannot claim capacity slots
+        #: (same r5 fix as SPMDEngine._predict_step_impl)
+        self._takes_mask = False
         self._params = None
         self._model_state = None
         self._lock = threading.Lock()
@@ -59,9 +64,12 @@ class InferenceModel:
         the jitted forward, where XLA fuses it into the matmuls."""
         import jax
 
-        from analytics_zoo_tpu.orca.learn.flax_adapter import _mode_kwarg
+        from analytics_zoo_tpu.orca.learn.flax_adapter import (
+            _mode_kwarg, declares_param)
         kw, invert = _mode_kwarg(module)
         kwargs = {kw: True if invert else False} if kw else {}
+        self._takes_mask = declares_param(type(module).__call__,
+                                          "token_mask")
 
         if quantize:
             import jax.numpy as jnp
@@ -73,24 +81,32 @@ class InferenceModel:
                 {"qparams": qparams, "state": model_state or {}})
 
             @jax.jit
-            def qfn(qvars, *feats):
+            def qfn(qvars, mask, *feats):
                 variables = {
                     "params": dequantize_params(qvars["qparams"],
                                                 dtype=jnp.bfloat16),
                     **qvars["state"]}
-                return module.apply(variables, *feats, **kwargs)
+                kw2 = dict(kwargs)
+                if self._takes_mask and mask is not None:
+                    kw2["token_mask"] = mask
+                return module.apply(variables, *feats, **kw2)
 
-            self._predict_fn = lambda *feats: qfn(qvars, *feats)
+            self._predict_fn = lambda mask, *feats: qfn(qvars, mask,
+                                                        *feats)
             return self
 
         variables = {"params": params, **(model_state or {})}
         variables = jax.device_put(variables)
 
         @jax.jit
-        def fn(variables, *feats):
-            return module.apply(variables, *feats, **kwargs)
+        def fn(variables, mask, *feats):
+            kw2 = dict(kwargs)
+            if self._takes_mask and mask is not None:
+                kw2["token_mask"] = mask
+            return module.apply(variables, *feats, **kw2)
 
-        self._predict_fn = lambda *feats: fn(variables, *feats)
+        self._predict_fn = lambda mask, *feats: fn(variables, mask,
+                                                   *feats)
         return self
 
     def load_apply_fn(self, apply_fn: Callable, params, model_state=None):
@@ -102,12 +118,22 @@ class InferenceModel:
         model_state = jax.device_put(model_state or {})
         rng = jax.random.PRNGKey(0)
 
+        from analytics_zoo_tpu.orca.learn.flax_adapter import (
+            declares_param)
+        self._takes_mask = declares_param(apply_fn, "mask")
+
         @jax.jit
-        def fn(params, model_state, *feats):
-            preds, _ = apply_fn(params, model_state, feats, rng, False)
+        def fn(params, model_state, mask, *feats):
+            if self._takes_mask and mask is not None:
+                preds, _ = apply_fn(params, model_state, feats, rng,
+                                    False, mask=mask)
+            else:
+                preds, _ = apply_fn(params, model_state, feats, rng,
+                                    False)
             return preds
 
-        self._predict_fn = lambda *feats: fn(params, model_state, *feats)
+        self._predict_fn = lambda mask, *feats: fn(
+            params, model_state, mask, *feats)
         return self
 
     def load_tf(self, path_or_bytes, outputs=None):
@@ -120,7 +146,9 @@ class InferenceModel:
         from analytics_zoo_tpu.pipeline.tf_graph import load_tf_graph
 
         net = load_tf_graph(path_or_bytes, outputs=outputs)
-        self._predict_fn = jax.jit(net._eval)
+        self._takes_mask = False
+        _tf_fn = jax.jit(net._eval)
+        self._predict_fn = lambda mask, *feats: _tf_fn(*feats)
         return self
 
     def load_model(self, path: str, model_cls=None,
@@ -178,8 +206,12 @@ class InferenceModel:
             return np.concatenate(parts)
         target = _bucket(n, self.max_batch_size)
         padded = tuple(_pad_to(a, target) for a in inputs)
+        mask = None
+        if self._takes_mask and target != n:
+            mask = np.zeros(target, np.float32)
+            mask[:n] = 1.0
         with self._sem:
-            out = self._predict_fn(*padded)
+            out = self._predict_fn(mask, *padded)
             with self._lock:
                 self._n_predict += n
         import jax

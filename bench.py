@@ -341,7 +341,7 @@ def attn_kernel_utilization(iters: int = 10):
             return c[0][0, 0, 0, 0].astype(jnp.float32)
         _ = float(many(q, k, v))
         dt = min(_timed(lambda: float(many(q, k, v)))
-                 for _ in range(3)) / iters
+                 for _ in range(2)) / iters
         return 3 * 4 * b * h * t * t * d / dt / V5E_PEAK_FLOPS
 
     def dense_eff(rows, H, I):
@@ -360,26 +360,27 @@ def attn_kernel_utilization(iters: int = 10):
             return c[0, 0].astype(jnp.float32)
         _ = float(many(x, w1, w2))
         dt = min(_timed(lambda: float(many(x, w1, w2)))
-                 for _ in range(3)) / (5 * iters)
+                 for _ in range(2)) / (5 * iters)
         return 4 * rows * H * I / dt / V5E_PEAK_FLOPS
 
     out = {}
-    # head-to-head shapes are sized so EINSUM'S BACKWARD FITS: its
-    # materialized [b, h, t, t] f32 score buffers need ~4x b*h*t^2*4
-    # bytes (t=4096 at b*h=128 OOMs one chip — which is itself the
-    # point of flash; the DCE'd-backward version of this bench "ran"
-    # it, r5 review catch).  flash additionally runs the big shapes
-    # einsum cannot hold at all.
-    for t, b in ((2048, 16), (4096, 4)):
-        for d, h in ((64, 8), (128, 4)):
-            out[f"flash_eff_t{t}_d{d}"] = round(
-                attn_eff(t, b, h, d, "flash"), 3)
-            out[f"einsum_eff_t{t}_d{d}"] = round(
-                attn_eff(t, b, h, d, "einsum"), 3)
-    for t, b in ((4096, 16), (16384, 2)):
-        for d, h in ((64, 8), (128, 4)):
-            out[f"flash_eff_t{t}_b{b}_d{d}"] = round(
-                attn_eff(t, b, h, d, "flash"), 3)
+    # The per-round core of the r5 decomposition (the full shape sweep
+    # lives in docs/parallelism-and-performance.md as one-off r5
+    # measurements): one head-to-head sequence length sized so EINSUM'S
+    # BACKWARD FITS — its materialized [b, h, t, t] f32 score buffers
+    # need ~4x b*h*t^2*4 bytes, and t=4096 at b*h=128 OOMs one chip
+    # outright (the DCE'd-backward version of this bench "ran" it, r5
+    # review catch) — plus the 16k flash-only points einsum cannot hold
+    # at all, plus the dense ceiling at BERT-base vs BERT-large-class
+    # hidden sizes.  Kept to 8 executables so the warm stage fits its
+    # bench-budget slot (~15-25 s of cache loads each over the tunnel).
+    for d, h in ((64, 8), (128, 4)):
+        out[f"flash_eff_t2048_d{d}"] = round(
+            attn_eff(2048, 16, h, d, "flash"), 3)
+        out[f"einsum_eff_t2048_d{d}"] = round(
+            attn_eff(2048, 16, h, d, "einsum"), 3)
+        out[f"flash_eff_t16384_b2_d{d}"] = round(
+            attn_eff(16384, 2, h, d, "flash"), 3)
     for H, I in ((768, 3072), (1536, 6144)):
         out[f"dense_eff_h{H}"] = round(dense_eff(32768, H, I), 3)
     return out
@@ -506,7 +507,11 @@ def main():
     # default budget leaves the BERT stage ~425s: enough for ONE cold
     # compile (~400s measured) so a fresh host still warms the
     # persistent cache on its first run instead of timing out forever
-    budget = float(os.environ.get("BENCH_TIME_BUDGET_S", 600))
+    # 750s default (r5): the warm stage ledger is bert ~60s + bert512
+    # ~75s + bertlarge ~110s + kernelbench ~150s + NCF 160s + longctx
+    # ~15s + serving ~25s ≈ 600s, and the vs_raw retry needs ~200s of
+    # slack on a jittery host
+    budget = float(os.environ.get("BENCH_TIME_BUDGET_S", 750))
     batch = int(os.environ.get("BENCH_BATCH", 65536))
     steps = int(os.environ.get("BENCH_STEPS", 30))
 
@@ -706,14 +711,24 @@ if __name__ == "__main__":
         #: paths time the SAME compiled step), so it retries and the
         #: best attempt is reported.
         VS_RAW_BAR = 0.95
-        budget = float(os.environ.get("BENCH_TIME_BUDGET_S", 600))
+        budget = float(os.environ.get("BENCH_TIME_BUDGET_S", 750))
         start = _t.monotonic()
         rc, best, best_vs = 0, None, -1.0
+        merged_extra = {}
         for attempt in (1, 2):
             remaining = max(60.0, budget - (_t.monotonic() - start))
             env = dict(os.environ,
                        _BENCH_ATTEMPT="1",
                        BENCH_TIME_BUDGET_S=str(remaining))
+            if attempt == 2 and merged_extra:
+                # the retry exists for the NCF headline (host jitter);
+                # re-running the BERT/kernel stages would blow whatever
+                # budget remains and time every stage out — their
+                # attempt-1 results are merged below.  Only skipped
+                # when attempt 1 actually MEASURED something: after a
+                # crash/hang that produced nothing, the retry is the
+                # run of record and keeps the full stage set.
+                env["BENCH_BERT"] = "0"
             try:
                 # hard wall: a stalled tunnel can HANG the client
                 # rather than crash it, and a hung attempt 1 would
@@ -725,14 +740,40 @@ if __name__ == "__main__":
                 rc = proc.returncode
             except subprocess.TimeoutExpired:
                 rc = -1
+            out = proc.stdout.decode() if rc != -1 else ""
             if rc == 0:
-                line = proc.stdout.decode().strip().splitlines()[-1]
-                result = json.loads(line)
+                try:
+                    result = json.loads(out.strip().splitlines()[-1])
+                except (IndexError, ValueError) as e:
+                    # a stray trailing line must not kill the wrapper
+                    # before the retry gets its chance
+                    print(f"bench attempt {attempt}: unparseable "
+                          f"output ({type(e).__name__})",
+                          file=sys.stderr)
+                    rc = 1
+                    continue
+                # stage extras merge across attempts: a success always
+                # lands; an error only fills a hole (attempt 2 runs
+                # NCF-only, so its "disabled" markers must not clobber
+                # attempt 1's measured stages)
+                for k, v in result.get("extra", {}).items():
+                    if k.endswith("_error"):
+                        merged_extra.setdefault(k, v)
+                    else:
+                        merged_extra[k] = v
                 vs_raw = float(result.get("extra", {})
                                .get("estimator_vs_raw") or 0.0)
                 if vs_raw > best_vs:
                     best, best_vs = result, vs_raw
                 if vs_raw >= VS_RAW_BAR:
+                    break
+                if (attempt == 1
+                        and budget - (_t.monotonic() - start) < 200):
+                    # the NCF-only retry needs ~200s; a doomed retry
+                    # just times out and reports nothing new
+                    print(f"bench: estimator_vs_raw {vs_raw:.3f} < "
+                          f"{VS_RAW_BAR} but no budget to re-measure",
+                          file=sys.stderr)
                     break
                 print(f"bench attempt {attempt}: estimator_vs_raw "
                       f"{vs_raw:.3f} < {VS_RAW_BAR} (host jitter); "
@@ -740,12 +781,43 @@ if __name__ == "__main__":
                          else "reporting best attempt"),
                       file=sys.stderr)
             else:
+                # keep the failed child's tail visible — it carries the
+                # partial diagnostics the old pass-through stdout did
+                if out:
+                    sys.stderr.write(out[-2000:])
                 print(f"bench attempt {attempt} exited rc={rc}"
                       + ("; retrying in a fresh process"
                          if attempt == 1 else ""),
                       file=sys.stderr)
         if best is not None:
+            # stage extras from whichever attempt measured them; the
+            # NCF-adjacent numbers must describe the SAME run as the
+            # headline, so they come from the best attempt
+            for k in ("ncf_raw_jit_samples_per_sec",
+                      "estimator_vs_raw", "cpu_raw_samples_per_sec"):
+                if k in best["extra"]:
+                    merged_extra[k] = best["extra"][k]
+            # drop an error marker only when ITS OWN stage's success
+            # keys landed in another attempt — prefix matching alone
+            # would let bert_large's success swallow bert-base's error
+            stage_keys = {
+                "bert_error": ("bert_finetune_tokens_per_sec",),
+                "bert_seq512_error": ("bert_seq512_tokens_per_sec",),
+                "bert_large_error": ("bert_large_seq512_tokens_per_sec",),
+                "kernelbench_error": ("dense_eff_h768",),
+                "serving_error": ("serving_records_per_sec",),
+                "longctx_error": ("flash_attention_seq16k_fwdbwd_ms",),
+            }
+            for k, succ in stage_keys.items():
+                if k in merged_extra and any(s in merged_extra
+                                             for s in succ):
+                    del merged_extra[k]
+            best["extra"] = merged_extra
             best["extra"]["vs_raw_bar"] = VS_RAW_BAR
+            if best_vs < VS_RAW_BAR:
+                # on the record: this run never met the bar, the best
+                # attempt is reported with the shortfall flagged
+                best["extra"]["vs_raw_below_bar"] = True
             print(json.dumps(best))
             sys.exit(0)
         sys.exit(rc)

@@ -39,8 +39,10 @@ _CASES = _collect_cases()
 
 @pytest.mark.parametrize("case", _CASES)
 def test_fsdp_case_in_child(case):
+    import time
+
     last_rc = None
-    for attempt in range(3):
+    for attempt in range(5):
         proc = subprocess.run(
             [sys.executable, "-m", "pytest",
              f"tests/_fsdp_cases.py::{case}", "-q",
@@ -55,7 +57,12 @@ def test_fsdp_case_in_child(case):
                 f"{case} failed in child (rc={last_rc}):\n"
                 + proc.stdout[-4000:] + proc.stderr[-2000:])
         # signal death (rc<0 from direct kill, or 128+sig via shells):
-        # the XLA:CPU rendezvous abort — retry in a fresh process
+        # the XLA:CPU rendezvous abort.  Under a sustained full-suite
+        # load spike the abort can repeat back-to-back (r5 observed 3
+        # consecutive), so back off before the fresh process — the
+        # spike passes, the retry then lands on a quieter host.
+        if attempt < 4:
+            time.sleep(5 * (attempt + 1))
     raise AssertionError(
-        f"{case} died on a signal in 3 consecutive children "
+        f"{case} died on a signal in 5 consecutive children "
         f"(last rc={last_rc}) — beyond rendezvous-flake odds")

@@ -258,3 +258,47 @@ def test_moe_aux_ignores_padded_rows(ep_mesh):
         np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
     finally:
         stop_orca_context()
+
+
+def test_moe_serving_bucket_padding_masked():
+    """r5: InferenceModel threads a row mask to token_mask-declaring
+    modules, so serving's power-of-two bucket padding cannot let
+    phantom rows claim MoE capacity.  Real-row outputs match the
+    unpadded call up to bucket-shape bf16 numerics, and are EXACTLY
+    independent of the phantom rows' content."""
+    import flax.linen as nn
+
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    class MoENet(nn.Module):
+        @nn.compact
+        def __call__(self, x, token_mask=None):
+            h, _aux = SwitchMoE(num_experts=4, hidden_size=8,
+                                ffn_size=16, capacity_factor=2.0)(
+                x, token_mask=token_mask)
+            return h
+
+    m = MoENet()
+    rng = np.random.default_rng(0)
+    x33 = rng.normal(size=(33, 8)).astype(np.float32)
+    params = m.init(jax.random.PRNGKey(0), x33)["params"]
+    im = InferenceModel(max_batch_size=64)
+    im.load_flax(m, params)
+    assert im._takes_mask
+    out = np.asarray(im.predict(x33))
+    ref = np.asarray(m.apply({"params": params}, x33))
+    assert out.shape == ref.shape == (33, 8)
+    # capacity is computed from the padded length, so bucket shapes
+    # differ — bf16 einsum tiling tolerance, not exactness
+    np.testing.assert_allclose(out, ref, atol=1e-2)
+    pad = np.zeros((64, 8), np.float32)
+    pad[:33] = x33
+    junk = rng.normal(size=(64, 8)).astype(np.float32)
+    junk[:33] = x33
+    mask = np.zeros(64, np.float32)
+    mask[:33] = 1.0
+    a = np.asarray(m.apply({"params": params}, pad,
+                           token_mask=mask))[:33]
+    b = np.asarray(m.apply({"params": params}, junk,
+                           token_mask=mask))[:33]
+    np.testing.assert_array_equal(a, b)
