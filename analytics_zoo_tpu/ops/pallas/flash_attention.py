@@ -53,7 +53,10 @@ _einsum = partial(jnp.einsum, precision=jax.lax.Precision.HIGHEST)
 
 #: measured on v5e-1 (b=4, h=8, d=64, t=4096 fwd+bwd): (256,256) 52ms,
 #: (512,512) 48ms, (512,1024) 45ms — bigger K tiles amortize the
-#: per-block online-softmax bookkeeping
+#: per-block online-softmax bookkeeping.  Re-validated at d=128
+#: (r5, t=16k fwd+bwd): an 8-config sweep found nothing beyond 1.03x
+#: of these defaults (within tunnel noise), so one tiling serves both
+#: head widths.
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
 #: backward tiles, measured at t=16k (bf16, masked): (512,512) 54ms,
