@@ -77,18 +77,20 @@ def _ncf_data(n):
     return u, i, y
 
 
-def _raw_loop_setup(dev, batch: int, steps: int):
+def _raw_loop_setup(dev, batch: int, steps: int, data=None):
     """The shared raw jax.jit training loop: jitted step, optax state,
     and `steps` DISTINCT device-resident batches (looping one batch
     would keep the same embedding rows cache-hot and overstate the
     ceiling).  ONE definition feeds both the TPU ceiling inside
     ncf_combined_throughput and the CPU vs_baseline denominator —
-    editing the loop cannot make those two apples-to-oranges."""
+    editing the loop cannot make those two apples-to-oranges.
+    `data` lets a caller that already built the (u, i, y) arrays share
+    them instead of regenerating."""
     import jax
     import optax
 
     model = _ncf_model()
-    u, i, y = _ncf_data(batch * steps)
+    u, i, y = data if data is not None else _ncf_data(batch * steps)
     with jax.default_device(dev):
         params = model.init(jax.random.PRNGKey(0), u[:1], i[:1])["params"]
         tx = optax.adam(1e-3)
@@ -128,7 +130,7 @@ def ncf_combined_throughput(batch: int, steps: int):
 
     u, i, y = _ncf_data(batch * steps)
     step, params, opt_state, batches = _raw_loop_setup(
-        jax.devices()[0], batch, steps)
+        jax.devices()[0], batch, steps, data=(u, i, y))
 
     prev_store = OrcaContext.train_data_store
     prev_cap = OrcaContext.device_cache_bytes
@@ -492,6 +494,10 @@ def serving_metrics(clients: int = 64, duration_s: float = 6.0,
             t.start()
         for t in threads:
             t.join()
+        # snapshot NOW: the timer reservoir keeps the newest samples,
+        # and the batched phase below would mix its near-zero queue
+        # waits into the per-record decomposition being published
+        per_record_summary = srv.timer.summary()
 
         # pre-batched mode: 4 concurrent clients x 512 records per
         # request (matches supported_concurrent_num, so dispatches
@@ -531,6 +537,16 @@ def serving_metrics(clients: int = 64, duration_s: float = 6.0,
         "serving_batched_records_per_sec": round(batched_tput, 1),
         "serving_clients": clients,
     }
+    # the r5 regime decomposition on the record: queue wait vs device
+    # time says WHICH bound the p50 is (on this tunneled host, predict
+    # is dominated by the ~110 ms dispatch round trip; host-attached,
+    # it would be device time) — see docs/serving-guide.md.  Taken from
+    # the snapshot made before the batched phase, so it describes the
+    # per-record mode it sits next to.
+    for op, key in (("queue_wait", "serving_queue_wait_p50_ms"),
+                    ("predict", "serving_predict_p50_ms")):
+        if op in per_record_summary and "p50_ms" in per_record_summary[op]:
+            out[key] = per_record_summary[op]["p50_ms"]
     if errors[0]:
         out["serving_client_errors"] = errors[0]
     return out
@@ -604,8 +620,6 @@ def main():
         except Exception as e:
             bert_extra.setdefault(
                 "kernelbench_error", f"{type(e).__name__}: {e}"[:200])
-
-    import jax
 
     from analytics_zoo_tpu import init_orca_context
     init_orca_context(cluster_mode="local")
