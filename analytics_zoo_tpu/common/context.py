@@ -54,6 +54,7 @@ class OrcaContextMeta(type):
     _epoch_scan_unroll = "auto"
     _failure_retry_times = 5
     _failure_retry_interval_s = 1.0
+    _observability_dir = None
 
     # --- TPU runtime state ---
     _mesh = None
@@ -182,6 +183,19 @@ class OrcaContextMeta(type):
         if float(value) < 0:
             raise ValueError("failure_retry_interval_s must be >= 0")
         cls._failure_retry_interval_s = float(value)
+
+    @property
+    def observability_dir(cls):
+        """Directory for the structured-event JSONL sink
+        (`observability.log_event` and completed spans append to
+        `<dir>/events.jsonl`).  None (default) disables the sink;
+        in-memory metrics/spans and the serving /metrics and /spans
+        endpoints work regardless."""
+        return cls._observability_dir
+
+    @observability_dir.setter
+    def observability_dir(cls, value):
+        cls._observability_dir = None if value is None else str(value)
 
     @property
     def mesh(cls):

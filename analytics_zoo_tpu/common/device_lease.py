@@ -34,9 +34,10 @@ wait-time stats are exposed for tests and observability.
 from __future__ import annotations
 
 import threading
-import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
+
+from analytics_zoo_tpu.observability import get_registry, now
 
 _lock = threading.Lock()          # the lease itself (exclusive, not FIFO)
 _state_lock = threading.Lock()    # guards the bookkeeping below
@@ -68,24 +69,33 @@ def device_lease(name: str = "anonymous", timeout: Optional[float] = None):
     ...     pass  # jit/compile/execute on the device here
     """
     global _current_holder
-    t0 = time.perf_counter()
+    t0 = now()
     ok = _lock.acquire(timeout=timeout if timeout is not None else -1)
     if not ok:
         raise TimeoutError(
             f"device lease not acquired within {timeout}s "
             f"(held by {_current_holder!r})")
-    waited = time.perf_counter() - t0
+    waited = now() - t0
+    get_registry().histogram(
+        "device_lease_wait_seconds",
+        help="time spent waiting for the host accelerator lease",
+    ).record(waited)
     with _state_lock:
         _current_holder = name
         _stats["acquisitions"] += 1
         _stats["total_wait_s"] += waited
         _history.append(name)
         del _history[:-256]
-    t1 = time.perf_counter()
+    t1 = now()
     try:
         yield
     finally:
+        held = now() - t1
+        get_registry().histogram(
+            "device_lease_hold_seconds",
+            help="time the host accelerator lease was held",
+        ).record(held)
         with _state_lock:
             _current_holder = None
-            _stats["total_hold_s"] += time.perf_counter() - t1
+            _stats["total_hold_s"] += held
         _lock.release()

@@ -1,0 +1,53 @@
+"""Unified observability layer (metrics registry + span tracing +
+structured events) shared by training, serving, the parallel runtimes
+and the FL server.
+
+Three primitives, one substrate:
+
+* `get_registry()` — the process-global `MetricsRegistry` (counters,
+  gauges, bounded-reservoir histograms); exposable as Prometheus text
+  (`prometheus_text`) and consumed back with `parse_prometheus_text`.
+* `trace(name, **attrs)` — contextvar-propagated spans; cross-thread
+  hops pass `current_span()` explicitly.  Completed spans are readable
+  via `recent_spans` (served as GET /spans by the serving frontend).
+* `log_event(kind, **fields)` — countable structured events, appended
+  as JSONL under `OrcaContext.observability_dir` when set.
+
+`now` is the single sanctioned wall-time clock for instrumentation
+(`time.perf_counter`); scripts/check_no_ad_hoc_timers.py keeps new
+stopwatches from sprouting outside this package.
+"""
+
+from analytics_zoo_tpu.observability.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    merged_prometheus_text,
+    nearest_rank,
+    now,
+    parse_prometheus_text,
+    reset_registry,
+    sanitize_metric_name,
+)
+from analytics_zoo_tpu.observability.tracing import (  # noqa: F401
+    Span,
+    annotate,
+    clear_spans,
+    current_span,
+    recent_spans,
+    trace,
+)
+from analytics_zoo_tpu.observability.events import (  # noqa: F401
+    close_sink,
+    log_event,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "Span",
+    "annotate", "clear_spans", "close_sink", "current_span",
+    "get_registry", "log_event", "merged_prometheus_text",
+    "nearest_rank", "now", "parse_prometheus_text", "recent_spans",
+    "reset_registry", "sanitize_metric_name", "trace",
+]

@@ -36,6 +36,7 @@ class NaNLossError(RuntimeError):
     non-finite loss/gradients (the skipped steps are reported)."""
 
 from analytics_zoo_tpu.common.context import OrcaContext
+from analytics_zoo_tpu.observability import annotate, log_event, trace
 from analytics_zoo_tpu.orca.learn import losses as losses_mod
 from analytics_zoo_tpu.orca.learn import metrics as metrics_mod
 from analytics_zoo_tpu.orca.learn import optimizers as optim_mod
@@ -253,33 +254,55 @@ class Estimator:
                         if max_failures is None else max_failures)
         pending_restore = False
 
-        while self._epoch < target_epoch:
-            try:
-                if pending_restore:
-                    # inside the try: a still-broken checkpoint/data source
-                    # must consume retry budget, not escape the loop
-                    self._restore_latest(start_epoch, target_epoch)
-                    pending_restore = False
-                self._fit_one_epoch(ds, val_ds, batch_size, trigger,
-                                    shuffle, nan_policy, profile,
-                                    dds=dds)
-            except (NaNLossError, KeyboardInterrupt):
-                raise
-            except Exception as e:
-                if retries_left <= 0 or not self.model_dir:
+        # NOTE: no `n=ds.n` attr here — for streaming XShards input,
+        # `ds.n` runs a full pass over the shards, and a shard failure
+        # during it would escape the retry loop below (the epoch span
+        # carries the row count once it's cheaply known)
+        with trace("estimator.fit", epochs=epochs,
+                   batch_size=batch_size):
+            while self._epoch < target_epoch:
+                try:
+                    if pending_restore:
+                        # inside the try: a still-broken checkpoint/data
+                        # source must consume retry budget, not escape
+                        # the loop
+                        self._restore_latest(start_epoch, target_epoch)
+                        pending_restore = False
+                    self._fit_one_epoch(ds, val_ds, batch_size, trigger,
+                                        shuffle, nan_policy, profile,
+                                        dds=dds)
+                except (NaNLossError, KeyboardInterrupt):
                     raise
-                retries_left -= 1
-                self.retries += 1
-                logger.warning(
-                    "training failed (%s: %s); restoring latest checkpoint "
-                    "and retrying (%d retries left)",
-                    type(e).__name__, e, retries_left)
-                time.sleep(OrcaContext.failure_retry_interval_s)
-                pending_restore = True
+                except Exception as e:
+                    if retries_left <= 0 or not self.model_dir:
+                        raise
+                    retries_left -= 1
+                    self.retries += 1
+                    log_event("fit_retry",
+                              error=f"{type(e).__name__}: {e}",
+                              retries_left=retries_left)
+                    logger.warning(
+                        "training failed (%s: %s); restoring latest "
+                        "checkpoint and retrying (%d retries left)",
+                        type(e).__name__, e, retries_left)
+                    time.sleep(OrcaContext.failure_retry_interval_s)
+                    pending_restore = True
         return self
 
     def _fit_one_epoch(self, ds, val_ds, batch_size, trigger, shuffle,
                        nan_policy, profile=False, dds=None):
+        # the epoch span parents the engine's spmd.step spans (same
+        # thread), giving fit -> epoch -> step the Dapper-style tree
+        with trace("estimator.epoch", epoch=self._epoch,
+                   step_start=(self._engine.host_step
+                               if self._engine else 0)):
+            self._fit_one_epoch_inner(ds, val_ds, batch_size, trigger,
+                                      shuffle, nan_policy, profile,
+                                      dds=dds)
+
+    def _fit_one_epoch_inner(self, ds, val_ds, batch_size, trigger,
+                             shuffle, nan_policy, profile=False,
+                             dds=None):
         eng = self._engine
         mult = eng.pad_multiple()
 
@@ -318,6 +341,10 @@ class Estimator:
                      samples_per_s=ds.n / max(time.time() - t0, 1e-9))
         self.train_summary.append(stats)
         self._tb_log("train", stats, step)
+        # JSONL structured-event sink + span attrs: the same epoch
+        # stats TensorBoard gets, machine-readable in-process
+        annotate(step=step, loss=stats.get("loss"))
+        log_event("train_epoch", **stats)
         if val_ds is not None:
             vstats = eng.run_epoch(
                 val_ds.batches(batch_size,
@@ -326,6 +353,7 @@ class Estimator:
             vstats.update(epoch=self._epoch, step=step)
             self.val_summary.append(vstats)
             self._tb_log("validation", vstats, step)
+            log_event("validation_epoch", **vstats)
         if trigger and self.model_dir and trigger(
                 epoch=self._epoch, step=step, epoch_end=True):
             self.save_checkpoint()
@@ -468,19 +496,21 @@ class Estimator:
                 "evaluate requires labels: pass {'x': ..., 'y': ...}, an "
                 "(x, y) tuple, or label_cols for DataFrame input")
         self._ensure_engine(ds.probe(batch_size))
-        return self._engine.run_epoch(
-            ds.batches(batch_size,
-                       pad_to_multiple_of=self._engine.pad_multiple()),
-            train=False)
+        with trace("estimator.evaluate", n=ds.n, batch_size=batch_size):
+            return self._engine.run_epoch(
+                ds.batches(batch_size,
+                           pad_to_multiple_of=self._engine.pad_multiple()),
+                train=False)
 
     def predict(self, data, batch_size: int = 32, feature_cols=None):
         """Returns stacked predictions (numpy).  For XShards/DataFrame input
         the row order of the input is preserved."""
         ds = HostDataset.from_data(data, feature_cols, None)
         self._ensure_engine(ds.probe(batch_size))
-        outs = self._engine.predict_all(
-            ds.batches(batch_size,
-                       pad_to_multiple_of=self._engine.pad_multiple()))
+        with trace("estimator.predict", n=ds.n, batch_size=batch_size):
+            outs = self._engine.predict_all(
+                ds.batches(batch_size,
+                           pad_to_multiple_of=self._engine.pad_multiple()))
         if not outs:
             return None
         if isinstance(outs[0], (tuple, list)):

@@ -22,7 +22,7 @@ Keras-style API, the flax path, and the torch importer all lower to.
 
 from __future__ import annotations
 
-import time
+from contextlib import contextmanager
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -33,7 +33,14 @@ import optax
 from flax import struct
 
 from analytics_zoo_tpu.common.context import OrcaContext
+from analytics_zoo_tpu.observability import (
+    annotate,
+    get_registry,
+    now,
+    trace,
+)
 from analytics_zoo_tpu.parallel.sharding import (
+    _count_device_put_bytes,
     batch_sharding,
     data_parallelism,
     infer_param_shardings,
@@ -165,6 +172,12 @@ class SPMDEngine:
         #: that just logged the step number were paying it every epoch.
         #: Resync via sync_host_step() after restoring external state.
         self.host_step = 0
+        #: which jitted entry points have dispatched at least once —
+        #: the first dispatch of each blocks on XLA compilation, so its
+        #: wall time IS (approximately) the compile time; step spans
+        #: carry `jit_cold=True` and the duration lands in the
+        #: `jax_jit_compile_seconds` histogram
+        self._jit_warm: set = set()
 
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
         self._eval_step = jax.jit(self._eval_step_impl)
@@ -420,6 +433,7 @@ class SPMDEngine:
                 "labels": tuple(prep(a) for a in labels),
                 "mask": prep(mask)}
         nbytes = sum(a.nbytes for a in jax.tree_util.tree_leaves(tree))
+        _count_device_put_bytes(tree)
         dev = jax.device_put(tree, stacked_batch_sharding(self.mesh))
         return DeviceDataset(dev, steps, b, n, nbytes)
 
@@ -432,6 +446,7 @@ class SPMDEngine:
         transfers at all; steps index batches out of the cached arrays
         inside the jit.  Shuffling is a device-side full-row permutation
         per epoch."""
+        self._annotate_mesh()
         data = dds.data
         if shuffle:
             rng = jax.random.fold_in(jax.random.PRNGKey(seed), epoch)
@@ -443,42 +458,48 @@ class SPMDEngine:
             # epoch-program comment in __init__)
             self.last_profile = []
             unroll = self._epoch_unroll(dds.steps)
-            if train:
-                start_state = self.state
-                self.state, totals = self._train_epoch_scan(
-                    start_state, data, unroll, False)
-                self.host_step += dds.steps
-                out = self._fetch_totals(totals)
-                if out.get("nan_steps"):
-                    # restore first: if the replay itself fails (compile
-                    # error, RPC loss), self.state must not be left on
-                    # the NaN-poisoned fast-run result — and the epoch
-                    # program never donates, so start_state stays valid
-                    # through a mid-execution replay failure too
-                    self.state = start_state
+            with trace("spmd.epoch_scan", steps=dds.steps, train=train,
+                       unroll=unroll):
+                if train:
+                    start_state = self.state
                     self.state, totals = self._train_epoch_scan(
-                        start_state, data, unroll, True)
+                        start_state, data, unroll, False)
+                    self.host_step += dds.steps
                     out = self._fetch_totals(totals)
-                return out
-            totals = self._eval_epoch_scan(self.state, data, unroll)
-            return self._fetch_totals(totals)
+                    if out.get("nan_steps"):
+                        # restore first: if the replay itself fails
+                        # (compile error, RPC loss), self.state must not
+                        # be left on the NaN-poisoned fast-run result —
+                        # and the epoch program never donates, so
+                        # start_state stays valid through a
+                        # mid-execution replay failure too
+                        self.state = start_state
+                        self.state, totals = self._train_epoch_scan(
+                            start_state, data, unroll, True)
+                        out = self._fetch_totals(totals)
+                    return out
+                totals = self._eval_epoch_scan(self.state, data, unroll)
+                return self._fetch_totals(totals)
         totals = None
         step = self.host_step if train else 0
         self.last_profile = []
         step_fn = (self._train_step_cached if train
                    else self._eval_step_cached)
+        kind = "train_cached" if train else "eval_cached"
         for i in range(dds.steps):
-            t0 = time.perf_counter() if profile else 0.0
-            if train:
-                self.state, stats = step_fn(self.state, data, i)
-                step += 1
-            else:
-                stats = step_fn(self.state, data, i)
+            t0 = now() if profile else 0.0
+            with self._step_span(kind, step + 1 if train else step,
+                                 train):
+                if train:
+                    self.state, stats = step_fn(self.state, data, i)
+                    step += 1
+                else:
+                    stats = step_fn(self.state, data, i)
             if profile:
                 jax.block_until_ready(stats["_count"])
                 self.last_profile.append(
                     {"step": step,
-                     "step_time_s": time.perf_counter() - t0})
+                     "step_time_s": now() - t0})
             if totals is None:
                 totals = jax.tree_util.tree_map(jnp.zeros_like, stats)
             totals = self._accum(totals, stats)
@@ -508,6 +529,33 @@ class SPMDEngine:
         while staged:
             yield staged.popleft()
 
+    def _annotate_mesh(self):
+        """Stamp the enclosing span (estimator.epoch, a bench harness,
+        ...) with the mesh layout — how an fsdp/tp/pp run's spans are
+        told apart from pure-dp ones in /spans output."""
+        annotate(mesh={a: int(self.mesh.shape[a])
+                       for a in self.mesh.axis_names})
+
+    @contextmanager
+    def _step_span(self, kind: str, step: int, train: bool):
+        """Span around one step dispatch.  The first dispatch of each
+        jitted entry point blocks on XLA compilation, so that span's
+        duration ≈ compile time: it is flagged `jit_cold` and recorded
+        into `jax_jit_compile_seconds`; warm dispatches are async, so
+        their spans measure dispatch (not device) time."""
+        cold = kind not in self._jit_warm
+        attrs = {"step": step, "train": train}
+        if cold:
+            attrs["jit_cold"] = True
+        with trace("spmd.step", **attrs) as sp:
+            yield sp
+        if cold:
+            self._jit_warm.add(kind)
+            get_registry().histogram(
+                "jax_jit_compile_seconds",
+                help="wall time of first (compiling) jit dispatches",
+            ).record(sp.duration_s)
+
     def run_epoch(self, batch_iter, train: bool = True,
                   on_step: Optional[Callable[[int], None]] = None,
                   profile: bool = False) -> Dict[str, float]:
@@ -522,18 +570,23 @@ class SPMDEngine:
         (see `_prefetch`) — so the accelerator pipeline stays full
         (VERDICT r1 weak #2).
         """
+        self._annotate_mesh()
         totals = None
         # host-side step mirror: avoids a device sync per step just to
         # know the step number
         step = self.host_step if train else 0
         self.last_profile = []
+        kind = "train" if train else "eval"
         for batch in self._prefetch(batch_iter):
-            t0 = time.perf_counter() if profile else 0.0
-            if train:
-                self.state, stats = self._train_step(self.state, batch)
-                step += 1
-            else:
-                stats = self._eval_step(self.state, batch)
+            t0 = now() if profile else 0.0
+            with self._step_span(kind, step + 1 if train else step,
+                                 train):
+                if train:
+                    self.state, stats = self._train_step(self.state,
+                                                         batch)
+                    step += 1
+                else:
+                    stats = self._eval_step(self.state, batch)
             if profile:
                 # opt-in: blocking per step defeats async dispatch, but
                 # gives true per-step wall time (reference torch_runner
@@ -541,7 +594,7 @@ class SPMDEngine:
                 jax.block_until_ready(stats["_count"])
                 self.last_profile.append(
                     {"step": step,
-                     "step_time_s": time.perf_counter() - t0})
+                     "step_time_s": now() - t0})
             if totals is None:
                 totals = jax.tree_util.tree_map(jnp.zeros_like, stats)
             totals = self._accum(totals, stats)
@@ -613,7 +666,9 @@ class SPMDEngine:
         for host_batch in batch_iter:
             n_real = int(host_batch["mask"].sum())
             batch = self.put_batch(host_batch)
-            preds = jax.device_get(self._predict_step(self.state, batch))
+            with self._step_span("predict", len(outs), False):
+                preds = jax.device_get(
+                    self._predict_step(self.state, batch))
             outs.append(jax.tree_util.tree_map(lambda a: a[:n_real], preds))
         return outs
 

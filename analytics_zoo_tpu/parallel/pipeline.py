@@ -371,5 +371,8 @@ def stack_stage_params(per_stage_params) -> object:
     """[params_stage0, params_stage1, ...] (identical treedefs) ->
     one pytree with a leading [S, ...] stage axis, ready for
     PIPELINE_SHARD_RULES."""
-    return jax.tree_util.tree_map(
-        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+    from analytics_zoo_tpu.observability import trace
+    with trace("pipeline.stack_stage_params",
+               stages=len(per_stage_params)):
+        return jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *per_stage_params)

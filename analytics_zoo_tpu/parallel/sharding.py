@@ -89,14 +89,31 @@ def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
     sharding = batch_sharding(mesh)
 
     if jax.process_count() == 1:
-        return jax.device_put(
-            jax.tree_util.tree_map(np.asarray, batch), sharding)
+        host = jax.tree_util.tree_map(np.asarray, batch)
+        _count_device_put_bytes(host)
+        return jax.device_put(host, sharding)
 
     def _one(x):
         x = np.asarray(x)
+        _count_device_put_bytes(x)
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree_util.tree_map(_one, batch)
+
+
+def _count_device_put_bytes(tree: Any) -> None:
+    """Account host→device transfer volume (the JAX-aware counter the
+    span layer annotates from): `jax_device_put_bytes_total` in the
+    global registry covers every batch staged by `shard_batch` plus
+    the DEVICE-tier dataset uploads (`SPMDEngine.cache_dataset`)."""
+    from analytics_zoo_tpu.observability import annotate, get_registry
+    nbytes = sum(a.nbytes for a in jax.tree_util.tree_leaves(tree)
+                 if hasattr(a, "nbytes"))
+    get_registry().counter(
+        "jax_device_put_bytes_total",
+        help="bytes staged host->device by shard_batch/cache_dataset",
+    ).inc(nbytes)
+    annotate(device_put_bytes=nbytes)
 
 
 # ---------------------------------------------------------------------------

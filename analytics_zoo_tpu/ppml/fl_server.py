@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
+from analytics_zoo_tpu.observability import get_registry, log_event, trace
 from analytics_zoo_tpu.ppml import fl_proto as P
 
 
@@ -151,6 +152,9 @@ class FLServer:
     def _upload_train(self, request: bytes, context) -> bytes:
         uuid, (name, version, tensors) = P.dec_upload_request(request)
         ps = self._ps
+        get_registry().counter(
+            "fl_uploads_total",
+            help="client train uploads received").inc()
         with ps.lock:
             if uuid not in ps.registered:
                 return P.enc_code_response("not registered", P.ERROR)
@@ -160,14 +164,23 @@ class FLServer:
             # must not trigger a partial aggregation (reference clientNum
             # semantics)
             if len(ps.pending[version]) >= ps.min_clients:
-                # FedAvg: average every tensor across clients
+                # FedAvg: average every tensor across clients — one
+                # span per aggregation round, the FL analog of the
+                # serving run_batch span
                 uploads = list(ps.pending.pop(version).values())
-                agg = {
-                    k: np.mean([u[k] for u in uploads], axis=0)
-                    for k in uploads[0]
-                }
+                with trace("fl.aggregate_round", version=version + 1,
+                           clients=len(uploads)):
+                    agg = {
+                        k: np.mean([u[k] for u in uploads], axis=0)
+                        for k in uploads[0]
+                    }
                 ps.global_tables[version + 1] = agg
                 ps.version = version + 1
+                get_registry().counter(
+                    "fl_rounds_total",
+                    help="FedAvg aggregation rounds completed").inc()
+                log_event("fl_round", version=ps.version,
+                          clients=len(uploads))
                 # clients only ever fetch the newest version; keep a
                 # small window so long trainings don't grow unbounded
                 for old in [v for v in ps.global_tables

@@ -19,6 +19,17 @@ Endpoints:
   POST /enqueue  — async: {"uri": id, "inputs": [...]}; result fetched via
   GET  /result/<uri> — {"status": "pending"|"ok", "outputs": [...]}
   GET  /healthz  — liveness + records served
+  GET  /metrics  — Prometheus text exposition: this server's per-op
+                   latency summaries (serving_queue_wait_seconds,
+                   serving_predict_seconds, ...), request/record/batch
+                   counters and live gauges (queue depth, worker-pool
+                   utilization), merged with the process-global registry
+                   (training spans, FL rounds, ...)
+  GET  /spans    — JSON dump of the most recent N completed spans
+                   (?n=, default 100), newest first
+  GET  /stats    — JSON operational snapshot: records_served, batcher
+                   queue depth, worker-pool utilization, per-op timer
+                   summaries
 """
 
 from __future__ import annotations
@@ -32,6 +43,16 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.observability import (
+    MetricsRegistry,
+    current_span,
+    get_registry,
+    log_event,
+    merged_prometheus_text,
+    now,
+    recent_spans,
+    trace,
+)
 from analytics_zoo_tpu.serving.codec import (
     ARROW_CONTENT_TYPE,
     decode_arrow_tensors,
@@ -43,14 +64,19 @@ from analytics_zoo_tpu.serving.inference_model import InferenceModel
 
 
 class _Pending:
-    __slots__ = ("inputs", "event", "outputs", "error", "t_enqueue")
+    __slots__ = ("inputs", "event", "outputs", "error", "t_enqueue",
+                 "span")
 
     def __init__(self, inputs: Tuple[np.ndarray, ...]):
         self.inputs = inputs
         self.event = threading.Event()
         self.outputs = None
         self.error: Optional[str] = None
-        self.t_enqueue = time.perf_counter()
+        self.t_enqueue = now()
+        # the submitting side's open span (HTTP handler thread); the
+        # batcher/executor thread links its run_batch span to it —
+        # contextvars don't flow across the queue hop
+        self.span = current_span()
 
 
 class ServingServer:
@@ -88,20 +114,58 @@ class ServingServer:
         # batches may complete on concurrent executor threads
         self._stats_lock = threading.Lock()
         from analytics_zoo_tpu.serving.timer import Timer
-        self.timer = Timer()
+        # per-SERVER registry (op timers, request counters, live
+        # gauges): isolated from other servers in this process, merged
+        # with the process-global registry at /metrics exposition
+        self.registry = MetricsRegistry()
+        self.timer = Timer(registry=self.registry, prefix="serving_")
+        self._c_requests = self.registry.counter(
+            "serving_requests_total", help="HTTP requests handled")
+        self._c_http_errors = self.registry.counter(
+            "serving_http_errors_total",
+            help="HTTP responses with status >= 400")
+        self._c_records = self.registry.counter(
+            "serving_records_served_total",
+            help="records returned by successful batches")
+        self._c_batches = self.registry.counter(
+            "serving_batches_total", help="device batches run")
+        self.registry.gauge(
+            "serving_queue_depth", fn=self._queue.qsize,
+            help="requests waiting in the dynamic batcher queue")
+        self.registry.gauge(
+            "serving_replicas",
+            fn=lambda: (worker_pool.n_workers
+                        if worker_pool is not None else 1),
+            help="model replicas behind this server")
+        if worker_pool is not None:
+            self.registry.gauge(
+                "serving_worker_utilization",
+                fn=worker_pool.utilization,
+                help="fraction of worker-pool replicas busy")
 
         server = self
 
         class Handler(BaseHTTPRequestHandler):
             daemon_threads = True
 
-            def log_message(self, *args):  # quiet
-                pass
+            def log_message(self, fmt, *args):
+                # http.server's default stderr chatter becomes a
+                # countable structured event instead of being dropped
+                log_event("http_log", message=fmt % args,
+                          client=self.client_address[0])
 
             def _json(self, code: int, payload: Dict[str, Any]):
                 body = json.dumps(payload).encode()
+                self._body(code, body, "application/json")
+
+            def _body(self, code: int, body: bytes, ctype: str):
+                server._c_requests.inc()
+                if code >= 400:
+                    server._c_http_errors.inc()
+                    log_event("http_error", code=code, path=self.path,
+                              client=self.client_address[0])
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -115,10 +179,27 @@ class ServingServer:
                                      if server.worker_pool else 1),
                         "batches_run": server._batches_run})
                     return
-                if self.path == "/metrics":
-                    # per-op latency histograms (reference Flink serving
-                    # Timer.scala printouts, as a scrapeable endpoint)
-                    self._json(200, server.timer.summary())
+                if self.path.startswith("/metrics"):
+                    # Prometheus text exposition (pull model): this
+                    # server's op summaries/counters/gauges + the
+                    # process-global registry (training, FL, spans)
+                    text = merged_prometheus_text(server.registry,
+                                                  get_registry())
+                    self._body(200, text.encode(),
+                               "text/plain; version=0.0.4")
+                    return
+                if self.path.startswith("/spans"):
+                    n = 100
+                    if "n=" in self.path:
+                        try:
+                            n = int(self.path.split("n=")[1]
+                                    .split("&")[0])
+                        except ValueError:
+                            pass
+                    self._json(200, {"spans": recent_spans(n)})
+                    return
+                if self.path.startswith("/stats"):
+                    self._json(200, server.stats())
                     return
                 if self.path.startswith("/result/"):
                     uri = self.path[len("/result/"):]
@@ -163,18 +244,16 @@ class ServingServer:
                         self._json(400, {"error": str(e)})
                         return
                 if self.path == "/predict":
-                    out, err = server._submit(inputs)
+                    # span opened on the handler thread; the batch it
+                    # joins links back to it from the batcher thread
+                    with trace("serving.http_request", path=self.path,
+                               records=len(inputs[0])):
+                        out, err = server._submit(inputs)
                     if err:
                         self._json(500, {"error": err})
                     elif arrow:
                         blob = encode_arrow_tensors(list(out))
-                        self.send_response(200)
-                        self.send_header("Content-Type",
-                                         ARROW_CONTENT_TYPE)
-                        self.send_header("Content-Length",
-                                         str(len(blob)))
-                        self.end_headers()
-                        self.wfile.write(blob)
+                        self._body(200, blob, ARROW_CONTENT_TYPE)
                     else:
                         self._json(200, {"outputs": [
                             encode_ndarray(o) for o in out]})
@@ -308,47 +387,81 @@ class ServingServer:
                 executor.shutdown(wait=False)
 
     def _run_batch(self, batch: List[_Pending]):
-        try:
-            # group by input signature; same-shape single records stack
-            sizes = [len(p.inputs[0]) for p in batch]
-            # record timings only on success: the heterogeneous-shape
-            # fallback re-runs per request, and counting the failed
-            # whole-batch attempt would double-book /metrics
-            t0 = time.perf_counter()
-            stacked = tuple(
-                np.concatenate([p.inputs[i] for p in batch])
-                for i in range(len(batch[0].inputs)))
-            t1 = time.perf_counter()
-            outs = self._predict(*stacked)
-            # the regime decomposition an operator needs (VERDICT r4
-            # weak #6): queue_wait dominating means batching/backlog —
-            # add replicas or raise max_batch_size; predict dominating
-            # means device-bound (on a tunneled device it is mostly the
-            # dispatch round trip)
-            self.timer.record(
-                "queue_wait",
-                sum(t0 - p.t_enqueue for p in batch) / len(batch),
-                sum(sizes))
-            self.timer.record("batch_assemble", t1 - t0, sum(sizes))
-            self.timer.record("predict", time.perf_counter() - t1,
-                              sum(sizes))
-            with self._stats_lock:
-                self._batches_run += 1
-            if not isinstance(outs, tuple):
-                outs = (outs,)
-            off = 0
-            for p, n in zip(batch, sizes):
-                p.outputs = [o[off:off + n] for o in outs]
-                off += n
-                p.event.set()
-        except Exception as e:
-            # heterogenous shapes in one batch: fall back to per-request
-            if len(batch) > 1:
-                for p in batch:
-                    self._run_batch([p])
-                return
-            batch[0].error = f"{type(e).__name__}: {e}"
-            batch[0].event.set()
+        # runs on the batcher (or an executor) thread: the span links
+        # to the first member's enqueue-side span explicitly — the
+        # contextvar did not follow the request across the queue
+        with trace("serving.run_batch", parent=batch[0].span,
+                   batch_size=len(batch)) as span:
+            try:
+                # group by input signature; same-shape records stack
+                sizes = [len(p.inputs[0]) for p in batch]
+                span.attrs["records"] = sum(sizes)
+                # record timings only on success: the heterogeneous-
+                # shape fallback re-runs per request, and counting the
+                # failed whole-batch attempt would double-book /metrics
+                t0 = now()
+                stacked = tuple(
+                    np.concatenate([p.inputs[i] for p in batch])
+                    for i in range(len(batch[0].inputs)))
+                t1 = now()
+                outs = self._predict(*stacked)
+                t2 = now()
+                # the regime decomposition an operator needs (VERDICT
+                # r4 weak #6): queue_wait dominating means batching/
+                # backlog — add replicas or raise max_batch_size;
+                # predict dominating means device-bound (on a tunneled
+                # device it is mostly the dispatch round trip)
+                self.timer.record(
+                    "queue_wait",
+                    sum(t0 - p.t_enqueue for p in batch) / len(batch),
+                    sum(sizes))
+                self.timer.record("batch_assemble", t1 - t0, sum(sizes))
+                self.timer.record("predict", t2 - t1, sum(sizes))
+                span.attrs["predict_s"] = round(t2 - t1, 6)
+                self._c_records.inc(sum(sizes))
+                self._c_batches.inc()
+                with self._stats_lock:
+                    self._batches_run += 1
+                if not isinstance(outs, tuple):
+                    outs = (outs,)
+                off = 0
+                for p, n in zip(batch, sizes):
+                    p.outputs = [o[off:off + n] for o in outs]
+                    off += n
+                    p.event.set()
+            except Exception as e:
+                # heterogenous shapes in one batch: fall back to
+                # per-request
+                if len(batch) > 1:
+                    for p in batch:
+                        self._run_batch([p])
+                    return
+                batch[0].error = f"{type(e).__name__}: {e}"
+                log_event("batch_error", error=batch[0].error,
+                          records=len(batch[0].inputs[0]))
+                batch[0].event.set()
+
+    def stats(self) -> Dict[str, Any]:
+        """Operational snapshot (the GET /stats payload): counters,
+        live batcher queue depth, worker-pool utilization and the
+        per-op timer summaries, all from the server's registry."""
+        out: Dict[str, Any] = {
+            "records_served": self.records_served,
+            "batches_run": self._batches_run,
+            "queue_depth": self._queue.qsize(),
+            "replicas": (self.worker_pool.n_workers
+                         if self.worker_pool else 1),
+            "timers": self.timer.summary(),
+        }
+        if self.worker_pool is not None:
+            out["worker_pool"] = {
+                "n_workers": self.worker_pool.n_workers,
+                "busy": self.worker_pool.busy_workers,
+                "utilization": self.worker_pool.utilization(),
+                "per_worker_served":
+                    self.worker_pool.per_worker_served(),
+            }
+        return out
 
     # ------------------------------------------------------------------
 

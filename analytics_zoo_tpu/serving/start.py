@@ -25,6 +25,10 @@ def main(argv=None):
     if args.no_block:
         ports = {k: getattr(v, "port", None) for k, v in servers.items()
                  if k != "model"}
+        # CLI feedback stays on stdout, but the structured event makes
+        # server starts countable/auditable like everything else
+        from analytics_zoo_tpu.observability import log_event
+        log_event("serving_started", job=cfg.job_name, ports=ports)
         print(f"serving '{cfg.job_name}' started: {ports}")
     return servers
 

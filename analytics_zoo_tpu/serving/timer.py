@@ -1,74 +1,91 @@
 """Per-op serving timers (reference `serving/engine/Timer.scala:26-100`
 — accumulators + histogram printouts per op — and the `Supportive.timing`
-wrapper, `serving/utils/Supportive.scala:22`)."""
+wrapper, `serving/utils/Supportive.scala:22`).
+
+Since the unified observability layer landed, `Timer` is a thin adapter
+over `observability.MetricsRegistry` histograms: same public API
+(`timing` / `record` / `summary` / `print`, nearest-rank percentiles),
+but the data lives in registry `Histogram`s so a server's per-op timers
+are Prometheus-exposable from the same store.  A bare `Timer()` gets a
+private registry (isolated, exact legacy semantics); `ServingServer`
+passes its per-server registry plus a `serving_` exposition prefix.
+
+The old implementation's `summary` bugs are fixed here by construction:
+no `import math` or per-name lambda inside a lock-held loop (percentile
+math lives in `observability.registry.nearest_rank`, computed on a
+snapshot taken outside the lock), and the key order is stable (ops
+sorted by name).
+"""
 
 from __future__ import annotations
 
-import threading
-import time
 from contextlib import contextmanager
-from typing import Dict
+from typing import Dict, Optional
+
+from analytics_zoo_tpu.observability.registry import (
+    MetricsRegistry,
+    now,
+    sanitize_metric_name,
+)
 
 
 class Timer:
-    """Thread-safe accumulators + bounded sample reservoirs per op."""
+    """Thread-safe accumulators + bounded sample reservoirs per op,
+    backed by the shared metrics registry.
 
-    def __init__(self, reservoir: int = 1024):
-        self._lock = threading.Lock()
+    registry: the `MetricsRegistry` to record into; None builds a
+        private one (drop-in legacy behavior).
+    prefix / suffix: exposition naming — op "predict" becomes registry
+        histogram `<prefix>predict<suffix>` (ServingServer uses
+        prefix="serving_", suffix="_seconds" so /metrics shows
+        `serving_predict_seconds` quantiles).  `summary()` keys remain
+        the bare op names.
+    """
+
+    def __init__(self, reservoir: int = 1024,
+                 registry: Optional[MetricsRegistry] = None,
+                 prefix: str = "", suffix: str = "_seconds"):
+        self._registry = registry or MetricsRegistry(reservoir=reservoir)
         self._reservoir = reservoir
-        self._acc: Dict[str, Dict] = {}
+        self._prefix = prefix
+        self._suffix = suffix
+        #: op name -> Histogram, for the ops THIS timer recorded (a
+        #: shared registry may hold other subsystems' metrics too)
+        self._ops: Dict[str, "object"] = {}
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._registry
+
+    def _histogram(self, name: str):
+        h = self._ops.get(name)
+        if h is None:
+            h = self._registry.histogram(
+                self._prefix + sanitize_metric_name(name) + self._suffix,
+                help=f"per-op serving timer: {name}",
+                reservoir=self._reservoir)
+            self._ops[name] = h
+        return h
 
     @contextmanager
     def timing(self, name: str, count: int = 1):
         """`with timer.timing("predict", n_records): ...` — the
         Supportive.timing analog."""
-        t0 = time.perf_counter()
+        t0 = now()
         try:
             yield
         finally:
-            self.record(name, time.perf_counter() - t0, count)
+            self.record(name, now() - t0, count)
 
     def record(self, name: str, seconds: float, count: int = 1):
-        with self._lock:
-            a = self._acc.setdefault(
-                name, {"calls": 0, "records": 0, "total_s": 0.0,
-                       "samples": []})
-            a["calls"] += 1
-            a["records"] += count
-            a["total_s"] += seconds
-            s = a["samples"]
-            s.append(seconds)
-            if len(s) > self._reservoir:
-                del s[: len(s) - self._reservoir]
+        self._histogram(name).record(seconds, count)
 
     def summary(self) -> Dict[str, Dict]:
         """{op: {calls, records, total_ms, avg_ms, p50_ms, p90_ms,
         p99_ms, max_ms, records_per_s}} — the Timer.print histogram as
-        data."""
-        out = {}
-        with self._lock:
-            import math
-            for name, a in self._acc.items():
-                s = sorted(a["samples"])
-                # nearest-rank percentile: ceil(p*n) - 1 (int(p*n) is
-                # one rank high — p90 of 10 samples would be the max)
-                q = (lambda p: s[min(len(s) - 1,
-                                     max(0, math.ceil(p * len(s)) - 1))]
-                     if s else 0.0)
-                total = a["total_s"]
-                out[name] = {
-                    "calls": a["calls"],
-                    "records": a["records"],
-                    "total_ms": round(total * 1e3, 3),
-                    "avg_ms": round(total / max(a["calls"], 1) * 1e3, 3),
-                    "p50_ms": round(q(0.50) * 1e3, 3),
-                    "p90_ms": round(q(0.90) * 1e3, 3),
-                    "p99_ms": round(q(0.99) * 1e3, 3),
-                    "max_ms": round((s[-1] if s else 0.0) * 1e3, 3),
-                    "records_per_s": round(a["records"] / total, 1)
-                    if total > 0 else 0.0,
-                }
-        return out
+        data, ops in stable (sorted) order."""
+        return {name: self._ops[name].summary_row()
+                for name in sorted(self._ops)}
 
     def print(self):  # reference Timer.print
         for name, row in self.summary().items():

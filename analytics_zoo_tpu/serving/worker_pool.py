@@ -25,7 +25,13 @@ import subprocess
 import sys
 import threading
 import queue as _queue
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
+
+from analytics_zoo_tpu.observability import (
+    get_registry,
+    log_event,
+    trace,
+)
 
 _FRAME = struct.Struct(">I")
 
@@ -172,45 +178,79 @@ class WorkerPool:
             self._free.put(w)
         self._served = 0
         self._served_lock = threading.Lock()
+        # checkout-wait histogram + respawn counter live in the
+        # process-global registry (a pool may outlive/predate servers);
+        # busy count drives the /stats + /metrics utilization gauge
+        self._busy = 0
+        reg = get_registry()
+        self._h_checkout = reg.histogram(
+            "serving_worker_checkout_wait_seconds",
+            help="time a batch waited to check a replica out")
+        self._c_respawns = reg.counter(
+            "serving_worker_respawns_total",
+            help="replica processes respawned after dying mid-predict")
 
     @property
     def records_served(self) -> int:
         return self._served
 
+    @property
+    def busy_workers(self) -> int:
+        """Replicas currently running a predict."""
+        with self._served_lock:
+            return self._busy
+
+    def utilization(self) -> float:
+        """busy / n_workers in [0, 1]."""
+        return self.busy_workers / max(self.n_workers, 1)
+
     def predict(self, *inputs) -> Any:
         import numpy as np
         arrays = tuple(np.asarray(a) for a in inputs)
-        w = self._free.get()
-        try:
-            outs = w.predict(arrays)
-            w.served += len(arrays[0])
-        except (EOFError, BrokenPipeError, OSError) as e:
-            # the replica process died: REPLACE it so the pool heals
-            # instead of handing the corpse to 1/N of future batches.
-            # Only a live worker goes back in the checkout queue; if the
-            # pool is shutting down (or the respawn fails) it shrinks
-            # by one instead of leaking a fresh orphan process.
-            w.stop()
-            if self._stopping:
-                raise RuntimeError(
-                    f"serving replica stopped ({e})") from e
-            try:
-                repl = _Worker(*self._spawn_args)
-                repl.wait_ready()
-                self._workers[self._workers.index(w)] = repl
-                self._free.put(repl)
-            except Exception:
-                self._workers.remove(w)
-            raise RuntimeError(
-                f"serving replica died mid-predict ({e}); replaced") \
-                from e
-        except Exception:
-            self._free.put(w)   # inference error; the replica is fine
-            raise
-        self._free.put(w)
+        with self._h_checkout.time():
+            w = self._free.get()
         with self._served_lock:
-            self._served += len(arrays[0])
-        return outs if len(outs) > 1 else outs[0]
+            self._busy += 1
+        try:
+            try:
+                with trace("serving.worker_predict",
+                           records=len(arrays[0])):
+                    outs = w.predict(arrays)
+                w.served += len(arrays[0])
+            except (EOFError, BrokenPipeError, OSError) as e:
+                # the replica process died: REPLACE it so the pool
+                # heals instead of handing the corpse to 1/N of future
+                # batches.  Only a live worker goes back in the
+                # checkout queue; if the pool is shutting down (or the
+                # respawn fails) it shrinks by one instead of leaking a
+                # fresh orphan process.
+                w.stop()
+                if self._stopping:
+                    raise RuntimeError(
+                        f"serving replica stopped ({e})") from e
+                self._c_respawns.inc()
+                log_event("worker_respawn",
+                          error=f"{type(e).__name__}: {e}")
+                try:
+                    repl = _Worker(*self._spawn_args)
+                    repl.wait_ready()
+                    self._workers[self._workers.index(w)] = repl
+                    self._free.put(repl)
+                except Exception:
+                    self._workers.remove(w)
+                raise RuntimeError(
+                    f"serving replica died mid-predict ({e}); "
+                    "replaced") from e
+            except Exception:
+                self._free.put(w)  # inference error; the replica is fine
+                raise
+            self._free.put(w)
+            with self._served_lock:
+                self._served += len(arrays[0])
+            return outs if len(outs) > 1 else outs[0]
+        finally:
+            with self._served_lock:
+                self._busy -= 1
 
     def per_worker_served(self):
         """Records served by each replica (dispatch distribution)."""

@@ -496,8 +496,24 @@ def serving_metrics(clients: int = 64, duration_s: float = 6.0,
             t.join()
         # snapshot NOW: the timer reservoir keeps the newest samples,
         # and the batched phase below would mix its near-zero queue
-        # waits into the per-record decomposition being published
-        per_record_summary = srv.timer.summary()
+        # waits into the per-record decomposition being published.
+        # Consumed via the server's own GET /metrics Prometheus
+        # exposition (the observability layer's machine-readable
+        # in-process decomposition) — the bench reads the same endpoint
+        # an operator's scraper would, with the in-process summary as
+        # fallback if the HTTP read fails
+        from urllib.request import urlopen
+
+        from analytics_zoo_tpu.observability import parse_prometheus_text
+        try:
+            prom = parse_prometheus_text(urlopen(
+                f"http://{srv.host}:{srv.port}/metrics",
+                timeout=10).read().decode())
+        except Exception:
+            prom = {
+                f"serving_{op}_seconds": {
+                    "quantiles": {0.5: row["p50_ms"] / 1e3}}
+                for op, row in srv.timer.summary().items()}
 
         # pre-batched mode: 4 concurrent clients x 512 records per
         # request (matches supported_concurrent_num, so dispatches
@@ -543,10 +559,13 @@ def serving_metrics(clients: int = 64, duration_s: float = 6.0,
     # it would be device time) — see docs/serving-guide.md.  Taken from
     # the snapshot made before the batched phase, so it describes the
     # per-record mode it sits next to.
-    for op, key in (("queue_wait", "serving_queue_wait_p50_ms"),
-                    ("predict", "serving_predict_p50_ms")):
-        if op in per_record_summary and "p50_ms" in per_record_summary[op]:
-            out[key] = per_record_summary[op]["p50_ms"]
+    for op, key in (("serving_queue_wait_seconds",
+                     "serving_queue_wait_p50_ms"),
+                    ("serving_predict_seconds",
+                     "serving_predict_p50_ms")):
+        q50 = prom.get(op, {}).get("quantiles", {}).get(0.5)
+        if q50 is not None:
+            out[key] = round(q50 * 1e3, 3)
     if errors[0]:
         out["serving_client_errors"] = errors[0]
     return out

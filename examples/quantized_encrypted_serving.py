@@ -61,8 +61,8 @@ def main():
             u[:64], i[:64], batched=True)
         print("served predictions:", np.asarray(preds).shape)
         stats = json.loads(urlopen(
-            f"http://{srv.host}:{srv.port}/metrics").read())
-        print("predict p50 (ms):", stats["predict"]["p50_ms"])
+            f"http://{srv.host}:{srv.port}/stats").read())
+        print("predict p50 (ms):", stats["timers"]["predict"]["p50_ms"])
     finally:
         stop_serving(servers)
         stop_orca_context()

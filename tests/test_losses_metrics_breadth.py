@@ -206,11 +206,16 @@ def test_serving_timer_metrics_endpoint():
     srv = ServingServer(im, port=0).start()
     try:
         InputQueue(srv.host, srv.port).predict(x, batched=True)
+        # /stats carries the per-op timer summaries as JSON; /metrics
+        # is Prometheus text now (tests/test_observability.py)
         stats = json.loads(urlopen(
-            f"http://{srv.host}:{srv.port}/metrics").read())
+            f"http://{srv.host}:{srv.port}/stats").read())["timers"]
         assert stats["predict"]["calls"] >= 1
         assert stats["predict"]["records"] >= 4
         assert stats["predict"]["p50_ms"] >= 0
+        text = urlopen(
+            f"http://{srv.host}:{srv.port}/metrics").read().decode()
+        assert 'serving_predict_seconds{quantile="0.5"}' in text
     finally:
         srv.stop()
 
