@@ -18,15 +18,51 @@ import jax.numpy as jnp
 
 def dot_product_attention(q, k, v, mask=None, causal: bool = False,
                           dropout_rate: float = 0.0, dropout_rng=None,
-                          compute_dtype=jnp.bfloat16):
+                          compute_dtype=jnp.bfloat16,
+                          ctx_k=None, ctx_v=None, ctx_len=None):
     """q, k, v: [batch, time, heads, head_dim] (BTHD).  `mask` is an
     additive float mask broadcastable to [batch, heads, q_time, k_time].
-    Returns [batch, time, heads, head_dim]."""
+    Returns [batch, time, heads, head_dim].
+
+    KV-cache read path (autoregressive decoding): `ctx_k`/`ctx_v`
+    [batch, ctx, heads, head_dim] hold the cached keys/values of the
+    tokens PRECEDING q — gathered from a paged pool and padded with
+    garbage beyond `ctx_len` [batch] (int32 valid lengths; cached
+    position j lives at column j).  q/k/v then carry only the NEW
+    tokens, whose absolute positions are ctx_len..ctx_len+time-1, and
+    attention runs causally over [ctx ; new] with the padding columns
+    masked out: decoding with time=1 is O(ctx) instead of the O(ctx^2)
+    full recompute.  `mask`/`causal` are ignored on this path (causal
+    semantics are implied); dropout is unsupported (decode is
+    inference-only)."""
     b, t, h, d = q.shape
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     q = q.astype(compute_dtype)
     k = k.astype(compute_dtype)
     v = v.astype(compute_dtype)
+
+    if ctx_k is not None:
+        if dropout_rate > 0.0:
+            raise ValueError("dropout is not supported on the KV-cache "
+                             "read path (decode is inference-only)")
+        c = ctx_k.shape[1]
+        ctx_len = jnp.asarray(ctx_len, jnp.int32)
+        keys = jnp.concatenate([ctx_k.astype(compute_dtype), k], axis=1)
+        vals = jnp.concatenate([ctx_v.astype(compute_dtype), v], axis=1)
+        scores = (jnp.einsum("bqhd,bkhd->bhqk", q, keys)
+                  .astype(jnp.float32) * scale)          # [b, h, t, c+t]
+        col = jnp.arange(c + t)[None, :]                 # [1, c+t]
+        # absolute key positions: cached col j sits at position j; new
+        # col c+j2 is the token at ctx_len+j2
+        k_pos = jnp.where(col < c, col, ctx_len[:, None] + (col - c))
+        q_pos = ctx_len[:, None] + jnp.arange(t)[None]   # [b, t]
+        valid = ((k_pos[:, None, :] <= q_pos[:, :, None])
+                 & ((col >= c) | (col < ctx_len[:, None]))[:, None, :])
+        scores = jnp.where(valid[:, None], scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd",
+                         probs.astype(compute_dtype), vals)
+        return out.astype(jnp.float32)
 
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:

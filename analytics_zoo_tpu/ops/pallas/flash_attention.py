@@ -767,6 +767,15 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
     keras.layers.self_attention.RelativePositionBias) train through the
     kernel; broadcast replicas accumulate in-kernel so the gradient has
     the primal bias's own shape.
+    MEMORY (differentiated bias only): the backward pass materializes
+    the bias gradient as a FLOAT32 [lead, t, t] HBM buffer (`lead` =
+    the primal bias's leading dims after broadcast reduction, e.g. `h`
+    for a [1, h, t, t] T5 bias) — at t=16k, h=12 that is ~12 GB, which
+    can OOM even when the bf16 primal bias itself fits.  The buffer
+    exists only when something actually differentiates the bias (a
+    constant additive mask's dbias pass is dead code XLA eliminates);
+    budget for it — or shorten t / shard heads — before training
+    learnable biases at long context.
     dropout_rate / dropout_rng: attention-probability dropout; the rng
     key is folded into an int32 seed for the positional hash RNG, so the
     forward and backward kernels agree on the keep mask without a [T, T]

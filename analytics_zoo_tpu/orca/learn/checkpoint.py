@@ -28,6 +28,7 @@ training pause on every checkpoint trigger.  So:
 from __future__ import annotations
 
 import atexit
+import logging
 import os
 import re
 from typing import Optional
@@ -92,7 +93,15 @@ def save_checkpoint(path: str, state, block: Optional[bool] = None) -> str:
     """Write `state` to `path`.  `block=None` -> platform gate
     (async on TPU, sync on CPU); the async path returns once the
     device->host copy is done and the directory write continues in
-    orbax's background thread."""
+    orbax's background thread.
+
+    DURABILITY: on the async path the returned path is NOT yet durable
+    — the directory may still be mid-write (or torn, on stores without
+    atomic rename) when this returns.  In-process readers are covered
+    (`load_checkpoint`/`find_latest_checkpoint` drain via
+    `wait_for_checkpoints` first), but before handing the path to
+    ANOTHER process, or gating external work on its existence, call
+    `wait_for_checkpoints()` yourself."""
     path = os.path.abspath(path)
     if block is None:
         block = not async_save_enabled()
@@ -207,16 +216,23 @@ def _is_committed(path: str) -> bool:
         from orbax.checkpoint.utils import is_checkpoint_finalized
         if not is_checkpoint_finalized(path):
             return False
-    except Exception:
+    except Exception as e:
         # predicate unavailable/errored: fall through to the metadata
-        # check rather than refusing every checkpoint
-        pass
+        # check rather than refusing every checkpoint — but SAY so,
+        # because the fallback is weaker on non-atomic-rename stores
+        logging.getLogger(__name__).warning(
+            "orbax is_checkpoint_finalized unavailable (%s: %s); "
+            "falling back to the _CHECKPOINT_METADATA presence check",
+            type(e).__name__, e)
     # on local fs the predicate is name-based (atomic-rename world) and
-    # passes ANY directory; orbax writes _CHECKPOINT_METADATA during
-    # finalize, so its absence marks a torn/foreign directory there too
+    # passes ANY directory; orbax writes _CHECKPOINT_METADATA at
+    # FINALIZE, so its absence marks a torn/foreign directory there
+    # too.  _METADATA is deliberately NOT accepted: the pytree metadata
+    # file can exist before the write finalizes on non-atomic-rename
+    # destinations — exactly the torn state this predicate must reject
+    # (ADVICE r5 #2).
     try:
-        return any(n in ("_CHECKPOINT_METADATA", "_METADATA")
-                   for n in os.listdir(path))
+        return "_CHECKPOINT_METADATA" in os.listdir(path)
     except OSError:
         return False
 

@@ -201,7 +201,7 @@ def _ep_dispatch(xd, xf32, rkern, rbias, num_experts: int,
     the MoE layer.  Returns (y [n, H], aux scalar averaged over
     groups)."""
     from analytics_zoo_tpu.parallel.sharding import (
-        data_axes, data_parallelism)
+        data_axes, data_parallelism, shard_map_compat)
 
     daxes = data_axes(mesh)
     tok = daxes if daxes else None        # token dim sharding
@@ -240,7 +240,7 @@ def _ep_dispatch(xd, xf32, rkern, rbias, num_experts: int,
         return y, aux
 
     espec = P("ep")                       # expert-dim sharded operands
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local, mesh=mesh,
         in_specs=(P(tok), P(tok), P(tok), P(), P(),
                   espec, espec, espec, espec),

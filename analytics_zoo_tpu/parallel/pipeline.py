@@ -56,6 +56,22 @@ def _pp_size(mesh) -> int:
 GATE_DEAD_TICKS = True
 
 
+#: docstring-level contract for the schedules below; referenced from
+#: both public entry points
+_NO_COLLECTIVES_CONTRACT = """
+    COLLECTIVE CONTRACT: with dead-tick gating enabled (the default,
+    `gate_dead_ticks=True`/`GATE_DEAD_TICKS`), inactive schedule ticks
+    run under `lax.cond` with a predicate that DIFFERS ACROSS pp ranks.
+    A `stage_fn`/`loss_fn` containing any collective (a tp psum, MoE ep
+    dispatch, psum_scatter, ...) would then execute that collective on
+    some devices but not others — deadlocking or miscompiling the
+    program.  Keep stage/loss bodies collective-free under gating, or
+    pass `gate_dead_ticks=False` for mixed-parallelism stages: the
+    `jnp.where`-based path runs every tick on every rank (dead work is
+    computed and discarded), which is safe for in-stage collectives at
+    the cost of not recovering dead-tick compute."""
+
+
 def _maybe_cond(gate, pred, live_fn, shapes=None):
     """Run `live_fn` gated by `pred`: lax.cond against a zeros branch
     when gating, else compute live and where-select.  The dead branch
@@ -79,7 +95,8 @@ def _maybe_cond(gate, pred, live_fn, shapes=None):
 
 def pipeline_apply(stage_fn: Callable, stage_params, x,
                    microbatches: int, mesh: Optional[Mesh] = None,
-                   extras: tuple = ()):
+                   extras: tuple = (),
+                   gate_dead_ticks: Optional[bool] = None):
     """Run `x` [batch, ...] through S pipelined stages (GPipe schedule).
 
     stage_fn(params_one_stage, x_micro, *extras_micro) -> y_micro (same
@@ -95,9 +112,15 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
     Gradient accumulation over microbatches is implicit: the schedule
     is differentiable (ppermute transposes to ppermute), so jax.grad of
     a loss over this output sums each microbatch's contribution into
-    the single stacked stage-parameter gradient."""
+    the single stacked stage-parameter gradient.
+
+    `gate_dead_ticks` overrides the module-level GATE_DEAD_TICKS
+    default for this call (see the collective contract appended below).
+    """
     from analytics_zoo_tpu.common.context import OrcaContext
 
+    gate = (GATE_DEAD_TICKS if gate_dead_ticks is None
+            else gate_dead_ticks)
     mesh = mesh or OrcaContext.mesh
     leaves = jax.tree_util.tree_leaves(stage_params)
     n_stages = leaves[0].shape[0]
@@ -119,7 +142,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
             f"stage count {n_stages} must equal the pp axis size {pp} "
             "(one stage per pipeline shard)")
 
-    from analytics_zoo_tpu.parallel.sharding import data_axes
+    from analytics_zoo_tpu.parallel.sharding import (data_axes,
+                                                       shard_map_compat)
 
     mb = batch // microbatches
     xm = x.reshape(microbatches, mb, *x.shape[1:])
@@ -156,7 +180,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
                 p_local, x_in, *e_t)
             if y_shapes is None:
                 y_shapes = jax.eval_shape(live_f)
-            y = _maybe_cond(GATE_DEAD_TICKS, f_active, live_f, y_shapes)
+            y = _maybe_cond(gate, f_active, live_f, y_shapes)
             if t >= pp - 1:
                 # the LAST stage's output at tick t is microbatch
                 # t - (pp - 1); other stages contribute zeros
@@ -167,7 +191,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
         return jax.lax.psum(out, "pp")
 
     espec = tuple(P(None, tok) for _ in em)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local, mesh=mesh,
         in_specs=(P("pp"), P(None, tok)) + espec,
         out_specs=P(None, tok),
@@ -180,7 +204,8 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
                                  stage_params, x, labels,
                                  microbatches: int,
                                  mesh: Optional[Mesh] = None,
-                                 extras: tuple = ()):
+                                 extras: tuple = (),
+                                 gate_dead_ticks: Optional[bool] = None):
     """One-fwd-one-bwd (1F1B) pipelined training step.
 
     Returns (mean_loss, stage_grads, dx) where stage_grads matches
@@ -201,10 +226,16 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
     loss_fn(y_micro, labels_micro) -> per-example loss [mb]; the
     reported loss and the gradients correspond to the mean over ALL
     real examples (microbatch losses are summed then divided by batch).
+
+    `gate_dead_ticks` overrides the module-level GATE_DEAD_TICKS
+    default for this call (see the collective contract appended below).
     """
     from analytics_zoo_tpu.common.context import OrcaContext
-    from analytics_zoo_tpu.parallel.sharding import data_axes
+    from analytics_zoo_tpu.parallel.sharding import (data_axes,
+                                                      shard_map_compat)
 
+    gate = (GATE_DEAD_TICKS if gate_dead_ticks is None
+            else gate_dead_ticks)
     mesh = mesh or OrcaContext.mesh
     pp = _pp_size(mesh)
     leaves = jax.tree_util.tree_leaves(stage_params)
@@ -281,8 +312,7 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
                 p_local, x_in, *e_f)
             if fwd_shapes is None:
                 fwd_shapes = jax.eval_shape(live_f)
-            y = _maybe_cond(GATE_DEAD_TICKS, f_active, live_f,
-                            fwd_shapes)
+            y = _maybe_cond(gate, f_active, live_f, fwd_shapes)
             slot_f = jnp.mod(m_f, B)
             act_buf = jnp.where(
                 f_active,
@@ -299,8 +329,7 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
             if loss_shapes is None:
                 loss_shapes = jax.eval_shape(live_l)
             lval, g_seed = _maybe_cond(
-                GATE_DEAD_TICKS, is_last & f_active, live_l,
-                loss_shapes)
+                gate, is_last & f_active, live_l, loss_shapes)
             loss_acc = loss_acc + lval
             seed_buf = jnp.where(
                 is_last & f_active,
@@ -329,7 +358,7 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
 
             if bwd_shapes is None:
                 bwd_shapes = jax.eval_shape(run_vjp)
-            dp_m, dx_m = _maybe_cond(GATE_DEAD_TICKS, b_active, run_vjp,
+            dp_m, dx_m = _maybe_cond(gate, b_active, run_vjp,
                                      bwd_shapes)
             grads = jax.tree_util.tree_map(
                 lambda acc, g: acc + g, grads, dp_m)
@@ -358,7 +387,7 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
         return loss_total, grads, dx_total
 
     espec = tuple(P(None, tok) for _ in em)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local, mesh=mesh,
         in_specs=(P("pp"), P(None, tok), P(None, tok)) + espec,
         out_specs=(P(), P("pp"), P(None, tok)),
@@ -376,3 +405,9 @@ def stack_stage_params(per_stage_params) -> object:
                stages=len(per_stage_params)):
         return jax.tree_util.tree_map(
             lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+# the contract is part of both public entry points' rendered help, not
+# just an inline comment (ADVICE r5 #1)
+pipeline_apply.__doc__ += _NO_COLLECTIVES_CONTRACT
+pipeline_value_and_grad_1f1b.__doc__ += _NO_COLLECTIVES_CONTRACT

@@ -290,6 +290,7 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
     when the per-device shard is at least RING_FLASH_MIN_TLOCAL (long
     shards are where per-shard scores stop fitting), einsum below."""
     from analytics_zoo_tpu.common.context import OrcaContext
+    from analytics_zoo_tpu.parallel.sharding import shard_map_compat
     mesh = mesh or OrcaContext.mesh
     if impl not in ("einsum", "flash", "auto"):
         # validate HERE too: the no-'sp' fallback below never reaches
@@ -363,8 +364,8 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None,
         kw = dict(kwargs, **dict(zip(names, rest)))
         return ring_attention(q, k, v, **kw)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
-                       out_specs=spec, check_vma=False)
+    fn = shard_map_compat(body, mesh=mesh, in_specs=tuple(in_specs),
+                          out_specs=spec, check_vma=False)
     return fn(*args)
 
 

@@ -22,6 +22,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from analytics_zoo_tpu.common.context import DATA_AXES, OrcaContext
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs,
+                     check_vma: bool = False):
+    """`jax.shard_map` across jax versions: newer jax exposes it
+    top-level with `check_vma`; older releases (e.g. 0.4.x) only have
+    `jax.experimental.shard_map.shard_map`, where the same knob is
+    spelled `check_rep`.  Every shard_map consumer in the package goes
+    through this shim so the parallel runtimes run on both."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check_vma)
+
+
 def _present_axes(mesh: Mesh, axes: Sequence[str]) -> Tuple[str, ...]:
     return tuple(a for a in axes if a in mesh.axis_names)
 

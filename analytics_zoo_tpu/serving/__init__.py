@@ -21,7 +21,22 @@ from analytics_zoo_tpu.serving.quantize import (
 )
 from analytics_zoo_tpu.serving.server import ServingServer
 
+#: generation subsystem symbols resolved lazily — the continuous-
+#: batching engine pulls in jax/flax at import, which a record-batch
+#: serving deployment (client-only processes included) need not pay
+_GENERATION = ("GenerationEngine", "GenerationStream", "CausalLM",
+               "PagedKVCache", "BlockAllocator", "SlotScheduler",
+               "sample_tokens")
+
+
+def __getattr__(name):
+    if name in _GENERATION:
+        from analytics_zoo_tpu.serving import generation
+        return getattr(generation, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = ["InferenceModel", "ServingServer", "InputQueue", "OutputQueue",
            "GrpcInputQueue", "GrpcServingFrontend", "quantize_params",
            "dequantize_params", "quantized_size_bytes", "ServingConfig",
-           "start_serving", "stop_serving"]
+           "start_serving", "stop_serving", *_GENERATION]

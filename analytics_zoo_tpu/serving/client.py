@@ -114,6 +114,48 @@ class InputQueue:
         outs = [decode_ndarray(o)[0] for o in resp["outputs"]]
         return outs[0] if len(outs) == 1 else tuple(outs)
 
+    def generate(self, tokens, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None, timeout: float = 300.0):
+        """Streaming generation client for POST /generate: a generator
+        yielding token ids AS THE SERVER SAMPLES THEM (chunked ndjson
+        lines decoded incrementally — first token arrives at decode
+        latency, not request latency).  After exhaustion
+        `self.last_generate` holds the final {"done", "n_tokens",
+        "finish_reason"} line.  Raises RuntimeError on a server-side
+        error, including mid-stream ones."""
+        payload = {"tokens": [int(t) for t in tokens],
+                   "max_new_tokens": max_new_tokens,
+                   "temperature": temperature, "top_k": top_k,
+                   "eos_id": eos_id}
+        req = urllib.request.Request(
+            f"{self.base}/generate", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            resp = urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            try:
+                err = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                err = str(e)
+            raise RuntimeError(f"serving error: {err}") from None
+        with resp:
+            for raw in resp:           # http.client de-chunks for us
+                msg = json.loads(raw)
+                if "error" in msg:
+                    raise RuntimeError(
+                        f"serving error: {msg['error']}")
+                if msg.get("done"):
+                    self.last_generate = msg
+                    return
+                yield msg["token"]
+        raise RuntimeError("generation stream ended without a "
+                           "done marker")
+
+    def generate_tokens(self, tokens, **kw):
+        """Blocking convenience: drain `generate` into a list."""
+        return list(self.generate(tokens, **kw))
+
     def enqueue(self, uri: str, **inputs) -> str:
         """Async enqueue of one record (reference InputQueue.enqueue);
         fetch via OutputQueue.dequeue(uri)."""

@@ -1,0 +1,48 @@
+"""Continuous-batching generation engine (L7, autoregressive serving).
+
+The reference's Cluster Serving layer streams fixed-shape record
+batches; generative workloads need the opposite shape of pipeline —
+iteration-level scheduling over a paged KV cache (vLLM-style
+PagedAttention block tables; Orca-style join/leave between decode
+steps).  Four pieces, one subsystem:
+
+* `PagedKVCache` / `BlockAllocator` — fixed-size KV blocks in one
+  preallocated device buffer, host-side free-list allocation,
+  per-sequence block tables, release-on-finish, cache-pressure
+  preemption (kv_cache.py).
+* `SlotScheduler` — fixed slot count + prefill token budget, FCFS
+  admission, sequences join/leave between steps via the active-slot
+  mask so steady-state serving never changes a compiled shape
+  (scheduler.py).
+* `CausalLM` — a GPT-style decoder on
+  `ops.attention.dot_product_attention`'s KV-cache read path
+  (model.py), with greedy/temperature/top-k sampling (sampling.py).
+* `GenerationEngine` — the decode loop tying them together: bucketed
+  prefill + ONE static-shape decode step (zero recompiles after
+  warmup), token streaming, tokens/sec + cache-occupancy metrics
+  (engine.py).  `ServingServer` exposes it as POST /generate with
+  chunked streaming responses.
+"""
+
+from analytics_zoo_tpu.serving.generation.engine import (  # noqa: F401
+    GenerationEngine,
+    GenerationStream,
+)
+from analytics_zoo_tpu.serving.generation.kv_cache import (  # noqa: F401
+    BlockAllocator,
+    PagedKVCache,
+)
+from analytics_zoo_tpu.serving.generation.model import (  # noqa: F401
+    CausalLM,
+)
+from analytics_zoo_tpu.serving.generation.sampling import (  # noqa: F401
+    sample_tokens,
+)
+from analytics_zoo_tpu.serving.generation.scheduler import (  # noqa: F401
+    Sequence,
+    SlotScheduler,
+)
+
+__all__ = ["BlockAllocator", "CausalLM", "GenerationEngine",
+           "GenerationStream", "PagedKVCache", "Sequence",
+           "SlotScheduler", "sample_tokens"]

@@ -1,0 +1,422 @@
+"""Continuous-batching decode engine.
+
+The hot loop is ONE jitted decode step over a fixed `max_slots`-lane
+grid — tokens [S], block tables [S, max_blocks], context lengths [S],
+an active-lane mask [S] and per-lane sampling params.  Sequences join
+and leave between steps by mutating those host arrays, never the
+compiled program: steady-state serving triggers ZERO recompiles after
+`warmup()` (asserted in tests via the jit cache size).  Prefill runs
+per-sequence over power-of-two length buckets, so any prompt length
+hits one of O(log max_context) compiled programs.
+
+Paging: the engine gathers each lane's cached K/V from the pool by its
+block table (`pool[:, 0][:, token_idx]` — a plain XLA gather), hands the
+contiguous view to the model's KV-cache read path, and scatters the new
+tokens' K/V back into block slots.  Inactive lanes carry the null block
+table and scribble into block 0 (kv_cache.py).
+
+Streaming: `submit()` returns a `GenerationStream`; the engine loop
+pushes each sampled token as it exists, so a consumer (the HTTP
+/generate chunked response) emits tokens with per-token latency, not
+per-request.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from functools import partial
+from typing import List, Optional, Sequence as Seq
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.observability import get_registry, log_event, now
+from analytics_zoo_tpu.serving.generation.kv_cache import PagedKVCache
+from analytics_zoo_tpu.serving.generation.sampling import sample_tokens
+from analytics_zoo_tpu.serving.generation.scheduler import (
+    Sequence,
+    SlotScheduler,
+)
+
+_STREAM_END = object()
+
+
+class GenerationStream:
+    """Consumer half of one request: iterate to receive token ids as
+    they are sampled; `tokens()` drains to completion.  After the
+    iterator is exhausted `finish_reason` is set ("length" | "eos" |
+    "error: ...")."""
+
+    def __init__(self, seq: Sequence, timeout: float = 120.0):
+        self.seq = seq
+        self.timeout = timeout
+        self._q: "queue.Queue" = queue.Queue()
+
+    def _put(self, token: int) -> None:
+        self._q.put(int(token))
+
+    def _close(self) -> None:
+        self._q.put(_STREAM_END)
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return self.seq.finish_reason
+
+    def __iter__(self):
+        while True:
+            item = self._q.get(timeout=self.timeout)
+            if item is _STREAM_END:
+                return
+            yield item
+
+    def tokens(self) -> List[int]:
+        return list(self)
+
+
+class GenerationEngine:
+    """Continuous-batching generation over a `CausalLM`.
+
+    `submit()` from any thread; drive the loop either explicitly
+    (`run_until_idle()`, tests/bench) or as a background thread
+    (`start()`/`stop()`, serving).  `warmup()` compiles the decode step
+    and every prefill bucket up front so live traffic never waits on
+    XLA."""
+
+    def __init__(self, model, params, *, max_slots: int = 8,
+                 block_size: int = 16, max_context: int = 512,
+                 num_blocks: Optional[int] = None,
+                 prefill_buckets: Optional[Seq[int]] = None,
+                 prefill_token_budget: int = 2048,
+                 cache_dtype=jnp.float32, registry=None, seed: int = 0):
+        if model.max_position_len < max_context:
+            raise ValueError(
+                f"model.max_position_len {model.max_position_len} < "
+                f"max_context {max_context}")
+        self.model = model
+        self.params = jax.device_put(params)
+        self.max_slots = max_slots
+        self.max_context = max_context
+        if num_blocks is None:
+            # comfortable default: every lane can hold a full context
+            num_blocks = max_slots * (-(-max_context // block_size)) + 1
+        self.cache = PagedKVCache(
+            model.n_block, num_blocks, block_size, model.n_head,
+            model.hidden_size // model.n_head, dtype=cache_dtype)
+        if prefill_buckets is None:
+            prefill_buckets = []
+            b = min(16, max_context)
+            while b < max_context:
+                prefill_buckets.append(b)
+                b *= 2
+            prefill_buckets.append(max_context)
+        elif max(prefill_buckets) < max_context:
+            # a preempted sequence re-prefills at up to max_context
+            # tokens; the top bucket must cover it
+            raise ValueError(
+                f"largest prefill bucket {max(prefill_buckets)} < "
+                f"max_context {max_context}")
+        self.scheduler = SlotScheduler(
+            self.cache, max_slots, max_context, prefill_buckets,
+            prefill_token_budget)
+        self._rng = jax.random.PRNGKey(seed)
+        self._lock = threading.RLock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        self._c_tokens = reg.counter(
+            "generation_tokens_total",
+            help="tokens sampled (prefill first-tokens + decode)")
+        self._c_prefill_tokens = reg.counter(
+            "generation_prefill_tokens_total",
+            help="prompt tokens prefilled (bucket-padded tokens excluded)")
+        self._c_requests = reg.counter(
+            "generation_requests_total", help="generation requests")
+        self._h_prefill = reg.histogram(
+            "generation_prefill_seconds",
+            help="per-sequence prefill latency (records = real tokens)")
+        self._h_decode = reg.histogram(
+            "generation_decode_seconds",
+            help="per-step decode latency (records = active lanes)")
+        reg.gauge("generation_cache_occupancy",
+                  fn=self.cache.allocator.occupancy,
+                  help="fraction of KV blocks held by live sequences")
+        reg.gauge("generation_active_slots",
+                  fn=lambda: len(self.scheduler.running()),
+                  help="decode lanes occupied")
+        reg.gauge("generation_queue_depth",
+                  fn=lambda: len(self.scheduler.waiting),
+                  help="requests waiting for a lane")
+        reg.gauge("generation_preemptions",
+                  fn=lambda: self.scheduler.n_preemptions,
+                  help="sequences preempted under cache pressure")
+
+        self._build_steps()
+
+    # ------------------------------------------------------------------
+    # compiled steps
+    # ------------------------------------------------------------------
+
+    def _build_steps(self) -> None:
+        model = self.model
+        bs = self.cache.block_size
+        max_pos = model.max_position_len
+        # buffer donation lets XLA update the KV pool in place; the CPU
+        # backend ignores donation and warns, so only donate off-CPU
+        donate = ((1,) if jax.devices()[0].platform != "cpu" else ())
+
+        def prefill(params, kv, tokens, length, block_table,
+                    temperature, top_k, rng):
+            # tokens [1, B] (bucket-padded), length scalar, block_table
+            # [max_blocks]; writes KV for the `length` real tokens and
+            # samples the first new token from the last real position
+            B = tokens.shape[1]
+            pos = jnp.minimum(jnp.arange(B), max_pos - 1)
+            token_mask = (jnp.arange(B) < length)[None]
+            logits, new_k, new_v = model.apply(
+                {"params": params}, tokens, pos[None],
+                token_mask=token_mask)
+            dest = block_table[jnp.arange(B) // bs] * bs \
+                + jnp.arange(B) % bs
+            dest = jnp.where(jnp.arange(B) < length, dest, 0)
+            kv = kv.at[:, 0, dest].set(new_k[:, 0])
+            kv = kv.at[:, 1, dest].set(new_v[:, 0])
+            last = logits[0, length - 1]
+            nxt = sample_tokens(last[None], rng, temperature, top_k)[0]
+            return kv, nxt, last
+
+        def decode(params, kv, tokens, block_tables, ctx_len, active,
+                   temperature, top_k, rng):
+            # ONE static-shape step for all lanes: tokens [S] (each
+            # lane's pending token), ctx_len [S] (= its position),
+            # block_tables [S, max_blocks], active [S] lane mask
+            S, MB = block_tables.shape
+            tok_idx = (block_tables[:, :, None] * bs
+                       + jnp.arange(bs)[None, None, :]).reshape(S, -1)
+            ctx_k = kv[:, 0][:, tok_idx]        # [L, S, C, h, d]
+            ctx_v = kv[:, 1][:, tok_idx]
+            pos = jnp.minimum(ctx_len, max_pos - 1)
+            logits, new_k, new_v = model.apply(
+                {"params": params}, tokens[:, None], pos[:, None],
+                ctx_k=ctx_k, ctx_v=ctx_v, ctx_len=ctx_len)
+            dest = block_tables[jnp.arange(S), ctx_len // bs] * bs \
+                + ctx_len % bs
+            dest = jnp.where(active, dest, 0)   # dead lanes → null block
+            kv = kv.at[:, 0, dest].set(new_k[:, :, 0])
+            kv = kv.at[:, 1, dest].set(new_v[:, :, 0])
+            last = jnp.where(active[:, None], logits[:, 0], 0.0)
+            nxt = sample_tokens(last, rng, temperature, top_k)
+            return kv, nxt, last
+
+        self._prefill_jit = jax.jit(prefill, donate_argnums=donate)
+        self._decode_jit = jax.jit(decode, donate_argnums=donate)
+
+    @property
+    def decode_compile_count(self) -> int:
+        """Compiled variants of the decode step (1 after warmup and
+        forever after — the zero-recompile guarantee; -1 when the jit
+        cache API is unavailable)."""
+        size = getattr(self._decode_jit, "_cache_size", None)
+        return size() if size is not None else -1
+
+    def warmup(self) -> None:
+        """Compile the decode step and every prefill bucket on dummy
+        inputs (all writes land in the null block)."""
+        with self._lock:
+            MB = self.scheduler.max_blocks_per_seq
+            one = jnp.zeros(1, jnp.float32)
+            onek = jnp.zeros(1, jnp.int32)
+            for b in self.scheduler.prefill_buckets:
+                self.cache.kv, _, _ = self._prefill_jit(
+                    self.params, self.cache.kv,
+                    jnp.zeros((1, b), jnp.int32), jnp.int32(1),
+                    jnp.zeros(MB, jnp.int32), one, onek, self._rng)
+            S = self.max_slots
+            self.cache.kv, _, _ = self._decode_jit(
+                self.params, self.cache.kv, jnp.zeros(S, jnp.int32),
+                jnp.zeros((S, MB), jnp.int32), jnp.zeros(S, jnp.int32),
+                jnp.zeros(S, bool), jnp.zeros(S, jnp.float32),
+                jnp.zeros(S, jnp.int32), self._rng)
+
+    # ------------------------------------------------------------------
+    # request intake
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_id: Optional[int] = None,
+               stream_timeout: float = 120.0) -> GenerationStream:
+        """Queue one request; returns its token stream.  Raises
+        ValueError up front for prompts that can never fit (longer than
+        the largest prefill bucket, or prompt + max_new_tokens beyond
+        max_context / the whole block pool)."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if any(not 0 <= t < self.model.vocab for t in prompt):
+            raise ValueError("prompt token out of vocab range")
+        seq = Sequence(prompt, max_new_tokens=max_new_tokens,
+                       temperature=temperature, top_k=top_k,
+                       eos_id=eos_id)
+        total = seq.context_len + seq.max_new_tokens
+        if self.cache.blocks_for(total) > self.cache.allocator.capacity:
+            raise ValueError(
+                f"request needs {self.cache.blocks_for(total)} KV "
+                f"blocks, pool holds {self.cache.allocator.capacity}")
+        seq.stream = GenerationStream(seq, timeout=stream_timeout)
+        with self._lock:
+            self.scheduler.submit(seq)
+            self._c_requests.inc()
+        self._wake.set()
+        return seq.stream
+
+    def generate(self, prompt, **kw) -> List[int]:
+        """Blocking one-shot convenience: submit and drain.  Drives the
+        loop inline when no background thread is running."""
+        stream = self.submit(prompt, **kw)
+        if self._thread is None:
+            self.run_until_idle()
+        return stream.tokens()
+
+    # ------------------------------------------------------------------
+    # the loop
+    # ------------------------------------------------------------------
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _finish(self, seq: Sequence, reason: str) -> None:
+        self.scheduler.release(seq, reason)
+        if seq.stream is not None:
+            seq.stream._close()
+
+    def _emit(self, seq: Sequence, token: int) -> None:
+        seq.generated.append(int(token))
+        self._c_tokens.inc()
+        if seq.stream is not None:
+            seq.stream._put(token)
+        reason = seq.should_finish()
+        if reason:
+            self._finish(seq, reason)
+
+    def _prefill_seq(self, seq: Sequence) -> None:
+        ctx = seq.prompt + seq.generated
+        L = len(ctx)
+        bucket = self.scheduler.bucket_for(L)
+        MB = self.scheduler.max_blocks_per_seq
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :L] = ctx
+        table = np.zeros(MB, np.int32)
+        table[:len(seq.block_table)] = seq.block_table
+        t0 = now()
+        self.cache.kv, nxt, _ = self._prefill_jit(
+            self.params, self.cache.kv, jnp.asarray(tokens),
+            jnp.int32(L), jnp.asarray(table),
+            jnp.full(1, seq.temperature, jnp.float32),
+            jnp.full(1, seq.top_k, jnp.int32), self._next_rng())
+        nxt = int(nxt)
+        self._h_prefill.record(now() - t0, L)
+        self._c_prefill_tokens.inc(L)
+        self._emit(seq, nxt)
+
+    def _decode_all(self) -> None:
+        S = self.max_slots
+        MB = self.scheduler.max_blocks_per_seq
+        tokens = np.zeros(S, np.int32)
+        tables = np.zeros((S, MB), np.int32)
+        ctx_len = np.zeros(S, np.int32)
+        active = np.zeros(S, bool)
+        temp = np.zeros(S, np.float32)
+        top_k = np.zeros(S, np.int32)
+        lanes = {}
+        for seq in self.scheduler.running():
+            i = seq.slot
+            lanes[i] = seq
+            tokens[i] = seq.generated[-1] if seq.generated \
+                else seq.prompt[-1]
+            tables[i, :len(seq.block_table)] = seq.block_table
+            ctx_len[i] = seq.context_len - 1    # the pending position
+            active[i] = True
+            temp[i] = seq.temperature
+            top_k[i] = seq.top_k
+        t0 = now()
+        self.cache.kv, nxt, _ = self._decode_jit(
+            self.params, self.cache.kv, jnp.asarray(tokens),
+            jnp.asarray(tables), jnp.asarray(ctx_len),
+            jnp.asarray(active), jnp.asarray(temp),
+            jnp.asarray(top_k), self._next_rng())
+        nxt = np.asarray(nxt)
+        self._h_decode.record(now() - t0, len(lanes))
+        for i, seq in lanes.items():
+            self._emit(seq, nxt[i])
+
+    def step(self) -> bool:
+        """One scheduling round: admit (prefill) → grow/preempt for
+        decode capacity → one decode step.  Returns whether any device
+        work ran."""
+        with self._lock:
+            did = False
+            for seq in self.scheduler.admit():
+                self._prefill_seq(seq)
+                did = True
+            self.scheduler.ensure_decode_capacity()
+            if self.scheduler.running():
+                self._decode_all()
+                did = True
+            return did
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        for _ in range(max_steps):
+            if not self.scheduler.has_work():
+                return
+            if not self.step():
+                raise RuntimeError(
+                    "generation engine stuck: waiting requests but no "
+                    "schedulable work (block pool too small?)")
+        raise RuntimeError(f"still busy after {max_steps} steps")
+
+    # ------------------------------------------------------------------
+    # background serving
+    # ------------------------------------------------------------------
+
+    def ensure_started(self) -> "GenerationEngine":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.scheduler.has_work():
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            try:
+                self.step()
+            except Exception as e:   # fail loudly but keep serving
+                log_event("generation_step_error",
+                          error=f"{type(e).__name__}: {e}")
+                with self._lock:
+                    for seq in list(self.scheduler.running()):
+                        self._finish(seq, f"error: {e}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # unblock consumers of requests that will never run
+        with self._lock:
+            for seq in list(self.scheduler.running()):
+                self._finish(seq, "error: engine stopped")
+            while self.scheduler.waiting:
+                self._finish(self.scheduler.waiting.popleft(),
+                             "error: engine stopped")

@@ -1,0 +1,108 @@
+"""Paged KV cache: fixed-size blocks in one preallocated device buffer.
+
+vLLM's PagedAttention insight, TPU-native: instead of reserving a
+max-context-length KV strip per sequence (most of it empty), the cache
+is a pool of `num_blocks` fixed-size blocks and each sequence holds a
+BLOCK TABLE — the list of block ids its tokens occupy, in order.
+Fragmentation drops from per-sequence worst-case to one partial block
+per sequence, so many more sequences fit in the same HBM.
+
+The device side is ONE jax array per cache,
+[n_layers, 2, num_blocks * block_size, heads, head_dim] (k=0/v=1 on
+axis 1), flat in the token dimension so reads/writes are plain
+gathers/scatters on `block_id * block_size + offset` — no kernel
+needed, XLA lowers them to dynamic-(gather|scatter) and the decode
+step stays a single compiled program.  Block 0 is reserved as the NULL
+block: inactive slots' table entries (and padding writes) all point at
+it, so dead lanes scribble harmlessly instead of branching — that is
+what keeps the decode step's shapes static.
+
+Allocation is host-side (the free list is python state; the device
+never sees it) — the allocator hands block ids to the scheduler, which
+bakes them into the block-table arrays fed to the jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+#: block id 0 is never allocated; see module docstring
+NULL_BLOCK = 0
+
+
+class BlockAllocator:
+    """Free-list allocator over `num_blocks` KV blocks (block 0
+    reserved as the null block).  LIFO reuse keeps recently-freed
+    blocks hot.  Not thread-safe — the engine loop is the only
+    caller."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is the "
+                             "reserved null block)")
+        self.num_blocks = num_blocks
+        # pop() takes from the tail: ascending init → low ids first
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._held = 0
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (excludes the null block)."""
+        return self.num_blocks - 1
+
+    def available(self) -> int:
+        return len(self._free)
+
+    def occupancy(self) -> float:
+        """Fraction of allocatable blocks currently held — the
+        cache-pressure gauge."""
+        return self._held / self.capacity
+
+    def alloc(self, n: int = 1) -> Optional[List[int]]:
+        """n blocks, or None when the pool can't cover the request
+        (the caller preempts or defers admission; partial allocations
+        are never handed out)."""
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._held += n
+        return blocks
+
+    def free(self, blocks: List[int]) -> None:
+        for blk in blocks:
+            if blk == NULL_BLOCK:
+                raise ValueError("cannot free the null block")
+            if not 0 < blk < self.num_blocks:
+                raise ValueError(f"block id {blk} out of range")
+            if blk in self._free:
+                raise ValueError(f"double free of block {blk}")
+        self._free.extend(blocks)
+        self._held -= len(blocks)
+
+
+class PagedKVCache:
+    """The device pool + its allocator.  `kv` is functional state: the
+    jitted prefill/decode steps take it as a donated argument and
+    return the updated array; the engine swaps its reference."""
+
+    def __init__(self, n_layers: int, num_blocks: int, block_size: int,
+                 n_head: int, head_dim: int, dtype=jnp.float32):
+        self.n_layers = n_layers
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.n_head = n_head
+        self.head_dim = head_dim
+        self.kv = jnp.zeros(
+            (n_layers, 2, num_blocks * block_size, n_head, head_dim),
+            dtype)
+        self.allocator = BlockAllocator(num_blocks)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold n_tokens."""
+        return -(-n_tokens // self.block_size)
+
+    @property
+    def nbytes(self) -> int:
+        return self.kv.size * self.kv.dtype.itemsize

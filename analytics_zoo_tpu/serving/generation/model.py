@@ -1,0 +1,94 @@
+"""Causal decoder LM for the generation engine.
+
+A small GPT-style stack (token+position embeds, post-LN blocks like
+`keras.layers.self_attention.TransformerBlock`, tied-free Dense head)
+whose attention is `ops.attention.dot_product_attention` in BOTH modes:
+full causal self-attention for prefill, and the KV-cache read path
+(`ctx_k/ctx_v/ctx_len`) for decode.  Every call also RETURNS the new
+tokens' per-layer keys/values — the model never touches the paged pool;
+the engine scatters them into block slots outside (model.py stays pure,
+paging stays in engine.py).
+
+compute_dtype defaults to float32 so KV-cached decode matches the
+full-sequence recompute to tight fp tolerance (tested); serve bf16 on a
+real TPU by passing compute_dtype=jnp.bfloat16.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.attention import dot_product_attention
+
+
+class CausalLM(nn.Module):
+    """input_ids/positions [batch, t] -> (logits [batch, t, vocab],
+    new_k, new_v [n_block, batch, t, heads, head_dim]).
+
+    Prefill: pass `token_mask` [batch, t] (1 = real token) and no ctx —
+    full causal attention over the (bucket-padded) prompt.
+    Decode: pass `ctx_k`/`ctx_v` [n_block, batch, ctx, heads, head_dim]
+    (gathered from the paged pool) and `ctx_len` [batch] — the new
+    tokens attend over [cache ; themselves]."""
+
+    vocab: int
+    hidden_size: int = 64
+    n_head: int = 4
+    n_block: int = 2
+    intermediate_size: int = 256
+    max_position_len: int = 2048
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, positions, token_mask=None,
+                 ctx_k=None, ctx_v=None, ctx_len=None):
+        b, t = input_ids.shape
+        h = self.n_head
+        hd = self.hidden_size // h
+        x = nn.Embed(self.vocab, self.hidden_size,
+                     name="token_embed")(input_ids.astype(jnp.int32))
+        x = x + nn.Embed(self.max_position_len, self.hidden_size,
+                         name="position_embed"
+                         )(positions.astype(jnp.int32))
+        x = nn.LayerNorm(name="embed_ln")(x)
+
+        additive_mask = None
+        if token_mask is not None:
+            additive_mask = (1.0 - token_mask[:, None, None, :]
+                             .astype(jnp.float32)) * -1e9
+
+        new_k, new_v = [], []
+        for i in range(self.n_block):
+            blk = f"block_{i}"
+            qkv = nn.Dense(3 * self.hidden_size, dtype=self.compute_dtype,
+                           name=f"{blk}_qkv")(x)
+            q, k, v = (a.reshape(b, t, h, hd)
+                       for a in jnp.split(qkv, 3, axis=-1))
+            # the pool holds f32 (or the cache dtype): hand back the
+            # raw per-token keys/values before attention consumes them
+            new_k.append(k.astype(jnp.float32))
+            new_v.append(v.astype(jnp.float32))
+            if ctx_k is not None:
+                a = dot_product_attention(
+                    q, k, v, compute_dtype=self.compute_dtype,
+                    ctx_k=ctx_k[i], ctx_v=ctx_v[i], ctx_len=ctx_len)
+            else:
+                a = dot_product_attention(
+                    q, k, v, mask=additive_mask, causal=True,
+                    compute_dtype=self.compute_dtype)
+            a = nn.Dense(self.hidden_size, dtype=self.compute_dtype,
+                         name=f"{blk}_proj")(
+                             a.reshape(b, t, self.hidden_size))
+            x = nn.LayerNorm(name=f"{blk}_ln1")(x + a.astype(x.dtype))
+            f = nn.Dense(self.intermediate_size,
+                         dtype=self.compute_dtype,
+                         name=f"{blk}_fc1")(x)
+            f = nn.gelu(f)
+            f = nn.Dense(self.hidden_size, dtype=self.compute_dtype,
+                         name=f"{blk}_fc2")(f)
+            x = nn.LayerNorm(name=f"{blk}_ln2")(x + f.astype(x.dtype))
+
+        logits = nn.Dense(self.vocab, name="lm_head")(x)
+        return (logits.astype(jnp.float32),
+                jnp.stack(new_k), jnp.stack(new_v))

@@ -1,0 +1,32 @@
+"""Token sampling for the decode step.
+
+Everything is per-SLOT arrays, not python scalars: sampling params ride
+through the one compiled decode step as data, so a slot switching from
+greedy to temperature-0.8 top-k-40 mid-stream (a new request joining)
+never changes a compiled shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(logits, rng, temperature, top_k):
+    """Next-token ids [slots] from `logits` [slots, vocab].
+
+    temperature [slots] float32 — <= 0 selects greedy (argmax) for that
+    slot; top_k [slots] int32 — > 0 restricts sampling to the k highest
+    logits for that slot, 0 disables the filter.  One categorical draw
+    per slot from `rng`; greedy slots ignore it."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    # per-slot top-k threshold: the k-th largest logit (k=0 → the
+    # smallest, i.e. no filtering)
+    desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    kk = jnp.clip(jnp.where(top_k > 0, top_k, vocab), 1, vocab) - 1
+    thresh = jnp.take_along_axis(desc, kk[:, None], axis=-1)
+    filtered = jnp.where(logits >= thresh, logits, -jnp.inf)
+    scaled = filtered / jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temperature > 0.0, sampled, greedy).astype(jnp.int32)

@@ -18,6 +18,15 @@ Endpoints:
                    or a pre-batched array.
   POST /enqueue  — async: {"uri": id, "inputs": [...]}; result fetched via
   GET  /result/<uri> — {"status": "pending"|"ok", "outputs": [...]}
+  POST /generate — autoregressive generation with STREAMED tokens
+                   (needs a `generation_engine`): {"tokens": [ids...],
+                   "max_new_tokens", "temperature", "top_k", "eos_id"}
+                   -> chunked application/x-ndjson, one {"token": id}
+                   line per sampled token as it exists, terminated by
+                   {"done": true, "n_tokens": n, "finish_reason": ...}.
+                   The engine continuously batches concurrent /generate
+                   requests into its fixed-slot decode step
+                   (serving/generation/).
   GET  /healthz  — liveness + records served
   GET  /metrics  — Prometheus text exposition: this server's per-op
                    latency summaries (serving_queue_wait_seconds,
@@ -87,16 +96,23 @@ class ServingServer:
                  port: int = 0, max_batch_size: int = 32,
                  batch_timeout_ms: float = 5.0,
                  result_ttl_s: float = 600.0, max_results: int = 10_000,
-                 worker_pool=None):
-        if model is None and worker_pool is None:
-            raise ValueError("need a model or a worker_pool")
+                 worker_pool=None, generation_engine=None):
+        if model is None and worker_pool is None and \
+                generation_engine is None:
+            raise ValueError("need a model, a worker_pool or a "
+                             "generation_engine")
         self.model = model
+        #: continuous-batching autoregressive engine behind
+        #: POST /generate (serving/generation/); its loop thread is
+        #: started/stopped with the server
+        self.generation_engine = generation_engine
         #: multi-replica scale-out (serving/worker_pool.py — the Flink
         #: modelParallelism analog): batches dispatch to N replica
         #: processes concurrently instead of the in-process model
         self.worker_pool = worker_pool
         self._predict = (worker_pool.predict if worker_pool is not None
-                         else model.predict)
+                         else model.predict if model is not None
+                         else None)   # generation-only server
         self.max_batch_size = max_batch_size
         self.batch_timeout_s = batch_timeout_ms / 1e3
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
@@ -147,6 +163,10 @@ class ServingServer:
 
         class Handler(BaseHTTPRequestHandler):
             daemon_threads = True
+            # HTTP/1.1 so /generate can stream Transfer-Encoding:
+            # chunked; every other handler sends Content-Length, which
+            # keeps persistent connections well-formed
+            protocol_version = "HTTP/1.1"
 
             def log_message(self, fmt, *args):
                 # http.server's default stderr chatter becomes a
@@ -214,9 +234,81 @@ class ServingServer:
                     return
                 self._json(404, {"error": "not found"})
 
+            def _chunk(self, text: str):
+                data = text.encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode()
+                                 + data + b"\r\n")
+                self.wfile.flush()
+
+            def _generate(self, body: bytes):
+                """Streamed autoregressive generation: each sampled
+                token goes out as its own chunk the moment the engine
+                emits it — a client renders tokens at decode latency,
+                not request latency."""
+                eng = server.generation_engine
+                if eng is None:
+                    self._json(404, {"error": "no generation engine "
+                                     "behind this server"})
+                    return
+                try:
+                    req = json.loads(body)
+                    tokens = [int(t) for t in req["tokens"]]
+                except Exception as e:
+                    self._json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    stream = eng.submit(
+                        tokens,
+                        max_new_tokens=int(req.get("max_new_tokens",
+                                                   32)),
+                        temperature=float(req.get("temperature", 0.0)),
+                        top_k=int(req.get("top_k", 0)),
+                        eos_id=(int(req["eos_id"])
+                                if req.get("eos_id") is not None
+                                else None))
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                server._c_requests.inc()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                n = 0
+                with trace("serving.generate", prompt=len(tokens)):
+                    try:
+                        for tok in stream:
+                            self._chunk(json.dumps({"token": tok})
+                                        + "\n")
+                            n += 1
+                        self._chunk(json.dumps(
+                            {"done": True, "n_tokens": n,
+                             "finish_reason": stream.finish_reason})
+                            + "\n")
+                    except Exception as e:
+                        # stream died mid-flight (engine stop, queue
+                        # timeout): terminate the chunked body with an
+                        # error line rather than a torn connection
+                        log_event("generate_error",
+                                  error=f"{type(e).__name__}: {e}")
+                        try:
+                            self._chunk(json.dumps(
+                                {"error": f"{type(e).__name__}: {e}"})
+                                + "\n")
+                        except OSError:
+                            return
+                    self.wfile.write(b"0\r\n\r\n")
+
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                if self.path == "/generate":
+                    self._generate(body)
+                    return
+                if server._predict is None:
+                    self._json(400, {"error": "this server has no "
+                                     "predict model (generation-only)"})
+                    return
                 arrow = (self.headers.get("Content-Type", "")
                          .startswith(ARROW_CONTENT_TYPE))
                 if arrow:
@@ -320,8 +412,9 @@ class ServingServer:
 
     @property
     def records_served(self) -> int:
-        return (self.worker_pool.records_served if self.worker_pool
-                else self.model.records_served)
+        if self.worker_pool is not None:
+            return self.worker_pool.records_served
+        return self.model.records_served if self.model is not None else 0
 
     def _batcher(self):
         """Drain the queue into device-batches (the FlinkInference.map
@@ -461,6 +554,16 @@ class ServingServer:
                 "per_worker_served":
                     self.worker_pool.per_worker_served(),
             }
+        if self.generation_engine is not None:
+            eng = self.generation_engine
+            out["generation"] = {
+                "active_slots": len(eng.scheduler.running()),
+                "max_slots": eng.max_slots,
+                "queue_depth": len(eng.scheduler.waiting),
+                "cache_occupancy": eng.cache.allocator.occupancy(),
+                "preemptions": eng.scheduler.n_preemptions,
+                "tokens_total": eng._c_tokens.value,
+            }
         return out
 
     # ------------------------------------------------------------------
@@ -472,6 +575,8 @@ class ServingServer:
         t1 = threading.Thread(target=self._batcher, daemon=True)
         t1.start()
         self._threads = [t1]
+        if self.generation_engine is not None:
+            self.generation_engine.ensure_started()
         self._http_started = http
         if http:
             if self._httpd is None:
@@ -490,6 +595,8 @@ class ServingServer:
 
     def stop(self):
         self._stop.set()
+        if self.generation_engine is not None:
+            self.generation_engine.stop()
         # shutdown() blocks on the serve_forever loop — only valid when
         # that loop actually ran (http=False never builds the listener)
         if self._httpd is not None:

@@ -617,6 +617,8 @@ def test_flash_dbias_kernel_dce_when_bias_constant():
     assert n_learn == n_const + 1
 
 
+@pytest.mark.slow   # ~21s warm; ring_flash_matches_ring_einsum
+# keeps the ring<->flash parity gate in the tier-1 budget
 def test_ring_dropout_and_bias_parity_with_flash():
     """r5 (VERDICT r4 weak #4 / ask #4): ring attention composes with
     attention dropout and additive bias.  The positional-hash RNG is

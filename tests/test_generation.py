@@ -1,0 +1,337 @@
+"""Continuous-batching generation subsystem tests
+(serving/generation/): block allocator invariants, scheduler
+join/leave + preemption, the zero-recompile decode guarantee, KV-cached
+vs full-recompute logit equivalence, and streamed /generate end-to-end
+through ServingServer."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.serving.generation import (
+    BlockAllocator,
+    CausalLM,
+    GenerationEngine,
+    PagedKVCache,
+    sample_tokens,
+)
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = CausalLM(vocab=VOCAB, hidden_size=32, n_head=4, n_block=2,
+                     intermediate_size=64, max_position_len=256)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        jnp.arange(8)[None])["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def eng(lm):
+    """One warmed engine shared by the tests that don't need a special
+    pool/slot geometry — mirrors a long-lived serving process."""
+    model, params = lm
+    e = GenerationEngine(model, params, max_slots=4, block_size=8,
+                         max_context=64)
+    e.warmup()
+    return e
+
+
+def _assert_greedy(model, params, prompt, out):
+    """Verify `out` is the greedy full-recompute decode of `prompt`
+    with ONE forward: greedy decoding == teacher forcing, so on the
+    completed sequence every generated token must be the argmax of the
+    logits at its preceding position (causality makes position j's
+    logits independent of later tokens)."""
+    assert out, "no tokens generated"
+    seq = list(prompt) + list(out)
+    logits, _, _ = model.apply(
+        {"params": params}, jnp.asarray(seq)[None],
+        jnp.arange(len(seq))[None], token_mask=jnp.ones((1, len(seq))))
+    want = np.argmax(np.asarray(logits[0]), axis=-1)
+    for i, tok in enumerate(out):
+        assert tok == want[len(prompt) + i - 1], (
+            f"token {i}: engine {tok} != full-recompute "
+            f"{want[len(prompt) + i - 1]}")
+
+
+# ----------------------------------------------------------------------
+# block allocator
+# ----------------------------------------------------------------------
+
+def test_block_allocator_invariants():
+    a = BlockAllocator(8)               # 7 allocatable, block 0 null
+    assert a.capacity == 7
+    got = a.alloc(3)
+    assert len(got) == 3 and 0 not in got
+    assert a.available() == 4
+    assert abs(a.occupancy() - 3 / 7) < 1e-9
+    assert a.alloc(5) is None           # over-ask: nothing handed out
+    assert a.available() == 4
+    rest = a.alloc(4)
+    assert a.alloc(1) is None and a.occupancy() == 1.0
+    a.free(got)
+    with pytest.raises(ValueError, match="double free"):
+        a.free([got[0]])
+    with pytest.raises(ValueError, match="null block"):
+        a.free([0])
+    a.free(rest)
+    assert a.available() == 7 and a.occupancy() == 0.0
+
+
+def test_paged_cache_shapes():
+    c = PagedKVCache(n_layers=2, num_blocks=5, block_size=4, n_head=2,
+                     head_dim=8)
+    assert c.kv.shape == (2, 2, 20, 2, 8)
+    assert c.blocks_for(1) == 1 and c.blocks_for(4) == 1
+    assert c.blocks_for(5) == 2
+
+
+# ----------------------------------------------------------------------
+# logit equivalence: KV-cached decode == full-sequence recompute
+# ----------------------------------------------------------------------
+
+def test_attention_kv_cache_path_matches_full():
+    from analytics_zoo_tpu.ops.attention import dot_product_attention
+
+    rng = np.random.default_rng(0)
+    b, t, h, d = 2, 9, 2, 8
+    q, k, v = (rng.normal(size=(b, t, h, d)).astype(np.float32)
+               for _ in range(3))
+    full = dot_product_attention(q, k, v, causal=True,
+                                 compute_dtype=jnp.float32)
+    # cached view of the last token: context gathered (with garbage
+    # padding past ctx_len) + the new token itself
+    pad = 4
+    ctx_k = np.concatenate(
+        [k[:, :t - 1], rng.normal(size=(b, pad, h, d))], 1
+    ).astype(np.float32)
+    ctx_v = np.concatenate(
+        [v[:, :t - 1], rng.normal(size=(b, pad, h, d))], 1
+    ).astype(np.float32)
+    ctx_len = np.full(b, t - 1, np.int32)
+    cached = dot_product_attention(
+        q[:, t - 1:], k[:, t - 1:], v[:, t - 1:],
+        compute_dtype=jnp.float32,
+        ctx_k=ctx_k, ctx_v=ctx_v, ctx_len=ctx_len)
+    np.testing.assert_allclose(np.asarray(cached),
+                               np.asarray(full[:, t - 1:]), atol=1e-5)
+
+
+def test_model_cached_logits_match_full_recompute(lm):
+    model, params = lm
+    rng = np.random.default_rng(1)
+    L = 12
+    ctx = rng.integers(0, VOCAB, L).astype(np.int32)
+    full, all_k, all_v = model.apply(
+        {"params": params}, jnp.asarray(ctx)[None],
+        jnp.arange(L)[None], token_mask=jnp.ones((1, L)))
+    # decode-style: last token against the cache of the first L-1
+    # (padded with garbage the ctx_len mask must hide)
+    pad = 5
+    junk = rng.normal(size=(model.n_block, 1, pad, model.n_head,
+                            model.hidden_size // model.n_head))
+    ck = jnp.concatenate([all_k[:, :, :L - 1], jnp.asarray(junk)], 2)
+    cv = jnp.concatenate([all_v[:, :, :L - 1], jnp.asarray(junk)], 2)
+    cached, _, _ = model.apply(
+        {"params": params}, jnp.asarray(ctx[L - 1:])[None],
+        jnp.full((1, 1), L - 1), ctx_k=ck, ctx_v=cv,
+        ctx_len=jnp.full(1, L - 1, jnp.int32))
+    np.testing.assert_allclose(np.asarray(cached[0, 0]),
+                               np.asarray(full[0, -1]), atol=1e-4)
+
+
+def test_engine_greedy_matches_full_recompute(lm, eng):
+    model, params = lm
+    rng = np.random.default_rng(2)
+    for trial in range(3):
+        prompt = list(rng.integers(0, VOCAB, int(rng.integers(4, 20))))
+        n = int(rng.integers(3, 12))
+        _assert_greedy(model, params, prompt,
+                       eng.generate(prompt, max_new_tokens=n))
+
+
+# ----------------------------------------------------------------------
+# zero recompiles after warmup
+# ----------------------------------------------------------------------
+
+def test_decode_compiles_once_after_warmup(lm, eng):
+    model, params = lm
+    assert eng.decode_compile_count == 1
+    rng = np.random.default_rng(3)
+    # mixed prompt lengths and batch occupancies, staggered finishes —
+    # steady-state serving must never touch the compiler again
+    streams = [eng.submit(list(rng.integers(0, VOCAB, l)),
+                          max_new_tokens=m, temperature=temp, top_k=k)
+               for l, m, temp, k in [(5, 3, 0.0, 0), (17, 9, 0.7, 5),
+                                     (33, 2, 0.0, 0), (8, 12, 1.2, 1),
+                                     (50, 5, 0.3, 40), (3, 7, 0.0, 0)]]
+    eng.run_until_idle()
+    assert all(len(s.tokens()) > 0 for s in streams)
+    assert eng.decode_compile_count == 1, \
+        "decode step recompiled during steady-state serving"
+
+
+# ----------------------------------------------------------------------
+# scheduler: join/leave mid-stream, preemption
+# ----------------------------------------------------------------------
+
+def test_scheduler_join_and_leave_midstream(lm):
+    model, params = lm
+    engine = GenerationEngine(model, params, max_slots=2, block_size=8,
+                              max_context=64)
+    rng = np.random.default_rng(4)
+    p_long = list(rng.integers(0, VOCAB, 10))
+    p_short = list(rng.integers(0, VOCAB, 6))
+    long_s = engine.submit(p_long, max_new_tokens=20)
+    engine.step()                       # long admitted + prefilled
+    assert long_s.seq.status == "running"
+    short_s = engine.submit(p_short, max_new_tokens=3)
+    engine.step()                       # short JOINS the running batch
+    assert short_s.seq.status == "running"
+    assert len(engine.scheduler.running()) == 2
+    while short_s.seq.status == "running":
+        engine.step()
+    # short LEFT; long is still mid-stream on its lane
+    assert short_s.seq.finish_reason == "length"
+    assert long_s.seq.status == "running"
+    # the freed lane is immediately admittable
+    third = engine.submit(p_short, max_new_tokens=2)
+    engine.step()
+    assert third.seq.status in ("running", "finished")
+    engine.run_until_idle()
+    _assert_greedy(model, params, p_long, long_s.tokens())
+    _assert_greedy(model, params, p_short, short_s.tokens())
+    assert len(long_s.seq.generated) == 20
+    assert len(short_s.seq.generated) == 3
+
+
+def test_preemption_under_cache_pressure_is_lossless(lm):
+    model, params = lm
+    # 9 allocatable blocks for 4 lanes that want up to 8 each
+    engine = GenerationEngine(model, params, max_slots=4, block_size=8,
+                              max_context=64, num_blocks=10)
+    rng = np.random.default_rng(5)
+    reqs = [list(rng.integers(0, VOCAB, 20)) for _ in range(5)]
+    streams = [engine.submit(p, max_new_tokens=16) for p in reqs]
+    engine.run_until_idle()
+    assert engine.scheduler.n_preemptions > 0
+    for p, s in zip(reqs, streams):
+        out = s.tokens()
+        assert len(out) == 16
+        _assert_greedy(model, params, p, out)
+    # release-on-finish: every block returned to the pool
+    assert engine.cache.allocator.occupancy() == 0.0
+    assert engine.cache.allocator.available() == \
+        engine.cache.allocator.capacity
+
+
+def test_submit_validation(lm):
+    model, params = lm
+    engine = GenerationEngine(model, params, max_slots=2, block_size=8,
+                              max_context=32)
+    with pytest.raises(ValueError, match="max_context"):
+        engine.submit(list(range(30)), max_new_tokens=10)
+    with pytest.raises(ValueError, match="vocab"):
+        engine.submit([VOCAB + 5], max_new_tokens=1)
+    with pytest.raises(ValueError, match="empty"):
+        engine.submit([], max_new_tokens=1)
+
+
+def test_sampling_controls():
+    logits = jnp.asarray(np.random.default_rng(6)
+                         .normal(size=(3, 32)).astype(np.float32))
+    rng = jax.random.PRNGKey(0)
+    greedy = np.argmax(np.asarray(logits), -1)
+    # temperature 0 → greedy; top_k=1 → greedy regardless of temp
+    t0 = sample_tokens(logits, rng, jnp.zeros(3), jnp.zeros(3, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(t0), greedy)
+    k1 = sample_tokens(logits, rng, jnp.full(3, 2.0),
+                       jnp.ones(3, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(k1), greedy)
+    # top_k restricts support
+    k4 = sample_tokens(logits, jax.random.PRNGKey(7), jnp.full(3, 1.5),
+                       jnp.full(3, 4, jnp.int32))
+    top4 = np.argsort(np.asarray(logits), -1)[:, -4:]
+    for row, tok in enumerate(np.asarray(k4)):
+        assert tok in top4[row]
+
+
+# ----------------------------------------------------------------------
+# end-to-end: streamed /generate through ServingServer
+# ----------------------------------------------------------------------
+
+def test_streamed_generate_end_to_end(lm, eng):
+    import json
+    from urllib.request import urlopen
+
+    from analytics_zoo_tpu.serving import InputQueue, ServingServer
+
+    model, params = lm
+    srv = ServingServer(generation_engine=eng).start()
+    try:
+        iq = InputQueue(srv.host, srv.port)
+        rng = np.random.default_rng(7)
+        prompt = list(rng.integers(0, VOCAB, 9))
+        toks = []
+        for t in iq.generate(prompt, max_new_tokens=8):
+            toks.append(t)
+        _assert_greedy(model, params, prompt, toks)
+        assert iq.last_generate["n_tokens"] == 8
+        assert iq.last_generate["finish_reason"] == "length"
+        # concurrent streams share the decode batch
+        import threading
+        outs = {}
+
+        def go(j):
+            c = InputQueue(srv.host, srv.port)
+            p = list(np.random.default_rng(20 + j)
+                     .integers(0, VOCAB, 5 + j))
+            outs[j] = (p, c.generate_tokens(p, max_new_tokens=6))
+
+        threads = [threading.Thread(target=go, args=(j,))
+                   for j in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for j, (p, o) in outs.items():
+            _assert_greedy(model, params, p, o)
+        # still exactly one compiled decode program
+        assert eng.decode_compile_count == 1
+        # bad request surfaces as an HTTP error, not a hang
+        with pytest.raises(RuntimeError, match="serving error"):
+            list(iq.generate([VOCAB + 9], max_new_tokens=2))
+        # /metrics exposes the generation decomposition
+        text = urlopen(f"http://{srv.host}:{srv.port}/metrics",
+                       timeout=10).read().decode()
+        for key in ("generation_tokens_total",
+                    "generation_cache_occupancy",
+                    "generation_prefill_seconds",
+                    "generation_decode_seconds"):
+            assert key in text, key
+        # /stats carries the live generation snapshot
+        stats = json.loads(urlopen(
+            f"http://{srv.host}:{srv.port}/stats", timeout=10).read())
+        assert "generation" in stats
+        assert stats["generation"]["tokens_total"] >= 8
+    finally:
+        srv.stop()
+
+
+def test_generation_only_server_rejects_predict(lm, eng):
+    from analytics_zoo_tpu.serving import InputQueue, ServingServer
+
+    model, params = lm
+    srv = ServingServer(generation_engine=eng).start()
+    try:
+        iq = InputQueue(srv.host, srv.port)
+        with pytest.raises(RuntimeError, match="generation-only"):
+            iq.predict(np.zeros(4, np.float32))
+    finally:
+        srv.stop()

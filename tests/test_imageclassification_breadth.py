@@ -28,8 +28,14 @@ def _data(n=16, hw=32, classes=2, seed=0):
 
 
 @pytest.mark.parametrize("model", [
-    InceptionV1(num_classes=2, width=0.125),
-    MobileNetV2(num_classes=2, width=0.125),
+    # inception/mobilenet are the two slowest tests in the whole suite
+    # (~49s + ~34s warm): slow-marked so the tier-1 `-m 'not slow'`
+    # budget keeps VGG as the representative backbone; run them with a
+    # plain `pytest tests/test_imageclassification_breadth.py`
+    pytest.param(InceptionV1(num_classes=2, width=0.125),
+                 marks=pytest.mark.slow),
+    pytest.param(MobileNetV2(num_classes=2, width=0.125),
+                 marks=pytest.mark.slow),
     VGG16(num_classes=2, width=0.125, fc_dim=32),
 ])
 def test_backbone_fit_predict(model):

@@ -178,6 +178,8 @@ def test_moe_trains_on_ep_mesh(ep_mesh):
     assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
 
 
+@pytest.mark.slow   # ~20s warm; the estimator aux-loss test keeps
+# masked-aux coverage in the tier-1 budget
 def test_moe_aux_ignores_padded_rows(ep_mesh):
     """r5 (VERDICT r4 weak #7): the router's balance statistics and
     capacity buckets exclude padded rows.
@@ -212,10 +214,13 @@ def test_moe_aux_ignores_padded_rows(ep_mesh):
                             token_mask=jnp.asarray(mask))
     np.testing.assert_allclose(float(aux_pad), float(aux_junk),
                                rtol=1e-6)
-    # ...while the UNmasked router is content-dependent (the bug class)
+    # ...while the UNmasked router is content-dependent (the bug
+    # class).  Any nonzero-beyond-fp difference demonstrates it; the
+    # magnitude depends on how many junk rows win capacity slots, which
+    # varies across jax versions' routing tie-breaks
     _, blind_pad = moe.apply({"params": params}, x_pad)
     _, blind_junk = moe.apply({"params": params}, x_junk)
-    assert abs(float(blind_pad) - float(blind_junk)) > 1e-4
+    assert abs(float(blind_pad) - float(blind_junk)) > 1e-5
 
     # dense path: masked aux == aux of the unpadded prefix, exactly
     stop_orca_context()
