@@ -55,6 +55,8 @@ class OrcaContextMeta(type):
     _failure_retry_times = 5
     _failure_retry_interval_s = 1.0
     _observability_dir = None
+    _kernel_tuning_mode = "off"
+    _kernel_tuning_cache_dir = None
 
     # --- TPU runtime state ---
     _mesh = None
@@ -196,6 +198,37 @@ class OrcaContextMeta(type):
     @observability_dir.setter
     def observability_dir(cls, value):
         cls._observability_dir = None if value is None else str(value)
+
+    @property
+    def kernel_tuning_mode(cls):
+        """Pallas kernel autotuning policy (ops/tuning, docs/kernels.md):
+        "off" (default) — tuned configs come from the persisted cache /
+        checked-in default tables only, a cache miss falls back to the
+        builtin defaults and NEVER benchmarks (CI-safe); "auto" — a
+        cache miss outside a jax trace on real hardware runs the
+        block-size search once and persists the winner."""
+        return cls._kernel_tuning_mode
+
+    @kernel_tuning_mode.setter
+    def kernel_tuning_mode(cls, value):
+        value = str(value).lower()
+        if value not in ("off", "auto"):
+            raise ValueError(
+                f"kernel_tuning_mode must be 'off' or 'auto', got {value!r}")
+        cls._kernel_tuning_mode = value
+
+    @property
+    def kernel_tuning_cache_dir(cls):
+        """Directory holding `kernel_tuning.json`, the persisted
+        per-(kernel, shape-bucket, dtype, platform) block-config cache
+        search winners are written to (and read back from, ahead of the
+        checked-in default tables).  None (default) disables
+        persistence; tuning results then live only in process memory."""
+        return cls._kernel_tuning_cache_dir
+
+    @kernel_tuning_cache_dir.setter
+    def kernel_tuning_cache_dir(cls, value):
+        cls._kernel_tuning_cache_dir = None if value is None else str(value)
 
     @property
     def mesh(cls):

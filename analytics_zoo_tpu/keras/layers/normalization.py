@@ -7,6 +7,7 @@ from typing import Optional
 import flax.linen as nn
 
 from analytics_zoo_tpu.keras.engine import Layer
+from analytics_zoo_tpu.ops.normalization import LayerNorm as _OpsLayerNorm
 
 
 class BatchNormalization(Layer):
@@ -33,7 +34,9 @@ class LayerNormalization(Layer):
         self.epsilon = epsilon
 
     def build_flax(self):
-        return nn.LayerNorm(epsilon=self.epsilon, name=self.name)
+        # routed through the ops dispatch layer (fused Pallas kernel on
+        # TPU, identical XLA form elsewhere); param tree unchanged
+        return _OpsLayerNorm(epsilon=self.epsilon, name=self.name)
 
     def apply_flax(self, m, x, training=False):
         return m(x)

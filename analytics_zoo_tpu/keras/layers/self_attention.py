@@ -19,6 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.keras.engine import Layer
+from analytics_zoo_tpu.ops.dense import DenseGelu
+from analytics_zoo_tpu.ops.normalization import LayerNorm as OpsLayerNorm
 
 
 class MultiHeadAttention(nn.Module):
@@ -199,14 +201,22 @@ class TransformerBlock(nn.Module):
                                attn_impl=self.attn_impl,
                                name="attn")(x, mask, training)
         a = nn.Dropout(self.residual_dropout)(a, deterministic=not training)
-        x = nn.LayerNorm(name="ln1")(x + a.astype(x.dtype))
-        f = nn.Dense(self.intermediate_size, dtype=self.compute_dtype,
-                     name="fc1")(x)
-        f = get_activation(self.activation)(f)
+        # LayerNorms and fc1+GELU go through the ops dispatch layer
+        # (ops.normalization / ops.dense): fused Pallas kernels on TPU,
+        # the bit-identical XLA forms elsewhere — same param trees as
+        # nn.LayerNorm / nn.Dense, so checkpoints are untouched
+        x = OpsLayerNorm(name="ln1")(x + a.astype(x.dtype))
+        if self.activation == "gelu":
+            f = DenseGelu(self.intermediate_size,
+                          dtype=self.compute_dtype, name="fc1")(x)
+        else:
+            f = nn.Dense(self.intermediate_size, dtype=self.compute_dtype,
+                         name="fc1")(x)
+            f = get_activation(self.activation)(f)
         f = nn.Dense(self.hidden_size, dtype=self.compute_dtype,
                      name="fc2")(f)
         f = nn.Dropout(self.residual_dropout)(f, deterministic=not training)
-        return nn.LayerNorm(name="ln2")(x + f.astype(x.dtype))
+        return OpsLayerNorm(name="ln2")(x + f.astype(x.dtype))
 
 
 class TransformerEncoder(nn.Module):
@@ -264,7 +274,7 @@ class TransformerEncoder(nn.Module):
             x = x + nn.Embed(self.n_segments, self.hidden_size,
                              name="segment_embed"
                              )(segment_ids.astype(jnp.int32))
-        x = nn.LayerNorm(name="embed_ln")(x)
+        x = OpsLayerNorm(name="embed_ln")(x)
         x = nn.Dropout(self.embedding_dropout)(x, deterministic=not training)
 
         # pass the raw [b, t] key-validity mask down: each attention impl

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.keras.layers.self_attention import TransformerBlock
+from analytics_zoo_tpu.ops.normalization import LayerNorm as OpsLayerNorm
 from analytics_zoo_tpu.parallel.pipeline import (
     PIPELINE_SHARD_RULES,
     pipeline_apply,
@@ -49,7 +50,7 @@ class _Embed(nn.Module):
                          name="position_embed")(jnp.arange(t)[None, :])
         x = x + nn.Embed(self.n_segments, self.hidden_size,
                          name="segment_embed")(seg.astype(jnp.int32))
-        return nn.LayerNorm(name="embed_ln")(x)
+        return OpsLayerNorm(name="embed_ln")(x)
 
 
 class _Stage(nn.Module):

@@ -24,10 +24,11 @@ VERDICT r3 weak #4):
     HBM no matter the batch.  The bias is DIFFERENTIABLE (r5): dbias_ij =
     ds_ij = p_ij*(dp_ij - delta_i);
     a dedicated backward pass (`_bwd_dbias_kernel`) recomputes ds
-    blockwise and ACCUMULATES broadcast replicas in VMEM (rep-innermost
-    grid), so the gradient lands in HBM at the PRIMAL bias's own shape —
-    a T5 [1, h, t, t] bias gets an [h, t, t] f32 gradient, never
-    [b*h, t, t].  Learnable biases therefore no longer force the einsum
+    blockwise and ACCUMULATES broadcast replicas in an O(block) f32
+    VMEM scratch (rep-innermost grid), so the gradient lands in HBM at
+    the PRIMAL bias's own shape AND DTYPE — a T5 [1, h, t, t] bf16
+    bias gets an [h, t, t] bf16 gradient, never an f32 [b*h, t, t]
+    buffer.  Learnable biases therefore no longer force the einsum
     path.  The dbias pass is a separate pallas_call precisely so that
     CONSTANT biases (padding/causal masks) never pay for it: their
     cotangent is dead code and jax/XLA eliminate the whole call, keeping
@@ -51,21 +52,182 @@ from jax.experimental.pallas import tpu as pltpu
 
 _einsum = partial(jnp.einsum, precision=jax.lax.Precision.HIGHEST)
 
-#: measured on v5e-1 (b=4, h=8, d=64, t=4096 fwd+bwd): (256,256) 52ms,
-#: (512,512) 48ms, (512,1024) 45ms — bigger K tiles amortize the
-#: per-block online-softmax bookkeeping.  Re-validated at d=128
-#: (r5, t=16k fwd+bwd): an 8-config sweep found nothing beyond 1.03x
-#: of these defaults (within tunnel noise), so one tiling serves both
-#: head widths.
+#: BUILTIN-FALLBACK tiles (measured on v5e-1, b=4, h=8, d=64, t=4096
+#: fwd+bwd: (256,256) 52ms, (512,512) 48ms, (512,1024) 45ms — bigger K
+#: tiles amortize the per-block online-softmax bookkeeping; an r5
+#: 8-config sweep at d=128 t=16k found nothing beyond 1.03x).  Since
+#: the autotuner landed these are only the LAST resort: block sizes
+#: default to `ops.tuning.get_config("flash_fwd"/"flash_bwd", ...)`,
+#: which consults the persisted per-(shape-bucket, dtype, platform)
+#: search cache and the checked-in default tables first — the r5
+#: verdict showed one tiling does NOT serve both head widths
+#: (flash_eff_t2048_d64=0.132 vs dense 0.534).  See docs/kernels.md.
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
-#: backward tiles, measured at t=16k (bf16, masked): (512,512) 54ms,
-#: (1024,512) 52ms total fwd+bwd; K blocks of 1024 blow the 16MB scoped
-#: VMEM in the dkv kernel (its dim-0-contraction dots materialize
-#: [bk, bq] transposes)
+#: backward fallback tiles, measured at t=16k (bf16, masked):
+#: (512,512) 54ms, (1024,512) 52ms total fwd+bwd; K blocks of 1024
+#: blow the 16MB scoped VMEM in the dkv kernel (its dim-0-contraction
+#: dots materialize [bk, bq] transposes) — the candidate grid below
+#: therefore excludes bwd block_k=1024
 DEFAULT_BLOCK_Q_BWD = 1024
 DEFAULT_BLOCK_K_BWD = 512
 NEG_INF = -1e30
+#: candidate VMEM ceiling: stay under the ~16MB scoped budget with
+#: headroom for Mosaic's own staging
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def flash_fwd_candidates(t: int, d: int):
+    """The autotuner's forward candidate grid: (block_q, block_k)
+    pairs that tile `t` and fit the VMEM budget at head dim `d`."""
+    out = []
+    for bq in (256, 512, 1024):
+        for bk in (256, 512, 1024):
+            if bq > t or bk > t:
+                continue
+            # q/k/v tiles (f32-equivalent bound) + f32 scores + o/m/l
+            # scratch
+            vmem = ((bq * d + 2 * bk * d) * 4 + bq * bk * 4
+                    + bq * d * 4 + 2 * bq * 128 * 4)
+            if vmem <= _VMEM_BUDGET:
+                out.append({"block_q": bq, "block_k": bk})
+    return out or [{"block_q": DEFAULT_BLOCK_Q,
+                    "block_k": DEFAULT_BLOCK_K}]
+
+
+def flash_bwd_candidates(t: int, d: int):
+    """Backward grid: block_k=1024 is excluded (see
+    DEFAULT_BLOCK_Q_BWD note — the dkv kernel's transposed dots blow
+    VMEM there)."""
+    out = []
+    for bq in (256, 512, 1024):
+        for bk in (256, 512):
+            if bq > t or bk > t:
+                continue
+            vmem = ((bq * d + 2 * bk * d) * 4 + 2 * bq * bk * 4
+                    + 2 * bk * d * 4 + bq * d * 4)
+            if vmem <= _VMEM_BUDGET:
+                out.append({"block_q": bq, "block_k": bk})
+    return out or [{"block_q": DEFAULT_BLOCK_Q_BWD,
+                    "block_k": DEFAULT_BLOCK_K_BWD}]
+
+
+def _bench_flash_fwd(b, t, h, d, dtype, cfg, iters: int = 4):
+    """Autotuner benchmark: forward-only wall time per call, the
+    iterations chained output->input inside ONE compiled scan so
+    per-dispatch latency cannot masquerade as kernel time (the bench.py
+    technique).  All four block args are passed explicitly so the
+    benchmark can never recurse into the tuner."""
+    from analytics_zoo_tpu.observability import now
+    k0 = jax.random.PRNGKey(0)
+    q = jax.random.normal(k0, (b, t, h, d), dtype)
+    k = jax.random.normal(jax.random.fold_in(k0, 1), (b, t, h, d), dtype)
+    v = jax.random.normal(jax.random.fold_in(k0, 2), (b, t, h, d), dtype)
+
+    @jax.jit
+    def many(q, k, v):
+        def body(c, _):
+            o = flash_attention(
+                c, k, v, block_q=cfg["block_q"], block_k=cfg["block_k"],
+                bwd_block_q=DEFAULT_BLOCK_Q_BWD,
+                bwd_block_k=DEFAULT_BLOCK_K_BWD)
+            return o.astype(c.dtype), None
+        c, _ = jax.lax.scan(body, q, None, length=iters)
+        return c[0, 0, 0, 0].astype(jnp.float32)
+
+    float(many(q, k, v))                      # compile + warm
+    dt = float("inf")
+    for _ in range(2):
+        t0 = now()
+        float(many(q, k, v))                  # value-fetch barrier
+        dt = min(dt, now() - t0)
+    return dt / iters
+
+
+def _bench_flash_bwd(b, t, h, d, dtype, fwd_cfg, cfg, iters: int = 4):
+    """Autotuner benchmark for the backward tiles: fwd+bwd wall time
+    with the forward pinned at `fwd_cfg` (tuned first) so only the
+    backward schedule varies."""
+    from analytics_zoo_tpu.observability import now
+    k0 = jax.random.PRNGKey(0)
+    q = jax.random.normal(k0, (b, t, h, d), dtype)
+    k = jax.random.normal(jax.random.fold_in(k0, 1), (b, t, h, d), dtype)
+    v = jax.random.normal(jax.random.fold_in(k0, 2), (b, t, h, d), dtype)
+    w_r = jax.random.normal(jax.random.fold_in(k0, 3), (b, t, h, d),
+                            dtype)
+
+    def loss(q, k, v):
+        return (flash_attention(
+            q, k, v, block_q=fwd_cfg["block_q"],
+            block_k=fwd_cfg["block_k"], bwd_block_q=cfg["block_q"],
+            bwd_block_k=cfg["block_k"]) * w_r).astype(jnp.float32).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def many(q, k, v):
+        def body(c, _):
+            cq, ck, cv = c
+            dq, dk, dv = g(cq, ck, cv)
+            eps = jnp.asarray(1e-8, dtype)
+            return (cq + dq.astype(dtype) * eps,
+                    ck + dk.astype(dtype) * eps,
+                    cv + dv.astype(dtype) * eps), None
+        c, _ = jax.lax.scan(body, (q, k, v), None, length=iters)
+        return c[0][0, 0, 0, 0].astype(jnp.float32)
+
+    float(many(q, k, v))
+    dt = float("inf")
+    for _ in range(2):
+        t0 = now()
+        float(many(q, k, v))
+        dt = min(dt, now() - t0)
+    return dt / iters
+
+
+def tuned_flash_blocks(b, t, h, d, dtype, allow_search=None):
+    """The four block sizes for this shape, from the autotuner
+    (ops/tuning): forward and backward are tuned INDEPENDENTLY under
+    the keys "flash_fwd"/"flash_bwd" at the pow2 (t, d) bucket.  With
+    tuning off (the default) this is a dict lookup against the
+    persisted cache / checked-in tables, falling back to the module
+    constants — never a benchmark."""
+    from analytics_zoo_tpu.ops import tuning
+    shape = {"t": t, "d": d}
+    fwd = tuning.get_config(
+        "flash_fwd", shape, dtype,
+        default={"block_q": DEFAULT_BLOCK_Q, "block_k": DEFAULT_BLOCK_K},
+        candidates=flash_fwd_candidates(t, d),
+        bench=lambda cfg: _bench_flash_fwd(b, t, h, d, dtype, cfg),
+        allow_search=allow_search)
+    bwd = tuning.get_config(
+        "flash_bwd", shape, dtype,
+        default={"block_q": DEFAULT_BLOCK_Q_BWD,
+                 "block_k": DEFAULT_BLOCK_K_BWD},
+        candidates=flash_bwd_candidates(t, d),
+        bench=lambda cfg: _bench_flash_bwd(b, t, h, d, dtype, fwd, cfg),
+        allow_search=allow_search)
+    return {"block_q": fwd["block_q"], "block_k": fwd["block_k"],
+            "bwd_block_q": bwd["block_q"], "bwd_block_k": bwd["block_k"]}
+
+
+def tune_flash_blocks(b, t, h, d, dtype=jnp.bfloat16, force=False):
+    """Search NOW (bench.py's kernel stage): benchmarks the candidate
+    grids on the attached accelerator, persists the winners to
+    `OrcaContext.kernel_tuning_cache_dir`, and returns the merged
+    config (same layout as `tuned_flash_blocks`)."""
+    from analytics_zoo_tpu.ops import tuning
+    shape = {"t": t, "d": d}
+    fwd = tuning.tune(
+        "flash_fwd", shape, dtype, flash_fwd_candidates(t, d),
+        lambda cfg: _bench_flash_fwd(b, t, h, d, dtype, cfg),
+        force=force)
+    bwd = tuning.tune(
+        "flash_bwd", shape, dtype, flash_bwd_candidates(t, d),
+        lambda cfg: _bench_flash_bwd(b, t, h, d, dtype, fwd, cfg),
+        force=force)
+    return {"block_q": fwd["block_q"], "block_k": fwd["block_k"],
+            "bwd_block_q": bwd["block_q"], "bwd_block_k": bwd["block_k"]}
 
 
 def _hash_bits(seed, bh, q_pos, k_pos):
@@ -383,7 +545,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
 def _bwd_dbias_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                       *rest, block_q: int, block_k: int, causal: bool,
                       has_mask: bool, dropout: float, scale: float,
-                      mul_l: int, mul_r: int):
+                      mul_l: int, mul_r: int, num_rep: int):
     # Standalone dbias pass: d s / d bias = 1, so the bias cotangent IS
     # ds = p*(dp - delta), recomputed here exactly as in the dQ kernel.
     # It is a SEPARATE pallas_call (not an extra dQ output) on purpose:
@@ -392,15 +554,18 @@ def _bwd_dbias_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     # gradient is only ever materialized for genuinely learnable biases.
     # Grid (lead, qi, ki, rep): `lead` walks the PRIMAL bias's leading
     # dim and `rep` its broadcast replicas (bh = mul_l*lead + mul_r*rep)
-    # — rep is innermost, so consecutive steps revisit the same output
-    # block and the broadcast reduction ACCUMULATES in VMEM instead of
-    # materializing [b*h, t, t] in HBM (a T5 [1, h, t, t] bias gets an
-    # [h, t, t] f32 gradient, b-fold smaller).
+    # — rep is innermost, so all replicas of one tile accumulate into
+    # the [block_q, block_k] f32 VMEM scratch (the dq/dkv pattern),
+    # and the LAST replica writes the tile to HBM once, already cast
+    # to the primal bias's dtype (ADVICE r5 #3): HBM holds one
+    # [lead, t, t] buffer at bias.dtype — a bf16 T5 bias's gradient
+    # costs half the old f32 buffer — while f32 precision lives only
+    # in the O(block) scratch.
     rest = list(rest)
     mask_ref = rest.pop(0) if has_mask else None
     bias_ref = rest.pop(0)
     seed_ref = rest.pop(0) if dropout > 0.0 else None
-    (dbias_ref,) = rest
+    dbias_ref, dbias_scr = rest
     lead = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -412,9 +577,9 @@ def _bwd_dbias_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
     @pl.when(rep == 0)
     def _init():
-        # first replica owns the tile: zero it (also covers causal-dead
-        # tiles, which skip the accumulation below entirely)
-        dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
+        # first replica owns the scratch tile: zero it (also covers
+        # causal-dead tiles, which skip the accumulation entirely)
+        dbias_scr[:] = jnp.zeros_like(dbias_scr)
 
     @pl.when(live)
     def _compute():
@@ -434,7 +599,11 @@ def _bwd_dbias_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             keep_d = _drop_keep(seed_ref, bh, q_start, k_start,
                                 block_q, block_k, dropout)
             dp = jnp.where(keep_d, dp * (1.0 / (1.0 - dropout)), 0.0)
-        dbias_ref[0] = dbias_ref[0] + p * (dp - delta_ref[0])
+        dbias_scr[:] = dbias_scr[:] + p * (dp - delta_ref[0])
+
+    @pl.when(rep == num_rep - 1)
+    def _finalize():
+        dbias_ref[0] = dbias_scr[:].astype(dbias_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
@@ -506,8 +675,10 @@ def _flash_bwd(q, k, v, kv_mask, bias, seed, out, lse, g, dlse, *,
                interpret: bool):
     """Pallas backward: returns (dq, dk, dv, dbias-or-None).  dbias
     comes from the dedicated `_bwd_dbias_kernel` pass (DCE'd when
-    unused), which accumulates broadcast replicas in VMEM and emits the
-    gradient at the collapsed primal shape [lead, t, t]."""
+    unused), which accumulates broadcast replicas in an O(block_q x
+    block_k) f32 VMEM scratch and emits the gradient at the collapsed
+    primal shape [lead, t, t] AT THE PRIMAL'S DTYPE — no f32 HBM
+    intermediate exists (ADVICE r5 #3)."""
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
     num_q = t // block_q
@@ -631,17 +802,19 @@ def _flash_bwd(q, k, v, kv_mask, bias, seed, out, lse, g, dlse, *,
         dbias = pl.pallas_call(
             partial(_bwd_dbias_kernel, block_q=block_q, block_k=block_k,
                     causal=causal, has_mask=has_mask, dropout=dropout,
-                    scale=scale, mul_l=mul_l, mul_r=mul_r),
-            out_shape=jax.ShapeDtypeStruct((lead, t, t), jnp.float32),
+                    scale=scale, mul_l=mul_l, mul_r=mul_r,
+                    num_rep=reps),
+            # the gradient lands in HBM at the PRIMAL bias's dtype;
+            # the f32 accumulator is the O(block) VMEM scratch below
+            out_shape=jax.ShapeDtypeStruct((lead, t, t), bias.dtype),
             grid=(lead, num_q, num_k, reps),
             in_specs=dspecs,
             out_specs=pl.BlockSpec((1, block_q, block_k),
                                    lambda l, i, j, r: (l, i, j),
                                    memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
             interpret=interpret,
         )(*dargs)
-        # f32 accumulation in-kernel; cotangent dtype must match primal
-        dbias = dbias.astype(bias.dtype)
 
     specs, args = common_specs(qk_order=False)
     dk, dv = pl.pallas_call(
@@ -751,10 +924,10 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
                     dropout_rate: float = 0.0, dropout_rng=None,
                     dropout_seed=None, dropout_pos=None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
-                    bwd_block_q: int = DEFAULT_BLOCK_Q_BWD,
-                    bwd_block_k: int = DEFAULT_BLOCK_K_BWD,
+                    block_q: int = None,
+                    block_k: int = None,
+                    bwd_block_q: int = None,
+                    bwd_block_k: int = None,
                     interpret: bool = None, return_lse: bool = False):
     """Flash attention over [batch, t, heads, d] (BTHD, same convention as
     `ops.attention.dot_product_attention`).
@@ -768,14 +941,17 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
     kernel; broadcast replicas accumulate in-kernel so the gradient has
     the primal bias's own shape.
     MEMORY (differentiated bias only): the backward pass materializes
-    the bias gradient as a FLOAT32 [lead, t, t] HBM buffer (`lead` =
-    the primal bias's leading dims after broadcast reduction, e.g. `h`
-    for a [1, h, t, t] T5 bias) — at t=16k, h=12 that is ~12 GB, which
-    can OOM even when the bf16 primal bias itself fits.  The buffer
-    exists only when something actually differentiates the bias (a
-    constant additive mask's dbias pass is dead code XLA eliminates);
-    budget for it — or shorten t / shard heads — before training
-    learnable biases at long context.
+    the bias gradient as ONE [lead, t, t] HBM buffer at the PRIMAL
+    BIAS'S DTYPE (`lead` = the bias's leading dims after broadcast
+    reduction, e.g. `h` for a [1, h, t, t] T5 bias); the f32
+    accumulation lives in an O(block_q x block_k) VMEM scratch, never
+    in HBM.  At t=16k, h=12 a bf16 bias's gradient is ~6 GB (the old
+    f32 buffer was ~12 GB and could OOM even when the bf16 primal
+    fit).  The buffer exists only when something actually
+    differentiates the bias (a constant additive mask's dbias pass is
+    dead code XLA eliminates); budget for the primal-sized gradient —
+    or shorten t / shard heads — before training learnable biases at
+    long context.
     dropout_rate / dropout_rng: attention-probability dropout; the rng
     key is folded into an int32 seed for the positional hash RNG, so the
     forward and backward kernels agree on the keep mask without a [T, T]
@@ -787,6 +963,15 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
     positions, making the keep mask shard-invariant: a ring device
     passes its Q-shard offset and the rotating K-shard's offset and gets
     bit-identical dropout to an unsharded call.
+
+    block_q/block_k (forward) and bwd_block_q/bwd_block_k (backward)
+    default to None = "ask the autotuner" (ops/tuning, docs/kernels.md):
+    the tuned config for this (t, d) pow2 bucket, dtype and platform —
+    a dict lookup against the persisted search cache and the
+    checked-in default tables, falling back to the module constants.
+    The lookup is memoized per key, so steady-state calls always trace
+    with the same static tile sizes (zero recompiles).  Passing
+    explicit ints bypasses the tuner entirely.
 
     return_lse=True additionally returns the per-row logsumexp
     [batch, t, heads] (pre-dropout, matching the kernel's online-softmax
@@ -800,6 +985,15 @@ def flash_attention(q, k, v, *, kv_mask=None, bias=None, causal: bool = False,
     b, t, h, d = q.shape
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
+    if block_q is None or block_k is None or bwd_block_q is None \
+            or bwd_block_k is None:
+        cfg = tuned_flash_blocks(b, t, h, d, q.dtype)
+        block_q = cfg["block_q"] if block_q is None else block_q
+        block_k = cfg["block_k"] if block_k is None else block_k
+        bwd_block_q = (cfg["bwd_block_q"] if bwd_block_q is None
+                       else bwd_block_q)
+        bwd_block_k = (cfg["bwd_block_k"] if bwd_block_k is None
+                       else bwd_block_k)
     dropout_rate = float(dropout_rate)
     if dropout_rate < 0.0 or dropout_rate >= 1.0:
         raise ValueError(f"dropout_rate {dropout_rate} not in [0, 1)")

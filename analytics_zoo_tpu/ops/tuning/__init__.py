@@ -1,0 +1,22 @@
+"""Kernel autotuning: block-size search with a persistent per-shape
+cache (docs/kernels.md).  See `autotuner.get_config` for the
+resolution order and the zero-recompile contract."""
+
+from analytics_zoo_tpu.ops.tuning.autotuner import (  # noqa: F401
+    CACHE_FILE_NAME,
+    DEFAULT_TABLE_PATH,
+    bucket_shape,
+    cache_info,
+    clear_memo,
+    config_source,
+    get_config,
+    make_key,
+    pow2_bucket,
+    tune,
+)
+
+__all__ = [
+    "CACHE_FILE_NAME", "DEFAULT_TABLE_PATH", "bucket_shape",
+    "cache_info", "clear_memo", "config_source", "get_config",
+    "make_key", "pow2_bucket", "tune",
+]

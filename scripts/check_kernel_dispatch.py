@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Lint: models and keras layers must route attention and LayerNorm
+through the `ops` dispatch layer.
+
+The fused Pallas kernels (flash attention, fused LayerNorm, the
+bias+GELU epilogue — docs/kernels.md) only reach a model if it goes
+through the dispatch points (`ops.attention`, `ops.pallas.flash_attention`,
+`ops.normalization.layer_norm`/`LayerNorm`, `ops.dense`): an ad-hoc
+`flax.linen.LayerNorm` or a hand-rolled scores-softmax einsum silently
+opts that model out of every kernel win AND out of the autotuner.
+This check fails the build when such a reimplementation appears under
+`analytics_zoo_tpu/models/` or `analytics_zoo_tpu/keras/layers/`:
+
+  * `nn.LayerNorm(` / `linen.LayerNorm(` / `import ... LayerNorm` —
+    use `analytics_zoo_tpu.ops.normalization.LayerNorm` (same params).
+  * the multi-head attention einsum signatures ("bqhd,bkhd" scores,
+    "bhqk,bkhd" combine) — use `ops.attention.dot_product_attention`
+    or `ops.pallas.flash_attention` (string mentions in docstrings
+    count too: the signature IS the reimplementation).
+
+Run directly (`python scripts/check_kernel_dispatch.py`) or via the
+tier-1 wrapper `tests/test_kernel_dispatch.py`.  Exit code 0 = clean.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "analytics_zoo_tpu")
+#: directories whose code must dispatch through ops/
+SCANNED_DIRS = (
+    os.path.join(PACKAGE, "models"),
+    os.path.join(PACKAGE, "keras", "layers"),
+)
+
+PATTERNS = (
+    (re.compile(r"\bnn\.LayerNorm\s*\("),
+     "use analytics_zoo_tpu.ops.normalization.LayerNorm"),
+    (re.compile(r"\blinen\.LayerNorm\s*\("),
+     "use analytics_zoo_tpu.ops.normalization.LayerNorm"),
+    (re.compile(r"from\s+flax[.\w]*\s+import\s+.*\bLayerNorm\b"),
+     "use analytics_zoo_tpu.ops.normalization.LayerNorm"),
+    (re.compile(r"bqhd,bkhd|bhqk,bkhd"),
+     "use ops.attention.dot_product_attention / "
+     "ops.pallas.flash_attention"),
+)
+
+
+def find_violations():
+    violations = []
+    for root in SCANNED_DIRS:
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path, encoding="utf-8") as f:
+                    for lineno, line in enumerate(f, 1):
+                        for pat, fix in PATTERNS:
+                            if pat.search(line):
+                                violations.append(
+                                    (os.path.relpath(path, REPO),
+                                     lineno, line.rstrip(), fix))
+    return violations
+
+
+def main() -> int:
+    violations = find_violations()
+    if not violations:
+        print("check_kernel_dispatch: clean")
+        return 0
+    print("check_kernel_dispatch: ad-hoc attention/LayerNorm "
+          "reimplementations outside the ops dispatch layer:",
+          file=sys.stderr)
+    for path, lineno, line, fix in violations:
+        print(f"  {path}:{lineno}: {line}\n      -> {fix}",
+              file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

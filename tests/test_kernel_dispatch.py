@@ -1,0 +1,48 @@
+"""Tier-1 wiring for scripts/check_kernel_dispatch.py: the build goes
+red if models/ or keras/layers/ grow an ad-hoc `nn.LayerNorm` or a
+hand-rolled attention-scores einsum instead of routing through the
+`ops` dispatch layer (which is where the fused Pallas kernels and the
+autotuner live — docs/kernels.md)."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_kernel_dispatch.py")
+
+
+def test_kernel_dispatch_clean():
+    proc = subprocess.run([sys.executable, SCRIPT],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        "ad-hoc attention/LayerNorm reimplementations crept in:\n"
+        + proc.stderr)
+
+
+def test_lint_detects_violation():
+    """Guard against the checker silently scanning the wrong tree: the
+    live tree is clean AND the patterns match the forbidden idioms."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("azt_kernel_lint",
+                                                  SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # the live tree is clean ...
+    assert mod.find_violations() == []
+
+    # ... and the patterns really match the forbidden idioms
+    def matches(line):
+        return any(pat.search(line) for pat, _fix in mod.PATTERNS)
+
+    assert matches('x = nn.LayerNorm(name="ln1")(x)')
+    assert matches("y = linen.LayerNorm()(x)")
+    assert matches("from flax.linen import LayerNorm")
+    assert matches('s = jnp.einsum("bqhd,bkhd->bhqk", q, k)')
+    assert matches('o = jnp.einsum("bhqk,bkhd->bqhd", p, v)')
+    # the sanctioned dispatch forms stay legal
+    assert not matches("x = OpsLayerNorm(name=\"ln1\")(x)")
+    assert not matches(
+        "from analytics_zoo_tpu.ops.normalization import LayerNorm")
+    assert not matches("out = dot_product_attention(q, k, v)")
