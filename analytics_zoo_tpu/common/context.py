@@ -57,6 +57,9 @@ class OrcaContextMeta(type):
     _observability_dir = None
     _kernel_tuning_mode = "off"
     _kernel_tuning_cache_dir = None
+    _goodput_sample_every = 16
+    _watchdog_deadline_s = None
+    _nonfinite_watchdog = False
 
     # --- TPU runtime state ---
     _mesh = None
@@ -198,6 +201,62 @@ class OrcaContextMeta(type):
     @observability_dir.setter
     def observability_dir(cls, value):
         cls._observability_dir = None if value is None else str(value)
+
+    @property
+    def goodput_sample_every(cls):
+        """Fence cadence of the goodput `StepClock`s
+        (observability/goodput.py): every Nth step is closed with a
+        `block_until_ready` fence so its wall time decomposes exactly
+        into compile / host-input / device-compute / blocked-collective
+        / overhead buckets.  Default 16 (≈6% of steps pay one fence);
+        1 fences every step (full accounting — what the bench's
+        buckets-sum-to-wall assertion runs)."""
+        return cls._goodput_sample_every
+
+    @goodput_sample_every.setter
+    def goodput_sample_every(cls, value):
+        if int(value) < 1:
+            raise ValueError("goodput_sample_every must be >= 1")
+        cls._goodput_sample_every = int(value)
+
+    @property
+    def watchdog_deadline_s(cls):
+        """Stall-watchdog deadline in seconds (None = off, the
+        default).  When set, `Estimator.fit` and the generation engine
+        arm a `Watchdog` (observability/watchdog.py): no step/decode
+        progress for this long → `watchdog_stall_total` increments and
+        a flight-recorder bundle (all-thread stacks, ring, metrics) is
+        written to `observability_dir`.  Size it above the slowest
+        expected dispatch — for the one-dispatch epoch-scan path the
+        heartbeat is per EPOCH, so the deadline must exceed an epoch's
+        wall time (plus the first epoch's XLA compile)."""
+        return cls._watchdog_deadline_s
+
+    @watchdog_deadline_s.setter
+    def watchdog_deadline_s(cls, value):
+        if value is not None and float(value) <= 0:
+            raise ValueError("watchdog_deadline_s must be > 0 or None")
+        cls._watchdog_deadline_s = (None if value is None
+                                    else float(value))
+
+    @property
+    def nonfinite_watchdog(cls):
+        """Opt-in nonfinite sentinel (default False).  The SPMD train
+        step always folds a cheap isfinite all-reduce over loss+grads
+        into the jitted program (its `_nan_steps` stat — detection is
+        free, it fuses into the backward pass); with the sentinel ON
+        the host CHECKS that stat per step and, on the first
+        non-finite step, runs the per-tensor localization pass
+        (`observability.localize_nonfinite`) naming the first
+        offending leaf and writes a flight-recorder bundle.  The
+        per-step check syncs the host with the device (that is its
+        cost); OFF leaves the dispatch pattern and the zero-recompile
+        guarantees byte-identical."""
+        return cls._nonfinite_watchdog
+
+    @nonfinite_watchdog.setter
+    def nonfinite_watchdog(cls, value):
+        cls._nonfinite_watchdog = bool(value)
 
     @property
     def kernel_tuning_mode(cls):

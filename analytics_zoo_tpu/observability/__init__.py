@@ -14,8 +14,9 @@ Three primitives, one substrate:
   as JSONL under `OrcaContext.observability_dir` when set.
 
 `now` is the single sanctioned wall-time clock for instrumentation
-(`time.perf_counter`); scripts/check_no_ad_hoc_timers.py keeps new
-stopwatches from sprouting outside this package.
+(the monotonic performance counter, defined once in registry.py);
+scripts/check_no_ad_hoc_timers.py keeps new stopwatches from sprouting
+anywhere else — including the rest of this package.
 """
 
 from analytics_zoo_tpu.observability.registry import (  # noqa: F401
@@ -43,11 +44,29 @@ from analytics_zoo_tpu.observability.events import (  # noqa: F401
     close_sink,
     log_event,
 )
+from analytics_zoo_tpu.observability.goodput import (  # noqa: F401
+    StepClock,
+    goodput_tables,
+    process_goodput_ratio,
+    step_clock,
+)
+from analytics_zoo_tpu.observability import (  # noqa: F401
+    flight_recorder,
+)
+from analytics_zoo_tpu.observability.watchdog import (  # noqa: F401
+    Watchdog,
+    localize_nonfinite,
+    maybe_watchdog,
+    nonfinite_leaves,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Span",
-    "annotate", "clear_spans", "close_sink", "current_span",
-    "get_registry", "log_event", "merged_prometheus_text",
-    "nearest_rank", "now", "parse_prometheus_text", "recent_spans",
-    "reset_registry", "sanitize_metric_name", "trace",
+    "StepClock", "Watchdog", "annotate", "clear_spans", "close_sink",
+    "current_span", "flight_recorder", "get_registry",
+    "goodput_tables", "localize_nonfinite", "log_event",
+    "maybe_watchdog", "merged_prometheus_text", "nearest_rank",
+    "nonfinite_leaves", "now", "parse_prometheus_text",
+    "process_goodput_ratio", "recent_spans", "reset_registry",
+    "sanitize_metric_name", "step_clock", "trace",
 ]

@@ -85,6 +85,14 @@ def log_event(kind: str, _count_metric: bool = True, **fields) -> None:
             reg.counter(
                 "events_" + sanitize_metric_name(kind) + "_total",
                 help=f"{kind} events emitted").inc()
+        if kind != "span":
+            # events also land in the flight-recorder ring so a crash
+            # bundle carries the recent history even with no JSONL sink
+            # configured (spans have their own ring — see tracing.py)
+            from analytics_zoo_tpu.observability import flight_recorder
+            flight_recorder.record(
+                "event:" + kind,
+                **{k: _jsonable(v) for k, v in fields.items()})
         directory = _configured_dir()
         if directory is None:
             return

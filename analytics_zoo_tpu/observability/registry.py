@@ -75,9 +75,16 @@ class Gauge:
     """Point-in-time value; either set/inc/dec'd, or backed by a
     callback (`fn`) sampled at read time — how live values like batcher
     queue depth and worker-pool utilization are exposed without a
-    background sampler thread."""
+    background sampler thread.
 
-    __slots__ = ("name", "help", "fn", "_lock", "_value")
+    Written (set/inc/dec) gauges additionally track the min/max value
+    ever observed (`.min`/`.max`) — what the goodput breakdown tables
+    use to report best/worst step wall time without a histogram's
+    reservoir cost.  Callback gauges report nan extremes (their reads
+    are not observed by this object)."""
+
+    __slots__ = ("name", "help", "fn", "_lock", "_value", "_min",
+                 "_max")
 
     def __init__(self, name: str, help: str = "",
                  fn: Optional[Callable[[], float]] = None):
@@ -86,17 +93,40 @@ class Gauge:
         self.fn = fn
         self._lock = threading.Lock()
         self._value = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def _observe_locked(self) -> None:
+        if self._value < self._min:
+            self._min = self._value
+        if self._value > self._max:
+            self._max = self._value
 
     def set(self, v: float) -> None:
         with self._lock:
             self._value = float(v)
+            self._observe_locked()
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
             self._value += n
+            self._observe_locked()
 
     def dec(self, n: float = 1.0) -> None:
         self.inc(-n)
+
+    @property
+    def min(self) -> float:
+        """Smallest value ever written (nan before any write)."""
+        with self._lock:
+            return self._min if self._min != math.inf else float("nan")
+
+    @property
+    def max(self) -> float:
+        """Largest value ever written (nan before any write)."""
+        with self._lock:
+            return self._max if self._max != -math.inf else \
+                float("nan")
 
     @property
     def value(self) -> float:
@@ -186,6 +216,10 @@ class _HistogramTimer:
         return self
 
     def __exit__(self, *exc):
+        # record in __exit__ UNCONDITIONALLY: a raising body must still
+        # contribute its elapsed time (a goodput table that silently
+        # dropped every failing step would overstate health) — the
+        # exception itself propagates untouched
         self._h.record(now() - self._t0)
         return False
 

@@ -36,7 +36,13 @@ class NaNLossError(RuntimeError):
     non-finite loss/gradients (the skipped steps are reported)."""
 
 from analytics_zoo_tpu.common.context import OrcaContext
-from analytics_zoo_tpu.observability import annotate, log_event, trace
+from analytics_zoo_tpu.observability import (
+    annotate,
+    flight_recorder,
+    log_event,
+    maybe_watchdog,
+    trace,
+)
 from analytics_zoo_tpu.orca.learn import losses as losses_mod
 from analytics_zoo_tpu.orca.learn import metrics as metrics_mod
 from analytics_zoo_tpu.orca.learn import optimizers as optim_mod
@@ -254,39 +260,74 @@ class Estimator:
                         if max_failures is None else max_failures)
         pending_restore = False
 
+        # flight recorder: armed (excepthook + faulthandler) for the
+        # whole fit; a fit-fatal exception below additionally writes a
+        # bundle explicitly so evidence lands even when a caller
+        # catches the exception (the excepthook only sees UNhandled
+        # ones).  Signal handlers are left to servers/drivers — a
+        # library call must not steal the process's SIGTERM.
+        flight_recorder.install(signals=False)
+        # stall watchdog (opt-in via OrcaContext.watchdog_deadline_s):
+        # heartbeats come from the engine's step loops — per dispatched
+        # step on the streaming/cached paths, per EPOCH on the
+        # one-dispatch epoch-scan path (size the deadline accordingly)
+        wd = maybe_watchdog("estimator_fit")
+        if wd is not None:
+            self._engine.watchdog = wd
+            wd.arm()
         # NOTE: no `n=ds.n` attr here — for streaming XShards input,
         # `ds.n` runs a full pass over the shards, and a shard failure
         # during it would escape the retry loop below (the epoch span
         # carries the row count once it's cheaply known)
-        with trace("estimator.fit", epochs=epochs,
-                   batch_size=batch_size):
-            while self._epoch < target_epoch:
-                try:
-                    if pending_restore:
-                        # inside the try: a still-broken checkpoint/data
-                        # source must consume retry budget, not escape
-                        # the loop
-                        self._restore_latest(start_epoch, target_epoch)
-                        pending_restore = False
-                    self._fit_one_epoch(ds, val_ds, batch_size, trigger,
-                                        shuffle, nan_policy, profile,
-                                        dds=dds)
-                except (NaNLossError, KeyboardInterrupt):
-                    raise
-                except Exception as e:
-                    if retries_left <= 0 or not self.model_dir:
+        try:
+            with trace("estimator.fit", epochs=epochs,
+                       batch_size=batch_size):
+                while self._epoch < target_epoch:
+                    try:
+                        if pending_restore:
+                            # inside the try: a still-broken checkpoint/
+                            # data source must consume retry budget, not
+                            # escape the loop
+                            self._restore_latest(start_epoch,
+                                                 target_epoch)
+                            pending_restore = False
+                        self._fit_one_epoch(ds, val_ds, batch_size,
+                                            trigger, shuffle,
+                                            nan_policy, profile,
+                                            dds=dds)
+                    except (NaNLossError, KeyboardInterrupt):
                         raise
-                    retries_left -= 1
-                    self.retries += 1
-                    log_event("fit_retry",
-                              error=f"{type(e).__name__}: {e}",
-                              retries_left=retries_left)
-                    logger.warning(
-                        "training failed (%s: %s); restoring latest "
-                        "checkpoint and retrying (%d retries left)",
-                        type(e).__name__, e, retries_left)
-                    time.sleep(OrcaContext.failure_retry_interval_s)
-                    pending_restore = True
+                    except Exception as e:
+                        if retries_left <= 0 or not self.model_dir:
+                            raise
+                        retries_left -= 1
+                        self.retries += 1
+                        flight_recorder.record(
+                            "fit_retry",
+                            error=f"{type(e).__name__}: {e}",
+                            retries_left=retries_left)
+                        log_event("fit_retry",
+                                  error=f"{type(e).__name__}: {e}",
+                                  retries_left=retries_left)
+                        logger.warning(
+                            "training failed (%s: %s); restoring latest "
+                            "checkpoint and retrying (%d retries left)",
+                            type(e).__name__, e, retries_left)
+                        time.sleep(OrcaContext.failure_retry_interval_s)
+                        pending_restore = True
+        except KeyboardInterrupt:
+            raise
+        except BaseException as e:
+            # fit is over (retries exhausted / non-retryable): leave
+            # the post-mortem bundle before the exception escapes
+            flight_recorder.dump(
+                "fit_exception", exc=e,
+                extra={"epoch": self._epoch, "retries": self.retries})
+            raise
+        finally:
+            if wd is not None:
+                wd.stop()
+                self._engine.watchdog = None
         return self
 
     def _fit_one_epoch(self, ds, val_ds, batch_size, trigger, shuffle,

@@ -35,8 +35,12 @@ from flax import struct
 from analytics_zoo_tpu.common.context import OrcaContext
 from analytics_zoo_tpu.observability import (
     annotate,
+    flight_recorder,
     get_registry,
+    localize_nonfinite,
+    log_event,
     now,
+    step_clock,
     trace,
 )
 from analytics_zoo_tpu.parallel.sharding import (
@@ -178,6 +182,16 @@ class SPMDEngine:
         #: carry `jit_cold=True` and the duration lands in the
         #: `jax_jit_compile_seconds` histogram
         self._jit_warm: set = set()
+        #: goodput step clocks (observability/goodput.py): every step
+        #: below is decomposed into compile / host-input /
+        #: device-compute / blocked-collective / overhead buckets,
+        #: fully measured at the fenced sampling cadence
+        self._clock_train = step_clock("spmd_train")
+        self._clock_eval = step_clock("spmd_eval")
+        #: optional stall watchdog (observability/watchdog.py): when an
+        #: owner (Estimator.fit) assigns one, the step loops below feed
+        #: it a heartbeat per dispatched step / per epoch program
+        self.watchdog = None
 
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
         self._eval_step = jax.jit(self._eval_step_impl)
@@ -448,16 +462,25 @@ class SPMDEngine:
         per epoch."""
         self._annotate_mesh()
         data = dds.data
+        clock = self._clock_train if train else self._clock_eval
+        sentinel = train and OrcaContext.nonfinite_watchdog
         if shuffle:
             rng = jax.random.fold_in(jax.random.PRNGKey(seed), epoch)
             data = self._shuffle_cached(data, rng)
-        if on_step is None and not profile:
+        if on_step is None and not profile and not sentinel:
             # fast path: the whole epoch is ONE dispatched program,
             # unguarded; on a detected non-finite step, replay the epoch
             # from its start state with the guarded program (see the
-            # epoch-program comment in __init__)
+            # epoch-program comment in __init__).  The nonfinite
+            # sentinel needs per-step stats to name the offending step,
+            # so sentinel mode takes the per-step loop below instead.
             self.last_profile = []
             unroll = self._epoch_unroll(dds.steps)
+            # goodput: the whole epoch is one "step" of the clock,
+            # always fenced (the totals fetch is a natural fence)
+            rec = clock.begin(force_fence=True)
+            key = ("epoch_scan", train, unroll)
+            rec.cold = key not in self._jit_warm
             with trace("spmd.epoch_scan", steps=dds.steps, train=train,
                        unroll=unroll):
                 if train:
@@ -465,7 +488,10 @@ class SPMDEngine:
                     self.state, totals = self._train_epoch_scan(
                         start_state, data, unroll, False)
                     self.host_step += dds.steps
+                    rec.lap("compile" if rec.cold else None)
+                    self._jit_warm.add(key)
                     out = self._fetch_totals(totals)
+                    rec.lap("device_compute")
                     if out.get("nan_steps"):
                         # restore first: if the replay itself fails
                         # (compile error, RPC loss), self.state must not
@@ -473,13 +499,29 @@ class SPMDEngine:
                         # and the epoch program never donates, so
                         # start_state stays valid through a
                         # mid-execution replay failure too
+                        flight_recorder.record(
+                            "epoch_nan_replay",
+                            nan_steps=out["nan_steps"])
                         self.state = start_state
                         self.state, totals = self._train_epoch_scan(
                             start_state, data, unroll, True)
                         out = self._fetch_totals(totals)
-                    return out
-                totals = self._eval_epoch_scan(self.state, data, unroll)
-                return self._fetch_totals(totals)
+                        rec.lap("device_compute")
+                else:
+                    totals = self._eval_epoch_scan(self.state, data,
+                                                   unroll)
+                    rec.lap("compile" if rec.cold else None)
+                    self._jit_warm.add(key)
+                    out = self._fetch_totals(totals)
+                    rec.lap("device_compute")
+            flight_recorder.record("spmd_epoch_scan", train=train,
+                                   steps=dds.steps)
+            if self.watchdog is not None:
+                # one dispatch per epoch = one heartbeat per epoch: the
+                # stall deadline must exceed the epoch wall time here
+                self.watchdog.beat()
+            rec.end()
+            return out
         totals = None
         step = self.host_step if train else 0
         self.last_profile = []
@@ -487,7 +529,9 @@ class SPMDEngine:
                    else self._eval_step_cached)
         kind = "train_cached" if train else "eval_cached"
         for i in range(dds.steps):
+            rec = clock.begin(force_fence=profile or sentinel)
             t0 = now() if profile else 0.0
+            rec.cold = kind not in self._jit_warm
             with self._step_span(kind, step + 1 if train else step,
                                  train):
                 if train:
@@ -495,16 +539,27 @@ class SPMDEngine:
                     step += 1
                 else:
                     stats = step_fn(self.state, data, i)
-            if profile:
+            rec.lap("compile" if rec.cold else None)
+            if rec.fenced:
                 jax.block_until_ready(stats["_count"])
+                rec.lap("device_compute")
+            if profile:
                 self.last_profile.append(
                     {"step": step,
                      "step_time_s": now() - t0})
+            if sentinel:
+                self._sentinel_check(
+                    stats,
+                    jax.tree_util.tree_map(lambda a: a[i], data), step)
             if totals is None:
                 totals = jax.tree_util.tree_map(jnp.zeros_like, stats)
             totals = self._accum(totals, stats)
+            flight_recorder.record("spmd_step", loop=kind, step=step)
+            if self.watchdog is not None:
+                self.watchdog.beat()
             if train and on_step is not None:
                 on_step(step)
+            rec.end()
         if train:
             self.host_step = step
         if totals is None:
@@ -556,6 +611,68 @@ class SPMDEngine:
                 help="wall time of first (compiling) jit dispatches",
             ).record(sp.duration_s)
 
+    # ------------------------------------------------------------------
+    # nonfinite sentinel (opt-in: OrcaContext.nonfinite_watchdog)
+    # ------------------------------------------------------------------
+
+    def _sentinel_check(self, stats, batch, step: int) -> None:
+        """Read the step's on-device nonfinite detection stat (the
+        isfinite all-reduce that is ALWAYS part of the jitted step —
+        this host read is the sentinel's only added cost) and, on trip,
+        localize + flight-record.  One bundle per offending step."""
+        if float(stats["_nan_steps"]) == 0.0:
+            return
+        found = self.localize_step_nonfinite(batch)
+        get_registry().counter(
+            "nonfinite_steps_total",
+            help="training steps the nonfinite sentinel tripped on"
+        ).inc()
+        paths = [f["path"] for f in found]
+        flight_recorder.record("nonfinite_step", step=step,
+                               leaves=paths)
+        log_event("nonfinite_step", step=step, leaves=found)
+        flight_recorder.dump("nonfinite_step",
+                             extra={"step": step, "leaves": found})
+
+    def localize_step_nonfinite(self, batch) -> List[Dict[str, Any]]:
+        """Host-side per-tensor localization pass: recompute the
+        forward/loss/grads for `batch` EAGERLY from the current state
+        (the on-device guard preserved the pre-step params, so the
+        recomputation reproduces the offending values) and name the
+        nonfinite leaves in order across params → predictions →
+        per-example loss → loss → grads.  The first entry is "the
+        first nonfinite leaf" — the tensor to stare at."""
+        state = self.state
+        rng = jax.random.fold_in(state.rng,
+                                 jnp.maximum(state.step - 1, 0))
+
+        def loss_of(params):
+            preds, _ = self._forward(params, state.model_state,
+                                     batch["features"], rng, True,
+                                     mask=batch["mask"])
+            preds, aux = self._split_aux(preds, batch["mask"])
+            per_ex = self._per_example_loss(preds, batch["labels"],
+                                            batch["mask"])
+            loss = masked_mean(per_ex, batch["mask"])
+            if aux is not None:
+                loss = loss + self.aux_loss_weight * aux
+            return loss, (preds, per_ex)
+
+        try:
+            (loss, (preds, per_ex)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(state.params)
+            trees = {
+                "params": state.params,
+                "predictions": preds,
+                "per_example_loss": per_ex,
+                "loss": loss,
+                "grads": grads,
+            }
+        except Exception as e:  # localization must not mask the event
+            return [{"path": "<localization failed: "
+                             f"{type(e).__name__}: {e}>"}]
+        return localize_nonfinite(trees)
+
     def run_epoch(self, batch_iter, train: bool = True,
                   on_step: Optional[Callable[[int], None]] = None,
                   profile: bool = False) -> Dict[str, float]:
@@ -568,7 +685,11 @@ class SPMDEngine:
         asynchronously) and fetched once at the end of the epoch, and input
         batches are staged onto devices `depth` ahead on this same thread
         (see `_prefetch`) — so the accelerator pipeline stays full
-        (VERDICT r1 weak #2).
+        (VERDICT r1 weak #2).  Exceptions: every
+        `OrcaContext.goodput_sample_every`-th step is closed with a
+        `block_until_ready` fence so the goodput clock can decompose it
+        (profile=True fences every step, as before), and the opt-in
+        nonfinite sentinel syncs per step to read the detection stat.
         """
         self._annotate_mesh()
         totals = None
@@ -577,8 +698,20 @@ class SPMDEngine:
         step = self.host_step if train else 0
         self.last_profile = []
         kind = "train" if train else "eval"
-        for batch in self._prefetch(batch_iter):
+        clock = self._clock_train if train else self._clock_eval
+        sentinel = train and OrcaContext.nonfinite_watchdog
+        it = iter(self._prefetch(batch_iter))
+        while True:
+            rec = clock.begin(force_fence=profile or sentinel)
+            try:
+                # pulling the next staged batch IS the host-input cost
+                # (HostDataset assembly + async device_put)
+                batch = next(it)
+            except StopIteration:
+                break
+            rec.lap("host_input")
             t0 = now() if profile else 0.0
+            rec.cold = kind not in self._jit_warm
             with self._step_span(kind, step + 1 if train else step,
                                  train):
                 if train:
@@ -587,19 +720,29 @@ class SPMDEngine:
                     step += 1
                 else:
                     stats = self._eval_step(self.state, batch)
-            if profile:
-                # opt-in: blocking per step defeats async dispatch, but
-                # gives true per-step wall time (reference torch_runner
-                # profile=True semantics)
+            rec.lap("compile" if rec.cold else None)
+            if rec.fenced:
+                # opt-in / sampled: blocking per step defeats async
+                # dispatch, but gives true per-step wall time
+                # (reference torch_runner profile=True semantics) and
+                # the goodput device bucket
                 jax.block_until_ready(stats["_count"])
+                rec.lap("device_compute")
+            if profile:
                 self.last_profile.append(
                     {"step": step,
                      "step_time_s": now() - t0})
+            if sentinel:
+                self._sentinel_check(stats, batch, step)
             if totals is None:
                 totals = jax.tree_util.tree_map(jnp.zeros_like, stats)
             totals = self._accum(totals, stats)
+            flight_recorder.record("spmd_step", loop=kind, step=step)
+            if self.watchdog is not None:
+                self.watchdog.beat()
             if train and on_step is not None:
                 on_step(step)
+            rec.end()
         if train:
             self.host_step = step
         if totals is None:

@@ -32,7 +32,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from analytics_zoo_tpu.observability import get_registry, log_event, now
+from analytics_zoo_tpu.observability import (
+    flight_recorder,
+    get_registry,
+    log_event,
+    maybe_watchdog,
+    now,
+    step_clock,
+)
 from analytics_zoo_tpu.serving.generation.kv_cache import PagedKVCache
 from analytics_zoo_tpu.serving.generation.sampling import sample_tokens
 from analytics_zoo_tpu.serving.generation.scheduler import (
@@ -154,6 +161,19 @@ class GenerationEngine:
         reg.gauge("generation_preemptions",
                   fn=lambda: self.scheduler.n_preemptions,
                   help="sequences preempted under cache pressure")
+        #: goodput decomposition of the two hot loops.  Both fence
+        #: naturally (prefill fetches the sampled token, decode fetches
+        #: the token vector), so every iteration is fully accounted
+        self._clock_prefill = step_clock("generation_prefill")
+        self._clock_decode = step_clock("generation_decode")
+        #: stall watchdog (opt-in via OrcaContext.watchdog_deadline_s):
+        #: armed while the engine has work, beaten once per scheduling
+        #: round — a wedged decode dispatch dumps a flight bundle
+        self.watchdog = maybe_watchdog("generation")
+        #: which compiled entry points have dispatched at least once —
+        #: a cold dispatch's wall time lands in the goodput "compile"
+        #: bucket instead of polluting warm decode latency
+        self._goodput_warm: set = set()
 
         self._build_steps()
 
@@ -241,6 +261,10 @@ class GenerationEngine:
                 jnp.zeros((S, MB), jnp.int32), jnp.zeros(S, jnp.int32),
                 jnp.zeros(S, bool), jnp.zeros(S, jnp.float32),
                 jnp.zeros(S, jnp.int32), self._rng)
+            # everything above compiled here: live traffic is warm
+            self._goodput_warm.add("decode")
+            self._goodput_warm.update(
+                ("prefill", b) for b in self.scheduler.prefill_buckets)
 
     # ------------------------------------------------------------------
     # request intake
@@ -305,6 +329,7 @@ class GenerationEngine:
             self._finish(seq, reason)
 
     def _prefill_seq(self, seq: Sequence) -> None:
+        rec = self._clock_prefill.begin(force_fence=True)
         ctx = seq.prompt + seq.generated
         L = len(ctx)
         bucket = self.scheduler.bucket_for(L)
@@ -313,18 +338,25 @@ class GenerationEngine:
         tokens[0, :L] = ctx
         table = np.zeros(MB, np.int32)
         table[:len(seq.block_table)] = seq.block_table
+        rec.lap("host_input")
         t0 = now()
+        rec.cold = ("prefill", bucket) not in self._goodput_warm
         self.cache.kv, nxt, _ = self._prefill_jit(
             self.params, self.cache.kv, jnp.asarray(tokens),
             jnp.int32(L), jnp.asarray(table),
             jnp.full(1, seq.temperature, jnp.float32),
             jnp.full(1, seq.top_k, jnp.int32), self._next_rng())
-        nxt = int(nxt)
+        rec.lap(None)
+        nxt = int(nxt)            # token fetch = device fence
+        rec.lap("device_compute")
+        self._goodput_warm.add(("prefill", bucket))
         self._h_prefill.record(now() - t0, L)
         self._c_prefill_tokens.inc(L)
         self._emit(seq, nxt)
+        rec.end()
 
     def _decode_all(self) -> None:
+        rec = self._clock_decode.begin(force_fence=True)
         S = self.max_slots
         MB = self.scheduler.max_blocks_per_seq
         tokens = np.zeros(S, np.int32)
@@ -344,16 +376,22 @@ class GenerationEngine:
             active[i] = True
             temp[i] = seq.temperature
             top_k[i] = seq.top_k
+        rec.lap("host_input")
         t0 = now()
+        rec.cold = "decode" not in self._goodput_warm
         self.cache.kv, nxt, _ = self._decode_jit(
             self.params, self.cache.kv, jnp.asarray(tokens),
             jnp.asarray(tables), jnp.asarray(ctx_len),
             jnp.asarray(active), jnp.asarray(temp),
             jnp.asarray(top_k), self._next_rng())
-        nxt = np.asarray(nxt)
+        rec.lap(None)
+        nxt = np.asarray(nxt)     # token fetch = device fence
+        rec.lap("device_compute")
+        self._goodput_warm.add("decode")
         self._h_decode.record(now() - t0, len(lanes))
         for i, seq in lanes.items():
             self._emit(seq, nxt[i])
+        rec.end()
 
     def step(self) -> bool:
         """One scheduling round: admit (prefill) → grow/preempt for
@@ -368,17 +406,28 @@ class GenerationEngine:
             if self.scheduler.running():
                 self._decode_all()
                 did = True
+            if self.watchdog is not None:
+                self.watchdog.beat()
             return did
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:
-        for _ in range(max_steps):
-            if not self.scheduler.has_work():
-                return
-            if not self.step():
-                raise RuntimeError(
-                    "generation engine stuck: waiting requests but no "
-                    "schedulable work (block pool too small?)")
-        raise RuntimeError(f"still busy after {max_steps} steps")
+        if self.watchdog is not None:
+            self.watchdog.arm()
+        try:
+            for _ in range(max_steps):
+                if not self.scheduler.has_work():
+                    return
+                if not self.step():
+                    flight_recorder.dump(
+                        "generation_stuck",
+                        extra={"waiting": len(self.scheduler.waiting)})
+                    raise RuntimeError(
+                        "generation engine stuck: waiting requests but "
+                        "no schedulable work (block pool too small?)")
+            raise RuntimeError(f"still busy after {max_steps} steps")
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.disarm()
 
     # ------------------------------------------------------------------
     # background serving
@@ -395,14 +444,20 @@ class GenerationEngine:
     def _loop(self) -> None:
         while not self._stop.is_set():
             if not self.scheduler.has_work():
+                if self.watchdog is not None:
+                    # idle is not a stall: disarm until work arrives
+                    self.watchdog.disarm()
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            if self.watchdog is not None:
+                self.watchdog.arm()
             try:
                 self.step()
             except Exception as e:   # fail loudly but keep serving
                 log_event("generation_step_error",
                           error=f"{type(e).__name__}: {e}")
+                flight_recorder.dump("generation_step_error", exc=e)
                 with self._lock:
                     for seq in list(self.scheduler.running()):
                         self._finish(seq, f"error: {e}")
@@ -410,6 +465,8 @@ class GenerationEngine:
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -24,6 +24,7 @@ import itertools
 from collections import deque
 from typing import Deque, List, Optional
 
+from analytics_zoo_tpu.observability import flight_recorder
 from analytics_zoo_tpu.serving.generation.kv_cache import PagedKVCache
 
 _UIDS = itertools.count()
@@ -121,6 +122,12 @@ class SlotScheduler:
         if not victims:
             return None
         victim = max(victims, key=lambda s: s._admit_order)
+        # per-lane decision trail for the flight recorder: a post-
+        # mortem shows WHY lanes emptied under cache pressure
+        flight_recorder.record("sched_preempt", uid=victim.uid,
+                               slot=victim.slot,
+                               blocks_freed=len(victim.block_table),
+                               context_len=victim.context_len)
         self.cache.allocator.free(victim.block_table)
         victim.block_table = []
         self.slots[victim.slot] = None
@@ -181,6 +188,10 @@ class SlotScheduler:
             self.slots[seq.slot] = seq
             budget -= bucket
             admitted.append(seq)
+            flight_recorder.record("sched_admit", uid=seq.uid,
+                                   slot=seq.slot, bucket=bucket,
+                                   blocks=len(blocks),
+                                   resumed=seq.n_preempted > 0)
         return admitted
 
     def release(self, seq: Sequence, reason: str) -> None:
@@ -194,3 +205,6 @@ class SlotScheduler:
             seq.slot = None
         seq.status = "finished"
         seq.finish_reason = reason
+        flight_recorder.record("sched_release", uid=seq.uid,
+                               reason=reason,
+                               generated=len(seq.generated))

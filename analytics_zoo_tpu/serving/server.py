@@ -36,9 +36,13 @@ Endpoints:
                    (training spans, FL rounds, ...)
   GET  /spans    — JSON dump of the most recent N completed spans
                    (?n=, default 100), newest first
+  GET  /goodput  — JSON step-time-breakdown tables from the goodput
+                   StepClocks (compile / host-input / device-compute /
+                   blocked-collective / overhead per hot loop) plus the
+                   process goodput ratio
   GET  /stats    — JSON operational snapshot: records_served, batcher
                    queue depth, worker-pool utilization, per-op timer
-                   summaries
+                   summaries, process goodput ratio
 """
 
 from __future__ import annotations
@@ -55,10 +59,13 @@ import numpy as np
 from analytics_zoo_tpu.observability import (
     MetricsRegistry,
     current_span,
+    flight_recorder,
     get_registry,
+    goodput_tables,
     log_event,
     merged_prometheus_text,
     now,
+    process_goodput_ratio,
     recent_spans,
     trace,
 )
@@ -207,6 +214,14 @@ class ServingServer:
                                                   get_registry())
                     self._body(200, text.encode(),
                                "text/plain; version=0.0.4")
+                    return
+                if self.path.startswith("/goodput"):
+                    # step-time breakdown tables: where every hot
+                    # loop's wall-clock went (observability/goodput.py)
+                    self._json(200, {
+                        "goodput_ratio": round(process_goodput_ratio(),
+                                               4),
+                        "clocks": goodput_tables()})
                     return
                 if self.path.startswith("/spans"):
                     n = 100
@@ -545,6 +560,7 @@ class ServingServer:
             "replicas": (self.worker_pool.n_workers
                          if self.worker_pool else 1),
             "timers": self.timer.summary(),
+            "goodput_ratio": round(process_goodput_ratio(), 4),
         }
         if self.worker_pool is not None:
             out["worker_pool"] = {
@@ -572,6 +588,10 @@ class ServingServer:
         """Start the dynamic batcher (always) and, with `http=True`, the
         HTTP ingress.  `http=False` runs batcher-only — for deployments
         where another frontend (gRPC) is the sole ingress."""
+        # arm the flight recorder for the serving process: unhandled
+        # exceptions and (when this is the main thread) SIGTERM leave a
+        # post-mortem bundle under OrcaContext.observability_dir
+        flight_recorder.install()
         t1 = threading.Thread(target=self._batcher, daemon=True)
         t1.start()
         self._threads = [t1]

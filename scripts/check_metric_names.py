@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Lint: every literally-named registry metric is Prometheus-legal AND
+documented in docs/observability.md.
+
+The metrics registry sanitizes names at registration, so an illegal
+name silently mutates instead of failing — which means a dashboard
+scraping the documented name would silently read nothing.  And a
+metric that exists but is absent from docs/observability.md's metric
+index is unfindable by the operator the observability layer exists
+for.  This check closes both gaps statically:
+
+* scan `analytics_zoo_tpu/` (plus `bench.py`) for
+  ``.counter("name")`` / ``.gauge("name")`` / ``.histogram("name")``
+  registrations whose first argument is a PLAIN string literal
+  (f-strings and concatenations — the `span_<name>_seconds` /
+  `events_<kind>_total` / `goodput_<clock>_<bucket>` families — are
+  matched up to their literal prefix);
+* each captured name must match the Prometheus metric-name grammar
+  ``[a-zA-Z_:][a-zA-Z0-9_:]*``;
+* each captured name (or family prefix) must appear verbatim in
+  docs/observability.md.
+
+Run directly (`python scripts/check_metric_names.py`) or via the
+tier-1 wrapper `tests/test_metric_names.py`.  Exit code 0 = clean.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "analytics_zoo_tpu")
+DOCS = os.path.join(REPO, "docs", "observability.md")
+EXTRA_FILES = (os.path.join(REPO, "bench.py"),)
+
+#: `.counter("…")`, `.gauge('…')`, `.histogram("…")` with a plain
+#: string literal (no f/r/b prefix — constructed names are matched by
+#: their literal prefix via the same pattern when they start with one)
+PATTERN = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*[\"']([A-Za-z0-9_:]+)[\"']")
+
+PROM_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _source_files():
+    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+    yield from EXTRA_FILES
+
+
+def find_violations():
+    with open(DOCS, encoding="utf-8") as f:
+        docs_text = f.read()
+    violations = []
+    for path in _source_files():
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for m in PATTERN.finditer(text):
+            name = m.group(1)
+            lineno = text.count("\n", 0, m.start()) + 1
+            rel = os.path.relpath(path, REPO)
+            if not PROM_NAME.match(name):
+                violations.append(
+                    (rel, lineno, name,
+                     "not a legal Prometheus metric name"))
+            elif name not in docs_text:
+                violations.append(
+                    (rel, lineno, name,
+                     "missing from docs/observability.md's metric "
+                     "index"))
+    return violations
+
+
+def main() -> int:
+    violations = find_violations()
+    if not violations:
+        print("check_metric_names: clean")
+        return 0
+    print("check_metric_names: undocumented or illegal registry "
+          "metric names:", file=sys.stderr)
+    for path, lineno, name, why in violations:
+        print(f"  {path}:{lineno}: {name!r} — {why}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

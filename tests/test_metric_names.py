@@ -1,0 +1,41 @@
+"""Tier-1 wiring for scripts/check_metric_names.py: the build goes red
+if a registry metric is registered under a name that is not legal
+Prometheus or is missing from docs/observability.md's metric index."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_metric_names.py")
+
+
+def test_metric_names_documented():
+    proc = subprocess.run([sys.executable, SCRIPT],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        "undocumented or illegal metric names crept in:\n"
+        + proc.stderr)
+
+
+def test_lint_detects_violation():
+    """Guard against the checker silently scanning the wrong tree: the
+    live tree is clean AND the pattern matches the idioms it must."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("azt_metric_lint",
+                                                  SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.find_violations() == []
+    # registration idioms the pattern must catch ...
+    assert mod.PATTERN.search('reg.counter("requests_total")')
+    assert mod.PATTERN.search("reg.gauge('depth', help='x')")
+    assert mod.PATTERN.search(
+        'self._reg.histogram(\n    "lat_seconds")')
+    # ... and the ones it must not (f-strings resolve at runtime; the
+    # goodput family is documented by its literal prefix instead)
+    assert not mod.PATTERN.search('reg.counter(f"goodput_{n}_total")')
+    # the Prometheus grammar rejects what the registry would sanitize
+    assert not mod.PROM_NAME.match("9leading_digit")
+    assert mod.PROM_NAME.match("a_ok:name")

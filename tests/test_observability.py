@@ -108,6 +108,72 @@ def test_timer_timing_context_manager():
     assert row["max_ms"] >= 0
 
 
+def test_histogram_time_records_on_exception():
+    """Regression (PR 4 satellite): a raising body must still
+    contribute its elapsed time — a table that silently dropped every
+    failing step would overstate health."""
+    h = Histogram("h")
+    with pytest.raises(RuntimeError):
+        with h.time():
+            raise RuntimeError("body died")
+    assert h.calls == 1 and h.records == 1
+    assert h.total > 0
+    # and the exception itself propagated untouched (not swallowed)
+    with h.time():
+        pass
+    assert h.calls == 2
+
+
+def test_gauge_min_max_tracking():
+    """Written gauges track the extremes ever observed (what the
+    goodput tables use for best/worst step); callback gauges do not
+    (their reads are not observed)."""
+    reg = MetricsRegistry()
+    g = reg.gauge("step_s")
+    import math
+    assert math.isnan(g.min) and math.isnan(g.max)   # before any write
+    g.set(3.0)
+    g.set(0.5)
+    g.set(9.0)
+    g.inc(1.0)           # 10.0
+    g.dec(4.0)           # 6.0
+    assert g.min == 0.5
+    assert g.max == 10.0
+    assert g.value == 6.0
+    live = reg.gauge("cb", fn=lambda: 42)
+    assert live.value == 42
+    assert math.isnan(live.min) and math.isnan(live.max)
+
+
+def test_step_clock_partition_invariant():
+    """Fenced bucket totals sum to the fenced wall by construction —
+    the invariant bench.py's 5% assertion gates on."""
+    from analytics_zoo_tpu.observability.goodput import StepClock
+    clock = StepClock("unit_clock", registry=MetricsRegistry())
+    for fence in (True, True, False):
+        rec = clock.begin(force_fence=fence)
+        rec.lap("host_input")
+        rec.lap(None)
+        if rec.fenced:
+            rec.lap("device_compute")
+        rec.end()
+    t = clock.table()
+    assert t["fenced_steps"] == 2
+    # the exact partition lives on the unrounded clock state; the
+    # table's values are rounded to 1e-6 s, so its sum only matches to
+    # rounding granularity (these steps are only microseconds long)
+    assert sum(clock.buckets.values()) == pytest.approx(
+        clock.fenced_wall_s, rel=1e-9, abs=1e-12)
+    assert sum(t["buckets_s"].values()) == pytest.approx(
+        t["fenced_wall_s"], abs=len(t["buckets_s"]) * 1e-6)
+    # a cold step's device wait folds into the compile bucket
+    rec = clock.begin(force_fence=True)
+    rec.cold = True
+    rec.lap("device_compute")
+    rec.end()
+    assert clock.buckets["compile"] > 0
+
+
 def test_prometheus_text_roundtrip():
     reg = MetricsRegistry()
     reg.counter("requests_total", help="reqs").inc(7)
@@ -365,6 +431,22 @@ def test_spans_endpoint_and_cross_thread_batch_parent(obs_server):
     assert run["thread"] != parents[run["parent_id"]]["thread"]
     assert run["trace_id"] == parents[run["parent_id"]]["trace_id"]
     assert run["attrs"]["records"] >= 2
+
+
+def test_goodput_endpoint(obs_server):
+    """GET /goodput serves the step-time breakdown tables; the spmd
+    clocks exist process-wide once any engine ran (other tests in this
+    session), so assert shape not specific clocks."""
+    payload = json.loads(_get(obs_server, "/goodput"))
+    assert "goodput_ratio" in payload
+    for name, table in payload["clocks"].items():
+        assert set(table["buckets_s"]) == {
+            "compile", "host_input", "device_compute",
+            "blocked_collective", "overhead"}, name
+        assert table["steps"] >= table["fenced_steps"] >= 0
+    # the aggregate gauge rides /metrics too
+    parsed = parse_prometheus_text(_get(obs_server, "/metrics"))
+    assert "goodput_ratio" in parsed
 
 
 def test_http_404_counted(obs_server):

@@ -16,6 +16,25 @@ from analytics_zoo_tpu.parallel.pipeline import (
 )
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _no_persistent_compile_cache():
+    """The pp-schedule shard_map programs here must NOT go through the
+    persistent XLA compile cache: the dp x pp x fsdp pipelined-BERT
+    executables do not survive serialization on XLA:CPU — a RELOADED
+    executable computes garbage (NaN loss, or wrong-but-finite values
+    that vary run to run) while fresh in-process compiles are
+    deterministic and correct.  Bisected in PR 4 with a fresh cache
+    dir: run 1 (compiles, persists) is clean; runs 2..N (load the
+    just-persisted entries) go NaN / wrong — the long-standing
+    `test_pipeline_fsdp_composition` "NaN flake" was exactly this,
+    appearing and disappearing with the warmth of `.jax_cache_tests`.
+    See BASELINE.md for the full ledger."""
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
 @pytest.fixture()
 def pp_mesh():
     stop_orca_context()
