@@ -60,6 +60,9 @@ class OrcaContextMeta(type):
     _goodput_sample_every = 16
     _watchdog_deadline_s = None
     _nonfinite_watchdog = False
+    _slo_targets = None
+    _request_log_size = 256
+    _memory_sample_interval_s = 1.0
 
     # --- TPU runtime state ---
     _mesh = None
@@ -257,6 +260,69 @@ class OrcaContextMeta(type):
     @nonfinite_watchdog.setter
     def nonfinite_watchdog(cls, value):
         cls._nonfinite_watchdog = bool(value)
+
+    @property
+    def slo_targets(cls):
+        """Per-request latency SLO targets (observability/slo.py) as a
+        dict over {"ttft_s", "tpot_s", "queue_wait_s", "e2e_s"} —
+        seconds each; any subset may be set.  Every finished generation
+        request is judged against the configured dimensions:
+        violations count in ``slo_violation_total`` (and the per-
+        dimension ``slo_violation_<dim>_total`` family), and the
+        rolling-window attainment rides the ``slo_attainment_ratio``
+        gauge and GET /slo.  None (default) disables SLO judging —
+        request latency histograms are recorded regardless."""
+        return cls._slo_targets
+
+    @slo_targets.setter
+    def slo_targets(cls, value):
+        if value is None:
+            cls._slo_targets = None
+            return
+        from analytics_zoo_tpu.observability.slo import SLO_DIMENSIONS
+        targets = {}
+        for k, v in dict(value).items():
+            if k not in SLO_DIMENSIONS:
+                raise ValueError(
+                    f"unknown SLO dimension {k!r}; valid: "
+                    f"{SLO_DIMENSIONS}")
+            if float(v) <= 0:
+                raise ValueError(f"SLO target {k} must be > 0")
+            targets[k] = float(v)
+        cls._slo_targets = targets
+
+    @property
+    def request_log_size(cls):
+        """Capacity of the per-request lifecycle log's finished-request
+        ring (observability/request_log.py).  Read when the process
+        log is first created (`reset_request_log()` re-reads it);
+        active requests are tracked regardless of the ring size."""
+        return cls._request_log_size
+
+    @request_log_size.setter
+    def request_log_size(cls, value):
+        if int(value) < 1:
+            raise ValueError("request_log_size must be >= 1")
+        cls._request_log_size = int(value)
+
+    @property
+    def memory_sample_interval_s(cls):
+        """Minimum seconds between memory-telemetry samples
+        (observability/memory.py: host RSS, jax live-buffer bytes,
+        registered pool providers).  Samples are taken opportunistically
+        from fenced goodput steps and forced by GET /timeline; the
+        interval bounds the cost of the `jax.live_arrays()` walk.
+        None disables opportunistic sampling (forced samples still
+        work)."""
+        return cls._memory_sample_interval_s
+
+    @memory_sample_interval_s.setter
+    def memory_sample_interval_s(cls, value):
+        if value is not None and float(value) < 0:
+            raise ValueError(
+                "memory_sample_interval_s must be >= 0 or None")
+        cls._memory_sample_interval_s = (None if value is None
+                                         else float(value))
 
     @property
     def kernel_tuning_mode(cls):

@@ -13,6 +13,13 @@ Three primitives, one substrate:
 * `log_event(kind, **fields)` — countable structured events, appended
   as JSONL under `OrcaContext.observability_dir` when set.
 
+Built on that substrate: goodput step accounting (goodput.py), the
+flight recorder + watchdogs (flight_recorder.py, watchdog.py), the
+per-request lifecycle log with TTFT/TPOT/queue-wait/e2e derivation
+(request_log.py), SLO tracking (slo.py), memory telemetry
+(memory.py), and the Perfetto-loadable Chrome-trace timeline export
+merging all of it onto one clock (timeline.py).
+
 `now` is the single sanctioned wall-time clock for instrumentation
 (the monotonic performance counter, defined once in registry.py);
 scripts/check_no_ad_hoc_timers.py keeps new stopwatches from sprouting
@@ -52,6 +59,23 @@ from analytics_zoo_tpu.observability.goodput import (  # noqa: F401
 )
 from analytics_zoo_tpu.observability import (  # noqa: F401
     flight_recorder,
+    memory,
+    request_log,
+    timeline,
+)
+from analytics_zoo_tpu.observability.request_log import (  # noqa: F401
+    RequestLog,
+    get_request_log,
+    new_request_id,
+    reset_request_log,
+)
+from analytics_zoo_tpu.observability.slo import (  # noqa: F401
+    SLOTracker,
+    get_slo_tracker,
+    reset_slo_tracker,
+)
+from analytics_zoo_tpu.observability.timeline import (  # noqa: F401
+    export_timeline,
 )
 from analytics_zoo_tpu.observability.watchdog import (  # noqa: F401
     Watchdog,
@@ -61,12 +85,15 @@ from analytics_zoo_tpu.observability.watchdog import (  # noqa: F401
 )
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry", "Span",
-    "StepClock", "Watchdog", "annotate", "clear_spans", "close_sink",
-    "current_span", "flight_recorder", "get_registry",
-    "goodput_tables", "localize_nonfinite", "log_event",
-    "maybe_watchdog", "merged_prometheus_text", "nearest_rank",
-    "nonfinite_leaves", "now", "parse_prometheus_text",
-    "process_goodput_ratio", "recent_spans", "reset_registry",
-    "sanitize_metric_name", "step_clock", "trace",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "RequestLog",
+    "SLOTracker", "Span", "StepClock", "Watchdog", "annotate",
+    "clear_spans", "close_sink", "current_span", "export_timeline",
+    "flight_recorder", "get_registry", "get_request_log",
+    "get_slo_tracker", "goodput_tables", "localize_nonfinite",
+    "log_event", "maybe_watchdog", "memory", "merged_prometheus_text",
+    "nearest_rank", "new_request_id", "nonfinite_leaves", "now",
+    "parse_prometheus_text", "process_goodput_ratio", "recent_spans",
+    "request_log", "reset_registry", "reset_request_log",
+    "reset_slo_tracker", "sanitize_metric_name", "step_clock",
+    "timeline", "trace",
 ]

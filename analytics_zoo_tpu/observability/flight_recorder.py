@@ -161,6 +161,7 @@ def dump(reason: str, extra: Optional[Dict[str, Any]] = None,
             "spans": recent_spans(100),
             "metrics": get_registry().snapshot(),
             "goodput": _goodput_tables_safe(),
+            "memory": _memory_snapshot_safe(),
             "thread_stacks": _thread_stacks(),
         }
         if exc is not None:
@@ -172,10 +173,23 @@ def dump(reason: str, extra: Optional[Dict[str, Any]] = None,
             }
         if extra:
             bundle["extra"] = extra
-        bundle = _redact(_jsonable(bundle))
-        path = os.path.join(
+        stem = os.path.join(
             directory,
-            f"flight_{int(time.time() * 1e3)}_{os.getpid()}.json")
+            f"flight_{int(time.time() * 1e3)}_{os.getpid()}")
+        # Perfetto-loadable sibling: the merged timeline (requests,
+        # goodput slices, ring, memory track) around the moment of
+        # death — written FIRST so the bundle only references a trace
+        # that actually exists
+        trace_path = None
+        try:
+            from analytics_zoo_tpu.observability import memory, timeline
+            memory.maybe_sample(force=True)
+            trace_path = timeline.write_timeline(stem + ".trace.json")
+        except Exception:
+            trace_path = None
+        bundle["timeline_path"] = trace_path
+        bundle = _redact(_jsonable(bundle))
+        path = stem + ".json"
         with open(path, "w", encoding="utf-8") as f:
             json.dump(bundle, f, indent=1)
         return path
@@ -191,6 +205,14 @@ def _goodput_tables_safe() -> Dict[str, Any]:
         return {}
 
 
+def _memory_snapshot_safe() -> Dict[str, Any]:
+    try:
+        from analytics_zoo_tpu.observability import memory
+        return memory.snapshot()
+    except Exception:
+        return {}
+
+
 def find_bundles(directory: Optional[str] = None) -> List[str]:
     """Bundle paths under `directory` (default: the configured
     observability dir), oldest first."""
@@ -199,7 +221,8 @@ def find_bundles(directory: Optional[str] = None) -> List[str]:
         return []
     return sorted(
         os.path.join(directory, fn) for fn in os.listdir(directory)
-        if fn.startswith("flight_") and fn.endswith(".json"))
+        if fn.startswith("flight_") and fn.endswith(".json")
+        and not fn.endswith(".trace.json"))   # Perfetto siblings
 
 
 # ----------------------------------------------------------------------

@@ -43,7 +43,9 @@ the per-bucket totals ride `/metrics` as
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
 
 from analytics_zoo_tpu.observability.registry import (
     get_registry,
@@ -53,6 +55,14 @@ from analytics_zoo_tpu.observability.registry import (
 
 BUCKETS = ("compile", "host_input", "device_compute",
            "blocked_collective", "overhead")
+
+#: bounded ring of FENCED step slices ({clock, ts (wall), dur_s,
+#: buckets, cold}) — what observability/timeline.py exports as goodput
+#: tracks.  Fenced-only keeps entries meaningful (fully decomposed)
+#: and the decode loop, which fences every iteration, fully covered.
+_TIMELINE_SIZE = 1024
+_timeline_lock = threading.Lock()
+_timeline: "deque[Dict[str, Any]]" = deque(maxlen=_TIMELINE_SIZE)
 
 #: productive buckets for the goodput ratio: device compute only —
 #: compile time is startup cost, not goodput (a retried job that spends
@@ -74,12 +84,16 @@ class _StepRecord:
     residual); `end()` closes the step and folds the residual into
     ``overhead`` when the step was fenced."""
 
-    __slots__ = ("_clock", "_t0", "_t_last", "_laps", "fenced", "cold")
+    __slots__ = ("_clock", "_t0", "_t_last", "_laps", "fenced", "cold",
+                 "_wall0")
 
     def __init__(self, clock: "StepClock", fenced: bool):
         self._clock = clock
         self._t0 = now()
         self._t_last = self._t0
+        #: wall anchor for the timeline exporter (durations still come
+        #: from the monotonic clock)
+        self._wall0 = time.time()
         self._laps: Dict[str, float] = {}
         self.fenced = fenced
         #: set by the caller when this step's dispatch blocked on XLA
@@ -103,7 +117,8 @@ class _StepRecord:
             # goodput is not polluted by one giant first step
             laps["compile"] = (laps.get("compile", 0.0)
                                + laps.pop("device_compute", 0.0))
-        self._clock._commit(wall, laps, self.fenced, self.cold)
+        self._clock._commit(wall, laps, self.fenced, self.cold,
+                            self._wall0)
 
 
 class StepClock:
@@ -160,7 +175,7 @@ class StepClock:
         self._counters[bucket].inc(seconds)
 
     def _commit(self, wall: float, laps: Dict[str, float], fenced: bool,
-                cold: bool) -> None:
+                cold: bool, wall0: Optional[float] = None) -> None:
         with self._lock:
             self.steps += 1
             self.wall_s += wall
@@ -185,6 +200,22 @@ class StepClock:
         for b, dt in laps.items():
             if dt:
                 self._counters[b].inc(dt)
+        if fenced:
+            with _timeline_lock:
+                _timeline.append({
+                    "clock": self.name,
+                    "ts": (wall0 if wall0 is not None
+                           else time.time() - wall),
+                    "dur_s": wall,
+                    "buckets": {b: round(v, 9)
+                                for b, v in laps.items() if v},
+                    "cold": cold,
+                })
+            # opportunistic memory telemetry rides the fenced cadence:
+            # every hot loop feeds the sampler without its own wiring,
+            # and the time gate bounds the live_arrays() walk cost
+            from analytics_zoo_tpu.observability import memory
+            memory.maybe_sample()
 
     # ------------------------------------------------------------------
 
@@ -276,10 +307,23 @@ def _ensure_global_gauge() -> None:
         _global_gauge_done = True
 
 
+def recent_steps(n: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Fenced step slices from the timeline ring, oldest first (what
+    observability/timeline.py draws as goodput tracks)."""
+    with _timeline_lock:
+        items = list(_timeline)
+    if n is not None:
+        items = items[-int(n):]
+    return items
+
+
 def reset_clocks() -> None:
-    """Drop every clock (tests).  The next `step_clock` call re-creates
-    them against the CURRENT global registry."""
+    """Drop every clock and the step timeline ring (tests).  The next
+    `step_clock` call re-creates clocks against the CURRENT global
+    registry."""
     global _global_gauge_done
     with _clocks_lock:
         _clocks.clear()
         _global_gauge_done = False
+    with _timeline_lock:
+        _timeline.clear()

@@ -1,0 +1,213 @@
+"""Memory telemetry — host RSS, jax live-buffer bytes, pool occupancy.
+
+The OOM class of failure (host heap creep, HBM exhaustion from a
+leaked buffer, a KV pool running hot) is invisible to latency metrics
+until the kill.  This module keeps a bounded ring of memory samples on
+the shared wall-time axis so the timeline exporter can draw a memory
+counter track under the request/step slices, plus high-watermark
+gauges and a snapshot for flight-recorder bundles.
+
+Each sample records:
+
+* ``host_rss_bytes`` — the process resident set (``/proc/self/statm``
+  where available, else the ``ru_maxrss`` peak as a degraded fallback),
+* ``jax_live_buffer_bytes`` — the sum of ``nbytes`` over
+  ``jax.live_arrays()``, guarded the same way the flight recorder
+  guards backend facts: only when jax is already imported AND a
+  backend is already initialized (sampling never brings one up);
+  0 otherwise,
+* every registered **provider**'s fields — e.g. the generation
+  engine's KV block pool registers
+  ``{"blocks_used", "blocks_capacity", "pool_bytes", "used_bytes"}``
+  under the name ``kv_pool``, flattened into the sample as
+  ``kv_pool_<field>``.
+
+Sampling is opportunistic and time-gated: fenced goodput steps call
+`maybe_sample()` (at most one sample per
+`OrcaContext.memory_sample_interval_s`), and `GET /timeline` forces
+one so an exported timeline always carries a current memory point.
+The sampler is pure host-side observation — it never dispatches device
+work, so the zero-recompile / byte-identical-dispatch guarantees of
+the hot loops are untouched (pinned by tests).
+
+Gauges (min/max tracking gives the high-watermarks for free):
+``memory_host_rss_bytes``, ``memory_jax_live_buffer_bytes``, and the
+``memory_<provider>_<field>`` family.  `snapshot()` returns the latest
+sample plus peaks — included in every flight-recorder bundle.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from analytics_zoo_tpu.observability.registry import get_registry, now
+
+#: sample ring capacity (the timeline memory track's depth)
+RING_SIZE = 512
+
+_lock = threading.Lock()
+_samples: "deque[Dict[str, Any]]" = deque(maxlen=RING_SIZE)
+_providers: Dict[str, Callable[[], Dict[str, float]]] = {}
+_peaks: Dict[str, float] = {}
+_n_samples = 0
+_last_sample_t: Optional[float] = None
+
+_PAGE_SIZE = None
+
+
+def register_provider(name: str,
+                      fn: Callable[[], Dict[str, float]]) -> None:
+    """Register (or replace) a named memory provider; `fn` returns a
+    flat dict of numeric fields sampled alongside the process stats."""
+    with _lock:
+        _providers[name] = fn
+
+
+def unregister_provider(name: str) -> None:
+    with _lock:
+        _providers.pop(name, None)
+
+
+def _host_rss_bytes() -> int:
+    """Current RSS from /proc (linux); on other platforms fall back to
+    the ru_maxrss PEAK (better than nothing for watermarks)."""
+    global _PAGE_SIZE
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        if _PAGE_SIZE is None:
+            _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE")
+        return pages * _PAGE_SIZE
+    except Exception:
+        try:
+            import resource
+            peak_kb = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss
+            return int(peak_kb) * 1024
+        except Exception:
+            return 0
+
+
+def _jax_live_buffer_bytes() -> int:
+    """Sum of live jax array bytes — WITHOUT initializing a backend
+    (same guard discipline as flight_recorder._jax_info)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    try:
+        from jax._src import xla_bridge
+        if not xla_bridge._backends:
+            return 0
+        return int(sum(getattr(a, "nbytes", 0)
+                       for a in jax.live_arrays()))
+    except Exception:
+        return 0
+
+
+def _interval_s() -> Optional[float]:
+    from analytics_zoo_tpu.common.context import OrcaContext
+    return OrcaContext.memory_sample_interval_s
+
+
+def sample() -> Dict[str, Any]:
+    """Take one sample now: read the sources, update gauges/peaks,
+    append to the ring.  Never raises."""
+    global _n_samples, _last_sample_t
+    s: Dict[str, Any] = {
+        "ts": round(time.time(), 6),
+        "host_rss_bytes": _host_rss_bytes(),
+        "jax_live_buffer_bytes": _jax_live_buffer_bytes(),
+    }
+    with _lock:
+        providers = list(_providers.items())
+    for name, fn in providers:
+        try:
+            for k, v in fn().items():
+                s[f"{name}_{k}"] = float(v)
+        except Exception:
+            pass
+    try:
+        reg = get_registry()
+        reg.counter("memory_samples_total",
+                    help="memory-telemetry samples taken").inc()
+        reg.gauge("memory_host_rss_bytes",
+                  help="process resident set size at the last sample "
+                       "(gauge max = high watermark)"
+                  ).set(s["host_rss_bytes"])
+        reg.gauge("memory_jax_live_buffer_bytes",
+                  help="total bytes of live jax arrays at the last "
+                       "sample (gauge max = high watermark)"
+                  ).set(s["jax_live_buffer_bytes"])
+        for k, v in s.items():
+            if k in ("ts", "host_rss_bytes", "jax_live_buffer_bytes"):
+                continue
+            # provider fields ride the memory_<provider>_<field> family
+            reg.gauge(f"memory_{k}",
+                      help="memory provider field (see "
+                           "docs/observability.md)").set(v)
+    except Exception:
+        pass
+    with _lock:
+        for k, v in s.items():
+            if k == "ts":
+                continue
+            if v > _peaks.get(k, float("-inf")):
+                _peaks[k] = v
+        _samples.append(s)
+        _n_samples += 1
+        _last_sample_t = now()
+    return s
+
+
+def maybe_sample(force: bool = False) -> Optional[Dict[str, Any]]:
+    """Time-gated sampling for opportunistic call sites (fenced goodput
+    steps).  At most one sample per
+    `OrcaContext.memory_sample_interval_s`; None interval disables
+    opportunistic sampling entirely.  `force=True` bypasses the gate
+    (GET /timeline, flight-recorder dumps)."""
+    try:
+        if not force:
+            interval = _interval_s()
+            if interval is None:
+                return None
+            with _lock:
+                last = _last_sample_t
+            if last is not None and now() - last < interval:
+                return None
+        return sample()
+    except Exception:
+        return None
+
+
+def samples(n: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Ring contents, oldest first; at most `n` newest."""
+    with _lock:
+        items = list(_samples)
+    if n is not None:
+        items = items[-int(n):]
+    return items
+
+
+def snapshot() -> Dict[str, Any]:
+    """Latest sample + high watermarks (the flight-bundle payload)."""
+    with _lock:
+        latest = dict(_samples[-1]) if _samples else None
+        peaks = dict(_peaks)
+        n = _n_samples
+    return {"latest": latest, "peaks": peaks, "n_samples": n}
+
+
+def reset() -> None:
+    """Drop samples, peaks and providers (tests)."""
+    global _n_samples, _last_sample_t
+    with _lock:
+        _samples.clear()
+        _peaks.clear()
+        _providers.clear()
+        _n_samples = 0
+        _last_sample_t = None

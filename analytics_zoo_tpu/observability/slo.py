@@ -1,0 +1,180 @@
+"""SLO tracking — are we meeting the latency targets?
+
+Iteration-level schedulers (the PR 2 continuous-batching engine) are
+judged on TTFT/TPOT percentiles *under an SLO*: the operator declares
+targets, and the system reports attainment and counts violations per
+dimension.  Targets live on `OrcaContext.slo_targets` as a dict over
+the four request-latency dimensions the request log derives:
+
+    OrcaContext.slo_targets = {"ttft_s": 0.5, "tpot_s": 0.05}
+
+Every finished request (observability/request_log.py calls
+`get_slo_tracker().observe(...)`) is judged against the configured
+dimensions:
+
+* `slo_violation_total` counts requests that missed >= 1 target, and
+  the `slo_violation_<dim>_total` family counts per dimension — the
+  alerting-rule inputs;
+* `slo_attainment_ratio` is a rolling-window gauge: the fraction of
+  the last `window` judged requests that met EVERY configured target
+  (nan before the first judged request);
+* `GET /slo` on `ServingServer` serves the full snapshot (targets,
+  window attainment overall and per dimension, violation counts).
+
+Targets are read at observe time, so they can be changed on a live
+process; requests finished while no targets were set are not judged
+(they do not dilute attainment).  A dimension whose measure is
+unavailable for a request (e.g. TPOT on a 1-token response) does not
+count as a violation of that dimension.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, Optional
+
+from analytics_zoo_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+
+#: the request-latency dimensions targets may be set over (the derived
+#: measures of observability/request_log.py)
+SLO_DIMENSIONS = ("ttft_s", "tpot_s", "queue_wait_s", "e2e_s")
+
+#: rolling attainment window (judged requests)
+DEFAULT_WINDOW = 512
+
+
+class SLOTracker:
+    """Rolling-window SLO judge over per-request latency measures."""
+
+    def __init__(self, window: int = DEFAULT_WINDOW,
+                 registry: Optional[MetricsRegistry] = None):
+        self.window = window
+        self._lock = threading.Lock()
+        #: per judged request: {dim: bool met} over the dims that were
+        #: both targeted and measurable at judge time
+        self._judged: "deque[Dict[str, bool]]" = deque(maxlen=window)
+        self._violations_by_dim: Dict[str, int] = {}
+        self._n_judged = 0
+        reg = registry if registry is not None else get_registry()
+        self._reg = reg
+        self._c_violations = reg.counter(
+            "slo_violation_total",
+            help="requests that missed at least one configured SLO "
+                 "target")
+        reg.gauge(
+            "slo_attainment_ratio", fn=self.attainment,
+            help="rolling-window fraction of judged requests meeting "
+                 "every configured SLO target (nan before the first)")
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _targets() -> Optional[Dict[str, float]]:
+        from analytics_zoo_tpu.common.context import OrcaContext
+        return OrcaContext.slo_targets
+
+    def observe(self, measures: Dict[str, Optional[float]]) -> None:
+        """Judge one finished request's derived latencies against the
+        configured targets.  No-op when no targets are set."""
+        targets = self._targets()
+        if not targets:
+            return
+        verdict: Dict[str, bool] = {}
+        for dim, target in targets.items():
+            value = measures.get(dim)
+            if value is None:
+                continue
+            verdict[dim] = value <= target
+        if not verdict:
+            return
+        missed = [d for d, ok in verdict.items() if not ok]
+        with self._lock:
+            self._judged.append(verdict)
+            self._n_judged += 1
+            for d in missed:
+                self._violations_by_dim[d] = (
+                    self._violations_by_dim.get(d, 0) + 1)
+        if missed:
+            self._c_violations.inc()
+            for d in missed:
+                # per-dimension family (documented by its literal
+                # prefix slo_violation_ in docs/observability.md)
+                self._reg.counter(
+                    f"slo_violation_{d}_total",
+                    help=f"requests missing the {d} SLO target").inc()
+
+    # ------------------------------------------------------------------
+
+    def attainment(self) -> float:
+        """Window fraction meeting every judged dimension (nan before
+        any judged request)."""
+        with self._lock:
+            if not self._judged:
+                return float("nan")
+            ok = sum(1 for v in self._judged if all(v.values()))
+            return ok / len(self._judged)
+
+    def attainment_by_dim(self) -> Dict[str, float]:
+        with self._lock:
+            counts: Dict[str, int] = {}
+            met: Dict[str, int] = {}
+            for v in self._judged:
+                for d, ok in v.items():
+                    counts[d] = counts.get(d, 0) + 1
+                    met[d] = met.get(d, 0) + (1 if ok else 0)
+        return {d: met[d] / counts[d] for d in sorted(counts)}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The GET /slo payload."""
+        targets = self._targets()
+        with self._lock:
+            n_window = len(self._judged)
+            n_judged = self._n_judged
+            by_dim_viol = dict(self._violations_by_dim)
+        att = self.attainment()
+        by_dim = self.attainment_by_dim()
+        out: Dict[str, Any] = {
+            "targets": dict(targets) if targets else None,
+            "window": self.window,
+            "requests_judged": n_judged,
+            "requests_in_window": n_window,
+            "attainment": (round(att, 4) if att == att else None),
+            "attainment_by_dim": {d: round(v, 4)
+                                  for d, v in by_dim.items()},
+            "violations_total": self._c_violations.value,
+            "violations_by_dim": by_dim_viol,
+        }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._judged.clear()
+            self._violations_by_dim.clear()
+            self._n_judged = 0
+
+
+# ----------------------------------------------------------------------
+
+_global_lock = threading.Lock()
+_global: Optional[SLOTracker] = None
+
+
+def get_slo_tracker() -> SLOTracker:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = SLOTracker()
+        return _global
+
+
+def reset_slo_tracker() -> SLOTracker:
+    """Drop and re-create the global tracker (tests) against the
+    CURRENT global registry."""
+    global _global
+    with _global_lock:
+        _global = None
+    return get_slo_tracker()

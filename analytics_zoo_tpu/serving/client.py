@@ -116,29 +116,41 @@ class InputQueue:
 
     def generate(self, tokens, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
-                 eos_id: Optional[int] = None, timeout: float = 300.0):
+                 eos_id: Optional[int] = None, timeout: float = 300.0,
+                 request_id: Optional[str] = None):
         """Streaming generation client for POST /generate: a generator
         yielding token ids AS THE SERVER SAMPLES THEM (chunked ndjson
         lines decoded incrementally — first token arrives at decode
         latency, not request latency).  After exhaustion
         `self.last_generate` holds the final {"done", "n_tokens",
         "finish_reason"} line.  Raises RuntimeError on a server-side
-        error, including mid-stream ones."""
+        error, including mid-stream ones.
+
+        `request_id` (optional) is sent as the X-Request-Id header;
+        the id the server echoed back — success or error — lands in
+        `self.last_request_id`, the key for the server's request
+        lifecycle log (/timeline, flight bundles)."""
         payload = {"tokens": [int(t) for t in tokens],
                    "max_new_tokens": max_new_tokens,
                    "temperature": temperature, "top_k": top_k,
                    "eos_id": eos_id}
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers["X-Request-Id"] = str(request_id)
         req = urllib.request.Request(
             f"{self.base}/generate", data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"})
+            headers=headers)
+        self.last_request_id = None
         try:
             resp = urllib.request.urlopen(req, timeout=timeout)
         except urllib.error.HTTPError as e:
+            self.last_request_id = e.headers.get("X-Request-Id")
             try:
                 err = json.loads(e.read()).get("error", str(e))
             except Exception:
                 err = str(e)
             raise RuntimeError(f"serving error: {err}") from None
+        self.last_request_id = resp.headers.get("X-Request-Id")
         with resp:
             for raw in resp:           # http.client de-chunks for us
                 msg = json.loads(raw)

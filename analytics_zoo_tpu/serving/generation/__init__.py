@@ -27,6 +27,8 @@ steps).  Four pieces, one subsystem:
 from analytics_zoo_tpu.serving.generation.engine import (  # noqa: F401
     GenerationEngine,
     GenerationStream,
+    QueueFull,
+    RequestTooLarge,
 )
 from analytics_zoo_tpu.serving.generation.kv_cache import (  # noqa: F401
     BlockAllocator,
@@ -44,5 +46,6 @@ from analytics_zoo_tpu.serving.generation.scheduler import (  # noqa: F401
 )
 
 __all__ = ["BlockAllocator", "CausalLM", "GenerationEngine",
-           "GenerationStream", "PagedKVCache", "Sequence",
-           "SlotScheduler", "sample_tokens"]
+           "GenerationStream", "PagedKVCache", "QueueFull",
+           "RequestTooLarge", "Sequence", "SlotScheduler",
+           "sample_tokens"]

@@ -37,7 +37,9 @@ from analytics_zoo_tpu.observability import (
     get_registry,
     log_event,
     maybe_watchdog,
+    memory,
     now,
+    request_log,
     step_clock,
 )
 from analytics_zoo_tpu.serving.generation.kv_cache import PagedKVCache
@@ -48,6 +50,18 @@ from analytics_zoo_tpu.serving.generation.scheduler import (
 )
 
 _STREAM_END = object()
+
+
+class RequestTooLarge(ValueError):
+    """The request can never be served by this engine's geometry
+    (prompt + max_new_tokens beyond max_context, or more KV blocks than
+    the whole pool).  The HTTP layer maps it to 413."""
+
+
+class QueueFull(RuntimeError):
+    """Admission control: the engine's waiting queue is at `max_queue`.
+    The HTTP layer maps it to 503 — shed load at the door instead of
+    queueing unboundedly."""
 
 
 class GenerationStream:
@@ -70,6 +84,12 @@ class GenerationStream:
     @property
     def finish_reason(self) -> Optional[str]:
         return self.seq.finish_reason
+
+    @property
+    def request_id(self) -> Optional[str]:
+        """The lifecycle-log id of this request (request_log.get(...)
+        returns its full event timeline and derived TTFT/TPOT/e2e)."""
+        return self.seq.request_id
 
     def __iter__(self):
         while True:
@@ -96,7 +116,8 @@ class GenerationEngine:
                  num_blocks: Optional[int] = None,
                  prefill_buckets: Optional[Seq[int]] = None,
                  prefill_token_budget: int = 2048,
-                 cache_dtype=jnp.float32, registry=None, seed: int = 0):
+                 cache_dtype=jnp.float32, registry=None, seed: int = 0,
+                 max_queue: Optional[int] = None):
         if model.max_position_len < max_context:
             raise ValueError(
                 f"model.max_position_len {model.max_position_len} < "
@@ -127,6 +148,10 @@ class GenerationEngine:
         self.scheduler = SlotScheduler(
             self.cache, max_slots, max_context, prefill_buckets,
             prefill_token_budget)
+        #: admission control: submit() raises QueueFull beyond this
+        #: many waiting requests (None = unbounded, the library
+        #: default; servers should bound it)
+        self.max_queue = max_queue
         self._rng = jax.random.PRNGKey(seed)
         self._lock = threading.RLock()
         self._wake = threading.Event()
@@ -161,6 +186,9 @@ class GenerationEngine:
         reg.gauge("generation_preemptions",
                   fn=lambda: self.scheduler.n_preemptions,
                   help="sequences preempted under cache pressure")
+        #: KV-pool occupancy rides the memory-telemetry track too, so
+        #: the timeline draws cache pressure under the request slices
+        memory.register_provider("kv_pool", self._kv_pool_stats)
         #: goodput decomposition of the two hot loops.  Both fence
         #: naturally (prefill fetches the sampled token, decode fetches
         #: the token vector), so every iteration is fully accounted
@@ -176,6 +204,17 @@ class GenerationEngine:
         self._goodput_warm: set = set()
 
         self._build_steps()
+
+    def _kv_pool_stats(self):
+        alloc = self.cache.allocator
+        used = alloc.capacity - alloc.available()
+        pool_bytes = self.cache.nbytes
+        return {
+            "blocks_used": used,
+            "blocks_capacity": alloc.capacity,
+            "pool_bytes": pool_bytes,
+            "used_bytes": pool_bytes * used // self.cache.num_blocks,
+        }
 
     # ------------------------------------------------------------------
     # compiled steps
@@ -273,24 +312,42 @@ class GenerationEngine:
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0,
                eos_id: Optional[int] = None,
-               stream_timeout: float = 120.0) -> GenerationStream:
-        """Queue one request; returns its token stream.  Raises
-        ValueError up front for prompts that can never fit (longer than
-        the largest prefill bucket, or prompt + max_new_tokens beyond
-        max_context / the whole block pool)."""
+               stream_timeout: float = 120.0,
+               request_id: Optional[str] = None) -> GenerationStream:
+        """Queue one request; returns its token stream.  Raises up
+        front when the request can never run: ValueError for malformed
+        prompts, `RequestTooLarge` (a ValueError; HTTP 413) when the
+        prompt + max_new_tokens exceed max_context or the whole block
+        pool, `QueueFull` (HTTP 503) past `max_queue` waiting requests.
+
+        `request_id` keys the per-request lifecycle log (request_log);
+        one is generated when absent and is readable from the returned
+        stream's `.request_id`."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
         if any(not 0 <= t < self.model.vocab for t in prompt):
             raise ValueError("prompt token out of vocab range")
-        seq = Sequence(prompt, max_new_tokens=max_new_tokens,
-                       temperature=temperature, top_k=top_k,
-                       eos_id=eos_id)
-        total = seq.context_len + seq.max_new_tokens
+        total = len(prompt) + int(max_new_tokens)
+        if total > self.max_context:
+            raise RequestTooLarge(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_context "
+                f"{self.max_context}")
         if self.cache.blocks_for(total) > self.cache.allocator.capacity:
-            raise ValueError(
+            raise RequestTooLarge(
                 f"request needs {self.cache.blocks_for(total)} KV "
                 f"blocks, pool holds {self.cache.allocator.capacity}")
+        if self.max_queue is not None and \
+                len(self.scheduler.waiting) >= self.max_queue:
+            raise QueueFull(
+                f"{len(self.scheduler.waiting)} requests already "
+                f"waiting (max_queue={self.max_queue})")
+        rid = request_log.start(request_id, prompt_len=len(prompt),
+                                max_new_tokens=int(max_new_tokens))
+        seq = Sequence(prompt, max_new_tokens=max_new_tokens,
+                       temperature=temperature, top_k=top_k,
+                       eos_id=eos_id, request_id=rid)
         seq.stream = GenerationStream(seq, timeout=stream_timeout)
         with self._lock:
             self.scheduler.submit(seq)
@@ -322,6 +379,7 @@ class GenerationEngine:
     def _emit(self, seq: Sequence, token: int) -> None:
         seq.generated.append(int(token))
         self._c_tokens.inc()
+        request_log.token(seq.request_id)
         if seq.stream is not None:
             seq.stream._put(token)
         reason = seq.should_finish()
@@ -352,6 +410,8 @@ class GenerationEngine:
         self._goodput_warm.add(("prefill", bucket))
         self._h_prefill.record(now() - t0, L)
         self._c_prefill_tokens.inc(L)
+        request_log.event(seq.request_id, "prefill", bucket=bucket,
+                          tokens=L, resumed=seq.n_preempted > 0)
         self._emit(seq, nxt)
         rec.end()
 
@@ -390,6 +450,7 @@ class GenerationEngine:
         self._goodput_warm.add("decode")
         self._h_decode.record(now() - t0, len(lanes))
         for i, seq in lanes.items():
+            request_log.decode_round(seq.request_id)
             self._emit(seq, nxt[i])
         rec.end()
 
@@ -418,9 +479,17 @@ class GenerationEngine:
                 if not self.scheduler.has_work():
                     return
                 if not self.step():
+                    stuck_ids = [s.request_id
+                                 for s in self.scheduler.waiting]
+                    for rid in stuck_ids:
+                        request_log.event(rid, "stuck")
+                    log_event("generation_stuck",
+                              waiting=len(stuck_ids),
+                              request_ids=stuck_ids)
                     flight_recorder.dump(
                         "generation_stuck",
-                        extra={"waiting": len(self.scheduler.waiting)})
+                        extra={"waiting": len(self.scheduler.waiting),
+                               "request_ids": stuck_ids})
                     raise RuntimeError(
                         "generation engine stuck: waiting requests but "
                         "no schedulable work (block pool too small?)")
@@ -442,6 +511,7 @@ class GenerationEngine:
         return self
 
     def _loop(self) -> None:
+        stuck_rounds = 0
         while not self._stop.is_set():
             if not self.scheduler.has_work():
                 if self.watchdog is not None:
@@ -453,11 +523,40 @@ class GenerationEngine:
             if self.watchdog is not None:
                 self.watchdog.arm()
             try:
-                self.step()
+                did = self.step()
+                with self._lock:
+                    if did or not self.scheduler.waiting:
+                        stuck_rounds = 0
+                    else:
+                        # waiting requests, no lanes running, nothing
+                        # admittable: the head can never be scheduled.
+                        # Reject it (tagged in the request log and
+                        # log_event so the failure is findable in a
+                        # bundle) instead of busy-spinning forever.
+                        stuck_rounds += 1
+                        if stuck_rounds >= 3:
+                            stuck_rounds = 0
+                            head = self.scheduler.waiting.popleft()
+                            log_event("generation_stuck",
+                                      request_ids=[head.request_id],
+                                      waiting=len(
+                                          self.scheduler.waiting) + 1)
+                            request_log.event(head.request_id, "stuck")
+                            flight_recorder.dump(
+                                "generation_stuck",
+                                extra={"request_ids":
+                                       [head.request_id]})
+                            self._finish(
+                                head, "error: engine stuck (request "
+                                "cannot be scheduled)")
             except Exception as e:   # fail loudly but keep serving
+                affected = [s.request_id
+                            for s in self.scheduler.running()]
                 log_event("generation_step_error",
-                          error=f"{type(e).__name__}: {e}")
-                flight_recorder.dump("generation_step_error", exc=e)
+                          error=f"{type(e).__name__}: {e}",
+                          request_ids=affected)
+                flight_recorder.dump("generation_step_error", exc=e,
+                                     extra={"request_ids": affected})
                 with self._lock:
                     for seq in list(self.scheduler.running()):
                         self._finish(seq, f"error: {e}")

@@ -24,7 +24,7 @@ import itertools
 from collections import deque
 from typing import Deque, List, Optional
 
-from analytics_zoo_tpu.observability import flight_recorder
+from analytics_zoo_tpu.observability import flight_recorder, request_log
 from analytics_zoo_tpu.serving.generation.kv_cache import PagedKVCache
 
 _UIDS = itertools.count()
@@ -36,12 +36,16 @@ class Sequence:
     __slots__ = ("uid", "prompt", "generated", "max_new_tokens",
                  "temperature", "top_k", "eos_id", "stream",
                  "block_table", "slot", "status", "finish_reason",
-                 "n_preempted", "_admit_order")
+                 "n_preempted", "_admit_order", "request_id")
 
     def __init__(self, prompt, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
-                 eos_id: Optional[int] = None, stream=None):
+                 eos_id: Optional[int] = None, stream=None,
+                 request_id: Optional[str] = None):
         self.uid = next(_UIDS)
+        #: lifecycle-log key, stable across preempt/resume (one id per
+        #: request end to end — the X-Request-Id the HTTP layer echoes)
+        self.request_id = request_id
         self.prompt = [int(t) for t in prompt]
         self.generated: List[int] = []
         self.max_new_tokens = int(max_new_tokens)
@@ -128,6 +132,9 @@ class SlotScheduler:
                                slot=victim.slot,
                                blocks_freed=len(victim.block_table),
                                context_len=victim.context_len)
+        request_log.event(victim.request_id, "preempt",
+                          slot=victim.slot,
+                          context_len=victim.context_len)
         self.cache.allocator.free(victim.block_table)
         victim.block_table = []
         self.slots[victim.slot] = None
@@ -192,6 +199,10 @@ class SlotScheduler:
                                    slot=seq.slot, bucket=bucket,
                                    blocks=len(blocks),
                                    resumed=seq.n_preempted > 0)
+            request_log.event(
+                seq.request_id,
+                "resume" if seq.n_preempted > 0 else "admit",
+                slot=seq.slot, bucket=bucket)
         return admitted
 
     def release(self, seq: Sequence, reason: str) -> None:
@@ -208,3 +219,4 @@ class SlotScheduler:
         flight_recorder.record("sched_release", uid=seq.uid,
                                reason=reason,
                                generated=len(seq.generated))
+        request_log.finish(seq.request_id, reason)

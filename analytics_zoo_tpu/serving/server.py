@@ -26,7 +26,12 @@ Endpoints:
                    {"done": true, "n_tokens": n, "finish_reason": ...}.
                    The engine continuously batches concurrent /generate
                    requests into its fixed-slot decode step
-                   (serving/generation/).
+                   (serving/generation/).  The client's X-Request-Id
+                   header (or a generated id) keys the per-request
+                   lifecycle log and is echoed back on every response;
+                   errors map to 400 (malformed) / 413 (can never fit)
+                   / 503 (admission queue full), each tagged with the
+                   request id in log_event and the request log.
   GET  /healthz  — liveness + records served
   GET  /metrics  — Prometheus text exposition: this server's per-op
                    latency summaries (serving_queue_wait_seconds,
@@ -40,6 +45,13 @@ Endpoints:
                    StepClocks (compile / host-input / device-compute /
                    blocked-collective / overhead per hot loop) plus the
                    process goodput ratio
+  GET  /slo      — SLO attainment snapshot: configured targets
+                   (OrcaContext.slo_targets), rolling-window attainment
+                   overall + per dimension, violation counts
+  GET  /timeline — Perfetto-loadable Chrome trace-event JSON merging
+                   spans, goodput step slices, request lifecycles,
+                   flight-ring instants and memory counter tracks onto
+                   one wall clock (observability/timeline.py)
   GET  /stats    — JSON operational snapshot: records_served, batcher
                    queue depth, worker-pool utilization, per-op timer
                    summaries, process goodput ratio
@@ -59,14 +71,18 @@ import numpy as np
 from analytics_zoo_tpu.observability import (
     MetricsRegistry,
     current_span,
+    export_timeline,
     flight_recorder,
     get_registry,
+    get_slo_tracker,
     goodput_tables,
     log_event,
+    memory,
     merged_prometheus_text,
     now,
     process_goodput_ratio,
     recent_spans,
+    request_log,
     trace,
 )
 from analytics_zoo_tpu.serving.codec import (
@@ -181,19 +197,29 @@ class ServingServer:
                 log_event("http_log", message=fmt % args,
                           client=self.client_address[0])
 
-            def _json(self, code: int, payload: Dict[str, Any]):
+            def _json(self, code: int, payload: Dict[str, Any],
+                      request_id: Optional[str] = None):
                 body = json.dumps(payload).encode()
-                self._body(code, body, "application/json")
+                self._body(code, body, "application/json",
+                           request_id=request_id)
 
-            def _body(self, code: int, body: bytes, ctype: str):
+            def _body(self, code: int, body: bytes, ctype: str,
+                      request_id: Optional[str] = None):
                 server._c_requests.inc()
                 if code >= 400:
                     server._c_http_errors.inc()
-                    log_event("http_error", code=code, path=self.path,
-                              client=self.client_address[0])
+                    # a tagged error is findable in a bundle: grep the
+                    # events/ring for the X-Request-Id the client saw
+                    fields = dict(code=code, path=self.path,
+                                  client=self.client_address[0])
+                    if request_id is not None:
+                        fields["request_id"] = request_id
+                    log_event("http_error", **fields)
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if request_id is not None:
+                    self.send_header("X-Request-Id", request_id)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -222,6 +248,25 @@ class ServingServer:
                         "goodput_ratio": round(process_goodput_ratio(),
                                                4),
                         "clocks": goodput_tables()})
+                    return
+                if self.path.startswith("/slo"):
+                    # SLO attainment snapshot (observability/slo.py):
+                    # configured targets, rolling-window attainment
+                    # overall and per dimension, violation counts
+                    self._json(200, get_slo_tracker().snapshot())
+                    return
+                if self.path.startswith("/timeline"):
+                    # Chrome-trace-event export (observability/
+                    # timeline.py): spans + goodput step slices +
+                    # request lifecycles + flight-ring instants +
+                    # memory counter tracks on one clock — save the
+                    # body and open it in Perfetto.  A fresh memory
+                    # sample is forced so the export always carries a
+                    # current memory point.
+                    memory.maybe_sample(force=True)
+                    self._body(200,
+                               json.dumps(export_timeline()).encode(),
+                               "application/json")
                     return
                 if self.path.startswith("/spans"):
                     n = 100
@@ -259,18 +304,41 @@ class ServingServer:
                 """Streamed autoregressive generation: each sampled
                 token goes out as its own chunk the moment the engine
                 emits it — a client renders tokens at decode latency,
-                not request latency."""
+                not request latency.
+
+                Request identity: the client's `X-Request-Id` header
+                (or a generated id) keys the per-request lifecycle log
+                and is echoed back as `X-Request-Id` on EVERY response
+                — success and error alike — so a slow or failed
+                request is findable in /timeline, /slo accounting and
+                flight-recorder bundles.  Error mapping: malformed
+                payload → 400, prompt that can never fit → 413,
+                admission queue full → 503."""
                 eng = server.generation_engine
                 if eng is None:
                     self._json(404, {"error": "no generation engine "
                                      "behind this server"})
                     return
+                rid = request_log.sanitize_request_id(
+                    self.headers.get("X-Request-Id")
+                    or request_log.new_request_id())
+
+                def reject(code: int, msg: str):
+                    request_log.reject(rid, code, msg)
+                    self._json(code,
+                               {"error": msg, "request_id": rid},
+                               request_id=rid)
+
                 try:
                     req = json.loads(body)
                     tokens = [int(t) for t in req["tokens"]]
                 except Exception as e:
-                    self._json(400, {"error": f"bad request: {e}"})
+                    reject(400, f"bad request: {e}")
                     return
+                from analytics_zoo_tpu.serving.generation.engine import (
+                    QueueFull,
+                    RequestTooLarge,
+                )
                 try:
                     stream = eng.submit(
                         tokens,
@@ -280,17 +348,27 @@ class ServingServer:
                         top_k=int(req.get("top_k", 0)),
                         eos_id=(int(req["eos_id"])
                                 if req.get("eos_id") is not None
-                                else None))
-                except ValueError as e:
-                    self._json(400, {"error": str(e)})
+                                else None),
+                        request_id=rid)
+                except RequestTooLarge as e:
+                    reject(413, str(e))
                     return
+                except QueueFull as e:
+                    reject(503, str(e))
+                    return
+                except ValueError as e:
+                    reject(400, str(e))
+                    return
+                rid = stream.request_id or rid   # uniquified id wins
                 server._c_requests.inc()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("X-Request-Id", rid)
                 self.end_headers()
                 n = 0
-                with trace("serving.generate", prompt=len(tokens)):
+                with trace("serving.generate", prompt=len(tokens),
+                           request_id=rid):
                     try:
                         for tok in stream:
                             self._chunk(json.dumps({"token": tok})
@@ -298,17 +376,25 @@ class ServingServer:
                             n += 1
                         self._chunk(json.dumps(
                             {"done": True, "n_tokens": n,
-                             "finish_reason": stream.finish_reason})
+                             "finish_reason": stream.finish_reason,
+                             "request_id": rid})
                             + "\n")
                     except Exception as e:
-                        # stream died mid-flight (engine stop, queue
-                        # timeout): terminate the chunked body with an
-                        # error line rather than a torn connection
+                        # stream died mid-flight (engine stop/stuck,
+                        # queue timeout): terminate the chunked body
+                        # with an error line rather than a torn
+                        # connection, and tag the request everywhere
+                        # a post-mortem will look
                         log_event("generate_error",
-                                  error=f"{type(e).__name__}: {e}")
+                                  error=f"{type(e).__name__}: {e}",
+                                  request_id=rid)
+                        request_log.event(
+                            rid, "stream_error",
+                            error=f"{type(e).__name__}: {e}")
                         try:
                             self._chunk(json.dumps(
-                                {"error": f"{type(e).__name__}: {e}"})
+                                {"error": f"{type(e).__name__}: {e}",
+                                 "request_id": rid})
                                 + "\n")
                         except OSError:
                             return
@@ -579,6 +665,14 @@ class ServingServer:
                 "cache_occupancy": eng.cache.allocator.occupancy(),
                 "preemptions": eng.scheduler.n_preemptions,
                 "tokens_total": eng._c_tokens.value,
+            }
+            rl = request_log.get_request_log()
+            slo = get_slo_tracker().snapshot()
+            out["requests"] = {
+                "active": rl.active_count(),
+                "finished_in_ring": rl.finished_count(),
+                "slo_attainment": slo["attainment"],
+                "slo_targets": slo["targets"],
             }
         return out
 

@@ -755,10 +755,15 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
     its slowest member, finished lanes idle).  Continuous = submit
     everything, the scheduler joins/leaves lanes between steps.  Also
     records the decode-step compile count after the whole run — the
-    zero-recompile-after-warmup guarantee (must be 1)."""
+    zero-recompile-after-warmup guarantee (must be 1) — and, from the
+    request lifecycle log, the per-request TTFT/TPOT p50/p99 each mode
+    delivered (the SLO-facing decomposition: continuous batching wins
+    on TTFT because nobody waits for a group barrier).  Asserts the
+    lifecycle invariant TTFT <= e2e on every request."""
     import jax
     import jax.numpy as jnp
 
+    from analytics_zoo_tpu.observability import request_log
     from analytics_zoo_tpu.serving.generation import (CausalLM,
                                                       GenerationEngine)
 
@@ -778,7 +783,7 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
     reqs = [(list(rng.integers(0, 512, int(l))), int(n))
             for l, n in zip(lens, news)]
 
-    def run(mode: str) -> float:
+    def run(mode: str):
         t0 = time.monotonic()
         if mode == "continuous":
             streams = [eng.submit(p, max_new_tokens=n)
@@ -793,10 +798,44 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
                 streams.extend(batch)
         wall = time.monotonic() - t0
         tokens = sum(len(s.tokens()) for s in streams)
-        return tokens / wall
+        return tokens / wall, streams
 
-    static_tput = run("static")
-    cont_tput = run("continuous")
+    def request_latencies(streams, mode: str):
+        """Pull each request's derived TTFT/TPOT from the lifecycle
+        log and gate the invariant TTFT <= e2e per request."""
+        ttfts, tpots = [], []
+        for s in streams:
+            rec = request_log.get(s.request_id)
+            if rec is None:
+                raise RuntimeError(
+                    f"{mode}: request {s.request_id} missing from the "
+                    "lifecycle log")
+            ttft, e2e, tpot = (rec["ttft_s"], rec["e2e_s"],
+                               rec["tpot_s"])
+            if ttft is None or e2e is None:
+                raise RuntimeError(
+                    f"{mode}: request {s.request_id} finished without "
+                    f"ttft/e2e (record: {rec['status']})")
+            if ttft > e2e:
+                raise RuntimeError(
+                    f"{mode}: lifecycle invariant violated — ttft "
+                    f"{ttft:.6f}s > e2e {e2e:.6f}s for "
+                    f"{s.request_id}")
+            ttfts.append(ttft)
+            if tpot is not None:
+                tpots.append(tpot)
+        pct = lambda v, p: float(np.percentile(v, p)) if v else 0.0  # noqa: E731
+        return {
+            "ttft_p50_ms": round(pct(ttfts, 50) * 1e3, 3),
+            "ttft_p99_ms": round(pct(ttfts, 99) * 1e3, 3),
+            "tpot_p50_ms": round(pct(tpots, 50) * 1e3, 3),
+            "tpot_p99_ms": round(pct(tpots, 99) * 1e3, 3),
+        }
+
+    static_tput, static_streams = run("static")
+    cont_tput, cont_streams = run("continuous")
+    cont_lat = request_latencies(cont_streams, "continuous")
+    static_lat = request_latencies(static_streams, "static")
     return {
         "generation_continuous_tokens_per_sec": round(cont_tput, 1),
         "generation_static_tokens_per_sec": round(static_tput, 1),
@@ -805,6 +844,16 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
         "generation_decode_compiles": eng.decode_compile_count,
         "generation_requests": n_requests,
         "generation_slots": slots,
+        # per-request latency percentiles from the lifecycle log —
+        # what an SLO on this engine would be written against
+        "generation_ttft_p50_ms": cont_lat["ttft_p50_ms"],
+        "generation_ttft_p99_ms": cont_lat["ttft_p99_ms"],
+        "generation_tpot_p50_ms": cont_lat["tpot_p50_ms"],
+        "generation_tpot_p99_ms": cont_lat["tpot_p99_ms"],
+        "generation_static_ttft_p50_ms": static_lat["ttft_p50_ms"],
+        "generation_static_ttft_p99_ms": static_lat["ttft_p99_ms"],
+        "generation_static_tpot_p50_ms": static_lat["tpot_p50_ms"],
+        "generation_static_tpot_p99_ms": static_lat["tpot_p99_ms"],
     }
 
 

@@ -44,14 +44,17 @@ def main():
     try:
         rng = np.random.default_rng(0)
 
-        # one streamed request, token by token
+        # one streamed request, token by token; the request_id keys
+        # the server's lifecycle log (TTFT/TPOT, /timeline tracks)
         iq = InputQueue(srv.host, srv.port)
         prompt = list(rng.integers(0, 512, 24))
         print("stream:", end=" ", flush=True)
         for tok in iq.generate(prompt, max_new_tokens=16,
-                               temperature=0.8, top_k=40):
+                               temperature=0.8, top_k=40,
+                               request_id="example-req-0"):
             print(tok, end=" ", flush=True)
-        print(f"\nfinish: {iq.last_generate}")
+        print(f"\nfinish: {iq.last_generate} "
+              f"(request_id={iq.last_request_id})")
 
         # concurrent mixed-length requests continuously batched onto
         # the same fixed-slot decode step
@@ -75,6 +78,21 @@ def main():
                 if l.startswith("generation_tokens_total")][0]
         print(f"{line}; decode programs still compiled: "
               f"{engine.decode_compile_count}")
+
+        # per-request latency story: TTFT/TPOT from the lifecycle log,
+        # and the merged Perfetto timeline (save it, open in
+        # https://ui.perfetto.dev)
+        from analytics_zoo_tpu.observability import request_log
+        rec = request_log.get("example-req-0")
+        print(f"request example-req-0: ttft={rec['ttft_s']}s "
+              f"tpot={rec['tpot_s']}s e2e={rec['e2e_s']}s "
+              f"rounds={rec['n_rounds']}")
+        trace = urlopen(f"http://{srv.host}:{srv.port}/timeline",
+                        timeout=10).read()
+        with open("/tmp/generation_timeline.json", "wb") as f:
+            f.write(trace)
+        print("timeline written to /tmp/generation_timeline.json "
+              f"({len(trace)} bytes)")
     finally:
         srv.stop()
         stop_orca_context()

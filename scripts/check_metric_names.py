@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Lint: every literally-named registry metric is Prometheus-legal AND
-documented in docs/observability.md.
+documented in docs/observability.md — and every documented metric
+actually exists in code.
 
 The metrics registry sanitizes names at registration, so an illegal
 name silently mutates instead of failing — which means a dashboard
@@ -19,6 +20,13 @@ for.  This check closes both gaps statically:
   ``[a-zA-Z_:][a-zA-Z0-9_:]*``;
 * each captured name (or family prefix) must appear verbatim in
   docs/observability.md.
+
+And the REVERSE direction (`find_dead_doc_entries`): every backticked
+metric name in the docs' metric-index table must still exist in the
+source — verbatim, or (for ``family_<var>_suffix`` entries and
+documented examples of such a family) via its literal prefix.  A
+renamed-in-code metric would otherwise leave a dead doc entry that
+operators would build dashboards on.
 
 Run directly (`python scripts/check_metric_names.py`) or via the
 tier-1 wrapper `tests/test_metric_names.py`.  Exit code 0 = clean.
@@ -75,15 +83,82 @@ def find_violations():
     return violations
 
 
+#: backticked tokens in the metric-index table that look like metric
+#: names (families use `<var>` placeholders: `span_<name>_seconds`)
+_DOC_TOKEN = re.compile(r"`([a-zA-Z_:][a-zA-Z0-9_:<>]*)`")
+
+
+def _metric_index_rows(docs_text: str):
+    """The `| metric | ... |` table rows of the '## Metric index'
+    section (until the next section heading)."""
+    in_section = False
+    for line in docs_text.splitlines():
+        if line.startswith("## "):
+            in_section = line.strip() == "## Metric index"
+            continue
+        if in_section and line.lstrip().startswith("|"):
+            yield line
+
+
+def find_dead_doc_entries(docs_text=None, sources=None):
+    """Reverse direction: metric-index entries with no counterpart in
+    the source tree.  A token is alive when it appears verbatim in any
+    scanned source file, when it is a `family_<var>` entry whose
+    literal prefix appears, or when it is a documented example covered
+    by some family's prefix."""
+    if docs_text is None:
+        with open(DOCS, encoding="utf-8") as f:
+            docs_text = f.read()
+    if sources is None:
+        chunks = []
+        for path in _source_files():
+            with open(path, encoding="utf-8") as f:
+                chunks.append(f.read())
+        sources = "\n".join(chunks)
+    tokens = []
+    for row in _metric_index_rows(docs_text):
+        cells = row.split("|")
+        if len(cells) < 2:
+            continue
+        for tok in _DOC_TOKEN.findall(cells[1]):
+            if tok not in ("metric",):      # the header row
+                tokens.append(tok)
+    family_prefixes = sorted(
+        {t.split("<")[0] for t in tokens if "<" in t}
+        | {t for t in tokens if t.endswith("_")})
+    dead = []
+    for tok in tokens:
+        if "<" in tok:
+            probe = tok.split("<")[0]
+            if probe and probe in sources:
+                continue
+        elif tok in sources:
+            continue
+        elif any(p and tok.startswith(p) for p in family_prefixes):
+            # a documented example of a computed-name family
+            continue
+        dead.append(tok)
+    return dead
+
+
 def main() -> int:
     violations = find_violations()
-    if not violations:
+    dead = find_dead_doc_entries()
+    if not violations and not dead:
         print("check_metric_names: clean")
         return 0
-    print("check_metric_names: undocumented or illegal registry "
-          "metric names:", file=sys.stderr)
-    for path, lineno, name, why in violations:
-        print(f"  {path}:{lineno}: {name!r} — {why}", file=sys.stderr)
+    if violations:
+        print("check_metric_names: undocumented or illegal registry "
+              "metric names:", file=sys.stderr)
+        for path, lineno, name, why in violations:
+            print(f"  {path}:{lineno}: {name!r} — {why}",
+                  file=sys.stderr)
+    if dead:
+        print("check_metric_names: dead docs/observability.md metric-"
+              "index entries (no counterpart in code):",
+              file=sys.stderr)
+        for tok in dead:
+            print(f"  {tok!r}", file=sys.stderr)
     return 1
 
 

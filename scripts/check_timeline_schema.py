@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Lint + validator: the timeline export is valid Chrome trace-event
+JSON.
+
+The observability layer's GET /timeline and the flight-recorder's
+``*.trace.json`` siblings exist to be dropped into Perfetto /
+``chrome://tracing``; a malformed export fails silently there (the UI
+shows an empty trace), so the schema is pinned here:
+
+* top level is an object with a non-empty ``traceEvents`` list;
+* every event has a known ``ph`` phase and a string ``name``;
+* non-metadata events carry numeric ``ts`` (>= 0) and integer
+  ``pid``/``tid``; ``X`` slices carry numeric ``dur`` >= 0; ``C``
+  counters carry an ``args`` dict of numbers; ``i`` instants carry a
+  valid scope;
+* ``ts`` is monotone non-decreasing over the non-metadata stream (the
+  exporter sorts — a regression here breaks sequential consumers);
+* pid/tid mapping: every pid used has a ``process_name`` metadata
+  event and every (pid, tid) a ``thread_name`` one — the rows Perfetto
+  labels.
+
+Usage: ``python scripts/check_timeline_schema.py [trace.json ...]``.
+With file arguments, each is validated.  With none, a synthetic
+scenario is run through the REAL exporter (a span, a fenced goodput
+step, a full request lifecycle incl. preemption, a memory sample) and
+the result validated — the self-contained tier-1 lint mode
+(tests/test_timeline_schema.py).  Exit code 0 = clean.
+"""
+
+from __future__ import annotations
+
+import json
+import numbers
+import os
+import sys
+from typing import Any, Dict, List
+
+#: repo root, so the synthetic mode can import the package when run as
+#: `python scripts/check_timeline_schema.py`
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: phases the exporter may emit (superset-safe: B/E/b/e accepted for
+#: hand-written traces fed through the validator)
+VALID_PH = {"X", "B", "E", "b", "e", "n", "i", "I", "C", "M"}
+
+#: instant-event scopes (g=global, p=process, t=thread)
+VALID_SCOPE = {"g", "p", "t"}
+
+META_KINDS = {"process_name", "thread_name", "process_labels",
+              "thread_sort_index", "process_sort_index"}
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, numbers.Real) and not isinstance(v, bool)
+
+
+def validate_timeline(doc: Any) -> List[str]:
+    """All schema violations in `doc` (empty list = valid)."""
+    errors: List[str] = []
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        return ["top level must be an object with a 'traceEvents' key"]
+    events = doc["traceEvents"]
+    if not isinstance(events, list):
+        return ["'traceEvents' must be a list"]
+    if not events:
+        return ["'traceEvents' is empty"]
+
+    last_ts = None
+    used_pids = set()
+    used_tids = set()
+    named_pids = set()
+    named_tids = set()
+
+    for i, e in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(e, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = e.get("ph")
+        if ph not in VALID_PH:
+            errors.append(f"{where}: unknown ph {ph!r}")
+            continue
+        name = e.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(f"{where}: missing/empty name")
+            continue
+        if ph == "M":
+            if name not in META_KINDS:
+                errors.append(
+                    f"{where}: unknown metadata kind {name!r}")
+            if name in ("process_name", "thread_name"):
+                if not isinstance(e.get("args", {}).get("name"), str):
+                    errors.append(
+                        f"{where}: {name} metadata needs args.name")
+                if not isinstance(e.get("pid"), int):
+                    errors.append(f"{where}: metadata needs int pid")
+                elif name == "process_name":
+                    named_pids.add(e["pid"])
+                elif isinstance(e.get("tid"), int):
+                    named_tids.add((e["pid"], e["tid"]))
+                else:
+                    errors.append(
+                        f"{where}: thread_name metadata needs int tid")
+            continue
+        # non-metadata events
+        ts = e.get("ts")
+        if not _is_num(ts) or ts < 0:
+            errors.append(f"{where}: ts must be a number >= 0")
+            continue
+        if not isinstance(e.get("pid"), int):
+            errors.append(f"{where}: pid must be an int")
+            continue
+        if not isinstance(e.get("tid"), int):
+            errors.append(f"{where}: tid must be an int")
+            continue
+        used_pids.add(e["pid"])
+        used_tids.add((e["pid"], e["tid"]))
+        if last_ts is not None and ts < last_ts:
+            errors.append(
+                f"{where}: ts {ts} < previous {last_ts} — stream not "
+                "monotone")
+        last_ts = ts
+        if ph == "X":
+            if not _is_num(e.get("dur")) or e["dur"] < 0:
+                errors.append(
+                    f"{where}: X slice needs numeric dur >= 0")
+        elif ph == "C":
+            args = e.get("args")
+            if not isinstance(args, dict) or not args or \
+                    not all(_is_num(v) for v in args.values()):
+                errors.append(
+                    f"{where}: C counter needs a non-empty args dict "
+                    "of numbers")
+        elif ph == "i" and e.get("s") not in VALID_SCOPE:
+            errors.append(
+                f"{where}: instant scope s must be one of "
+                f"{sorted(VALID_SCOPE)}")
+
+    for pid in sorted(used_pids - named_pids):
+        errors.append(f"pid {pid} has no process_name metadata")
+    for pid, tid in sorted(used_tids - named_tids):
+        errors.append(
+            f"(pid {pid}, tid {tid}) has no thread_name metadata")
+    return errors
+
+
+def _synthetic_timeline() -> Dict[str, Any]:
+    """Drive the REAL exporter over a small synthetic scenario — the
+    self-contained lint mode exercises every track type."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from analytics_zoo_tpu.observability import (
+        flight_recorder,
+        memory,
+        request_log,
+        timeline,
+        trace,
+    )
+    from analytics_zoo_tpu.observability.goodput import step_clock
+
+    with trace("lint.span", check="timeline_schema"):
+        pass
+    clock = step_clock("lint_clock")
+    rec = clock.begin(force_fence=True)
+    rec.lap("host_input")
+    rec.lap("device_compute")
+    rec.end()
+    rid = request_log.start("lint-req", prompt_len=8, max_new_tokens=4)
+    request_log.event(rid, "admit", slot=0)
+    request_log.event(rid, "prefill", bucket=16, tokens=8)
+    request_log.token(rid)
+    request_log.event(rid, "preempt", slot=0)
+    request_log.event(rid, "resume", slot=1)
+    for _ in range(3):
+        request_log.decode_round(rid)
+        request_log.token(rid)
+    request_log.finish(rid, "length")
+    request_log.reject("lint-reject", 413, "too large")
+    flight_recorder.record("lint_event", step=1)
+    memory.sample()
+    return timeline.export_timeline()
+
+
+def main(argv: List[str]) -> int:
+    if argv:
+        rc = 0
+        for path in argv:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    doc = json.load(f)
+            except Exception as e:
+                print(f"check_timeline_schema: {path}: unreadable "
+                      f"({e})", file=sys.stderr)
+                rc = 1
+                continue
+            errors = validate_timeline(doc)
+            if errors:
+                rc = 1
+                print(f"check_timeline_schema: {path}:",
+                      file=sys.stderr)
+                for err in errors:
+                    print(f"  {err}", file=sys.stderr)
+            else:
+                print(f"check_timeline_schema: {path}: clean")
+        return rc
+    doc = _synthetic_timeline()
+    errors = validate_timeline(doc)
+    if errors:
+        print("check_timeline_schema: the exporter emits schema "
+              "violations:", file=sys.stderr)
+        for err in errors:
+            print(f"  {err}", file=sys.stderr)
+        return 1
+    n = len(doc["traceEvents"])
+    print(f"check_timeline_schema: clean ({n} events, synthetic "
+          "scenario)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -494,6 +494,9 @@ def test_ring_auto_impl_selects_by_shard_length(monkeypatch):
                                np.asarray(out_einsum), atol=2e-5)
 
 
+@pytest.mark.slow   # ~13s warm (PR 5 budget trim): the seq512 dbias
+# variant; the dbias contract + parity at smaller seq stay tier-1 in
+# tests/test_fused_kernels.py
 def test_flash_bias_gradient_matches_einsum_seq512():
     """The r5 dbias kernel: bias cotangents from the Pallas backward
     match the einsum/reference path at seq 512 for every broadcast

@@ -127,6 +127,9 @@ def test_tcmf_hybrid_beats_plain_factorization():
     assert mse_h < mse_p, (mse_h, mse_p)
 
 
+@pytest.mark.slow   # ~13s warm (PR 5 budget trim): the covariate +
+# incremental-retrain depth case; tcmf fit/forecast/save-load and the
+# hybrid-beats-plain quality gate stay tier-1
 def test_tcmf_covariates_and_incremental_retrain():
     """User covariates thread through fit/predict (channel-count
     mismatches rejected), and fit_incremental extends the model with a
@@ -370,6 +373,8 @@ def test_predict_does_not_poison_roll_state():
     assert all("y" in b for b in blocks)
 
 
+@pytest.mark.slow   # ~13s warm (PR 5 budget trim): tcmf keeps tier-1
+# coverage via factorizes/hybrid/covariates/save_load
 def test_tcmf_rolling_validation():
     """Walk-forward retraining evaluation (reference
     DeepGLO.rolling_validation): per-round scores + means, model rolled

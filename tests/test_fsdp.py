@@ -36,8 +36,17 @@ def _collect_cases():
 
 _CASES = _collect_cases()
 
+#: cases too heavy for the tier-1 870s budget (PR 5: the suite grew
+#: past the cap again) — run under `-m slow`.  Cross-mesh checkpoint
+#: restore ~20s warm; the cheaper fsdp cases (loss parity, sharding
+#: asserts) keep the tier-1 signal.
+_SLOW_CASES = {"test_checkpoint_restores_across_mesh_shapes"}
 
-@pytest.mark.parametrize("case", _CASES)
+
+@pytest.mark.parametrize(
+    "case",
+    [pytest.param(c, marks=pytest.mark.slow) if c in _SLOW_CASES
+     else c for c in _CASES])
 def test_fsdp_case_in_child(case):
     import time
 

@@ -1,6 +1,8 @@
 """Tier-1 wiring for scripts/check_metric_names.py: the build goes red
 if a registry metric is registered under a name that is not legal
-Prometheus or is missing from docs/observability.md's metric index."""
+Prometheus, is missing from docs/observability.md's metric index, OR
+is documented there without a counterpart in code (the reverse
+direction — dead doc entries)."""
 
 import os
 import subprocess
@@ -39,3 +41,32 @@ def test_lint_detects_violation():
     # the Prometheus grammar rejects what the registry would sanitize
     assert not mod.PROM_NAME.match("9leading_digit")
     assert mod.PROM_NAME.match("a_ok:name")
+
+
+def test_reverse_direction_detects_dead_doc_entries():
+    """The live docs index is fully backed by code, and the reverse
+    checker actually catches a dead entry / accepts the live idioms
+    (families by prefix, documented examples of a family)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("azt_metric_lint2",
+                                                  SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.find_dead_doc_entries() == []
+    docs = (
+        "## Metric index\n"
+        "| metric | type | where |\n"
+        "|---|---|---|\n"
+        "| `real_total` | counter | a.py |\n"
+        "| `fam_<kind>_total` (prefix `fam_`) | counter | b.py |\n"
+        "| `fam_example_total` | counter | b.py |\n"
+        "| `ghost_total` | counter | gone.py |\n"
+        "\n## Next section\n"
+        "| `not_in_index_total` | counter | ignored |\n")
+    sources = 'reg.counter("real_total")\nf"fam_{kind}_total"\n'
+    dead = mod.find_dead_doc_entries(docs_text=docs, sources=sources)
+    # the literal exists, the family exists by prefix, the example is
+    # covered by the family; only the ghost is dead — and tokens
+    # outside the Metric index section are never scanned
+    assert dead == ["ghost_total"]

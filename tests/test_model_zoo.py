@@ -121,6 +121,9 @@ def test_anomaly_detector_end_to_end():
     assert (250 - 20) in idx, idx
 
 
+@pytest.mark.slow   # ~13s warm (PR 5 budget trim): resnet stays
+# covered tier-1 by test_resnet_save_load_with_batchstats and the
+# imageclassification breadth suite
 def test_resnet18_forward_and_train_step():
     from analytics_zoo_tpu.models.image.imageclassification import (
         ImageClassifier)

@@ -28,11 +28,25 @@ def _no_persistent_compile_cache():
     just-persisted entries) go NaN / wrong — the long-standing
     `test_pipeline_fsdp_composition` "NaN flake" was exactly this,
     appearing and disappearing with the warmth of `.jax_cache_tests`.
-    See BASELINE.md for the full ledger."""
+    See BASELINE.md for the full ledger.
+
+    Setting the config alone is NOT enough in full-suite context
+    (found in PR 5): `compilation_cache.is_cache_used` memoizes its
+    verdict at the process's FIRST compile, so once any earlier test
+    compiled with the cache enabled, a later `config None` is ignored
+    and this module still loads poisoned entries — which is why the
+    flake survived the PR 4 fix in full runs while the module alone
+    was 3/3 green.  `reset_cache()` drops that memo (and the cache
+    object) so the config actually takes effect, both on the way in
+    and when restoring for the rest of the suite."""
+    from jax._src import compilation_cache
+
     prev = jax.config.jax_compilation_cache_dir
     jax.config.update("jax_compilation_cache_dir", None)
+    compilation_cache.reset_cache()
     yield
     jax.config.update("jax_compilation_cache_dir", prev)
+    compilation_cache.reset_cache()
 
 
 @pytest.fixture()

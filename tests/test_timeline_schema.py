@@ -1,0 +1,172 @@
+"""Tier-1 wiring for scripts/check_timeline_schema.py plus live
+validation: the Chrome-trace timeline export (GET /timeline, flight
+bundle *.trace.json siblings) must be schema-valid Perfetto input and
+must actually contain the merged tracks (request lifecycles, goodput
+step slices, memory counters) the exporter exists for."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_timeline_schema.py")
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location("azt_timeline_lint",
+                                                  SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_timeline_schema_lint():
+    """The lint itself (synthetic scenario through the real exporter),
+    isolated in a subprocess like the other tier-1 lints."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, SCRIPT],
+                          capture_output=True, text=True, env=env,
+                          timeout=120)
+    assert proc.returncode == 0, (
+        "timeline exporter emits schema violations:\n"
+        + proc.stdout + proc.stderr)
+
+
+def test_validator_catches_breakage():
+    """The live exporter being clean proves nothing if the validator
+    is blind — pin that each rule actually fires."""
+    mod = _load_validator()
+    ok = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+         "args": {"name": "p"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+         "args": {"name": "t"}},
+        {"ph": "X", "name": "a", "pid": 1, "tid": 1, "ts": 10,
+         "dur": 5},
+        {"ph": "i", "name": "m", "pid": 1, "tid": 1, "ts": 12,
+         "s": "t"},
+        {"ph": "C", "name": "c", "pid": 1, "tid": 1, "ts": 20,
+         "args": {"v": 1.5}},
+    ]}
+    assert mod.validate_timeline(ok) == []
+
+    import copy
+    bad = copy.deepcopy(ok)
+    bad["traceEvents"][2]["ts"] = 30           # out of order
+    assert any("monotone" in e for e in mod.validate_timeline(bad))
+    bad = copy.deepcopy(ok)
+    del bad["traceEvents"][2]["dur"]           # X without dur
+    assert any("dur" in e for e in mod.validate_timeline(bad))
+    bad = copy.deepcopy(ok)
+    bad["traceEvents"][2]["pid"] = 9           # unnamed pid
+    assert any("process_name" in e for e in mod.validate_timeline(bad))
+    bad = copy.deepcopy(ok)
+    bad["traceEvents"][4]["args"] = {"v": "high"}   # non-numeric C
+    assert any("numbers" in e for e in mod.validate_timeline(bad))
+    bad = copy.deepcopy(ok)
+    bad["traceEvents"][2]["ph"] = "Z"          # unknown phase
+    assert any("unknown ph" in e for e in mod.validate_timeline(bad))
+    assert mod.validate_timeline({"traceEvents": []})
+    assert mod.validate_timeline([1, 2])
+
+
+@pytest.fixture(scope="module")
+def served_engine():
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.serving import ServingServer
+    from analytics_zoo_tpu.serving.generation import (
+        CausalLM,
+        GenerationEngine,
+    )
+
+    model = CausalLM(vocab=32, hidden_size=16, n_head=2, n_block=1,
+                     intermediate_size=32, max_position_len=64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        jnp.arange(8)[None])["params"]
+    eng = GenerationEngine(model, params, max_slots=2, block_size=8,
+                           max_context=32)
+    eng.warmup()
+    srv = ServingServer(generation_engine=eng).start()
+    yield srv, eng
+    srv.stop()
+
+
+def test_live_timeline_is_valid_and_complete(served_engine):
+    """The acceptance shape: GET /timeline on a serving process is
+    schema-valid Chrome trace JSON containing at least one request
+    lifecycle, one goodput slice and one memory counter track."""
+    from analytics_zoo_tpu.observability.timeline import (
+        PID_GOODPUT,
+        PID_MEMORY,
+        PID_REQUESTS,
+    )
+    from analytics_zoo_tpu.serving import InputQueue
+
+    srv, eng = served_engine
+    iq = InputQueue(srv.host, srv.port)
+    toks = list(iq.generate([1, 2, 3, 4], max_new_tokens=5,
+                            request_id="tl-req-1"))
+    assert len(toks) == 5
+    doc = json.loads(urllib.request.urlopen(
+        f"http://{srv.host}:{srv.port}/timeline", timeout=10).read())
+    mod = _load_validator()
+    errors = mod.validate_timeline(doc)
+    assert errors == [], "\n".join(errors)
+    evs = doc["traceEvents"]
+    # request lifecycle: the tl-req-1 track with its phase slices
+    req_slices = [e for e in evs if e.get("ph") == "X"
+                  and e["pid"] == PID_REQUESTS]
+    assert any(e["args"].get("request_id") == "tl-req-1"
+               for e in req_slices)
+    assert {"queued", "prefill", "decode"} <= {
+        e["name"] for e in req_slices}
+    # goodput: fenced decode/prefill step slices with bucket args
+    good = [e for e in evs if e.get("ph") == "X"
+            and e["pid"] == PID_GOODPUT]
+    assert any(e["name"] == "generation_decode" for e in good)
+    assert any("device_compute" in e.get("args", {}) for e in good)
+    # memory: the counter track (a sample is forced by the endpoint)
+    mem = [e for e in evs if e.get("ph") == "C"
+           and e["pid"] == PID_MEMORY]
+    assert any(e["name"] == "memory_bytes"
+               and e["args"].get("host_rss", 0) > 0 for e in mem)
+    # request-track rows are labeled with the request id
+    assert any(e.get("ph") == "M" and e.get("name") == "thread_name"
+               and e["args"]["name"] == "tl-req-1" for e in evs)
+
+
+def test_flight_bundle_carries_trace_sibling(tmp_path, served_engine):
+    """Every crash bundle gets a Perfetto-loadable *.trace.json next
+    to it (referenced as timeline_path) plus the memory snapshot —
+    and find_bundles never mistakes the trace for a bundle."""
+    from analytics_zoo_tpu.common.context import OrcaContext
+    from analytics_zoo_tpu.observability import flight_recorder
+
+    prev = OrcaContext.observability_dir
+    OrcaContext.observability_dir = str(tmp_path / "obs")
+    try:
+        path = flight_recorder.dump("unit_timeline")
+        assert path is not None
+        bundle = json.load(open(path))
+        trace_path = bundle["timeline_path"]
+        assert trace_path and os.path.exists(trace_path)
+        assert trace_path.endswith(".trace.json")
+        mod = _load_validator()
+        doc = json.load(open(trace_path))
+        assert mod.validate_timeline(doc) == []
+        # memory snapshot rode along (forced sample at dump time)
+        assert bundle["memory"]["latest"]["host_rss_bytes"] > 0
+        # the trace sibling is not itself listed as a bundle
+        assert all(not p.endswith(".trace.json")
+                   for p in flight_recorder.find_bundles())
+        assert path in flight_recorder.find_bundles()
+    finally:
+        OrcaContext.observability_dir = prev
