@@ -57,6 +57,7 @@ class OrcaContextMeta(type):
     _observability_dir = None
     _kernel_tuning_mode = "off"
     _kernel_tuning_cache_dir = None
+    _kv_cache_quantization = None
     _goodput_sample_every = 16
     _watchdog_deadline_s = None
     _nonfinite_watchdog = False
@@ -354,6 +355,30 @@ class OrcaContextMeta(type):
     @kernel_tuning_cache_dir.setter
     def kernel_tuning_cache_dir(cls, value):
         cls._kernel_tuning_cache_dir = None if value is None else str(value)
+
+    @property
+    def kv_cache_quantization(cls):
+        """KV-cache residency policy for the generation engine
+        (serving/generation, docs/generation.md): None (default) keeps
+        the block pool at the engine's `cache_dtype` (f32/bf16/f16);
+        "int8" stores blocks as int8 with per-token-slot symmetric
+        scales — ~1.9x block-pool residency vs f16 at equal pool
+        bytes, dequantized on read inside the paged-attention kernel.
+        Read at engine construction (an existing engine's pool dtype
+        never changes under it)."""
+        return cls._kv_cache_quantization
+
+    @kv_cache_quantization.setter
+    def kv_cache_quantization(cls, value):
+        if value is not None:
+            value = str(value).lower()
+            if value in ("none", "off"):
+                value = None
+            elif value != "int8":
+                raise ValueError(
+                    f"kv_cache_quantization must be None or 'int8', "
+                    f"got {value!r}")
+        cls._kv_cache_quantization = value
 
     @property
     def mesh(cls):

@@ -17,10 +17,15 @@ Each sample records:
   backend is already initialized (sampling never brings one up);
   0 otherwise,
 * every registered **provider**'s fields — e.g. the generation
-  engine's KV block pool registers
-  ``{"blocks_used", "blocks_capacity", "pool_bytes", "used_bytes"}``
-  under the name ``kv_pool``, flattened into the sample as
-  ``kv_pool_<field>``.
+  engine's KV block pool registers ``{"blocks_used",
+  "blocks_capacity", "pool_bytes", "used_bytes",
+  "pool_bytes_logical", "pool_bytes_physical", "used_bytes_logical",
+  "used_bytes_physical"}`` under the name ``kv_pool``, flattened into
+  the sample as ``kv_pool_<field>``.  The logical/physical split is
+  the int8 KV-quantization residency gauge: logical = the cached
+  tokens dequantized at the cache dtype, physical = bytes actually
+  resident (int8 values + per-token-slot scales) —
+  docs/generation.md.
 
 Sampling is opportunistic and time-gated: fenced goodput steps call
 `maybe_sample()` (at most one sample per

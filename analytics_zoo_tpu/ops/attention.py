@@ -6,6 +6,13 @@ with bfloat16 einsums (MXU-friendly) and float32 softmax accumulation.
 A pallas flash-attention kernel (tiled online-softmax, no materialized
 score matrix) can replace it for long sequences — same signature — via
 `use_flash=True` once `analytics_zoo_tpu.ops.pallas.flash_attention` lands.
+
+`paged_decode_attention` is the serving decode path (q_len=1 per lane
+against a paged KV block pool): the ONE dispatch point the generation
+engine routes through (enforced by scripts/check_kernel_dispatch.py),
+picking the Pallas paged kernel (block-table gather inside the kernel,
+ops/pallas/paged_attention.py) on TPU and an XLA fallback that
+bit-matches the gather+concat-attend path everywhere else.
 """
 
 from __future__ import annotations
@@ -77,3 +84,87 @@ def dot_product_attention(q, k, v, mask=None, causal: bool = False,
         probs = probs * keep / (1.0 - dropout_rate)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(compute_dtype), v)
     return out.astype(jnp.float32)
+
+
+def _paged_dequant(flat, flat_scale, tok_idx):
+    """Gather token rows from a flat [ntok, h, d] pool view and
+    dequantize when a flat [ntok] scale vector rides along."""
+    ctx = flat[tok_idx]                                  # [S, C, h, d]
+    if flat_scale is not None:
+        ctx = (ctx.astype(jnp.float32)
+               * flat_scale[tok_idx][:, :, None, None])
+    return ctx
+
+
+def paged_decode_attention(q, new_k, new_v, k_pool, v_pool,
+                           block_tables, ctx_len, *, k_scale=None,
+                           v_scale=None, impl: str = "auto",
+                           block_gather: Optional[int] = None,
+                           compute_dtype=jnp.float32,
+                           interpret: Optional[bool] = None):
+    """Decode-step attention of one new token per lane over its paged
+    KV cache — the generation engine's hot path (docs/kernels.md,
+    docs/generation.md).
+
+    q / new_k / new_v: [S, heads, head_dim] — lane S's pending token
+    (it attends to itself in addition to the cache).
+    k_pool / v_pool: [num_blocks, block_size, heads, head_dim] — the
+    paged pool (block 0 reserved as the null block).  int8 pools pass
+    `k_scale`/`v_scale` [num_blocks, block_size] f32 per-token-slot
+    dequant scales (serving/generation/kv_cache.py's quantized mode).
+    block_tables: [S, max_blocks] int32; ctx_len: [S] int32 — cached
+    position p of lane s lives at block_tables[s, p // bs], slot
+    p % bs; entries past ctx_len are masked (garbage-safe, so
+    null-table padding and mid-preemption lanes cost nothing).
+    Returns [S, heads, head_dim] float32.
+
+    impl: "auto" (Pallas on TPU, XLA elsewhere) | "pallas" | "xla".
+    The XLA fallback gathers the context and runs the exact
+    `dot_product_attention` KV-cache read path (concat-attend) — the
+    pre-paged-kernel decode path, bit for bit, which is what the
+    parity tests pin the kernel against.  `block_gather=None` asks the
+    autotuner (ops/tuning, key family "paged_decode") for the Pallas
+    kernel's gather width; `interpret=True` runs the kernel on the CPU
+    interpreter (tests)."""
+    s, h, d = q.shape
+    nb, bs = k_pool.shape[:2]
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    if impl == "auto":
+        try:
+            platform = jax.default_backend()
+        except Exception:
+            platform = "cpu"
+        impl = "pallas" if platform == "tpu" else "xla"
+    if impl == "xla":
+        flat_k = k_pool.reshape(nb * bs, h, d)
+        flat_v = v_pool.reshape(nb * bs, h, d)
+        fk_scale = (None if k_scale is None
+                    else k_scale.reshape(nb * bs).astype(jnp.float32))
+        fv_scale = (None if v_scale is None
+                    else v_scale.reshape(nb * bs).astype(jnp.float32))
+        tok_idx = (block_tables[:, :, None] * bs
+                   + jnp.arange(bs)[None, None, :]).reshape(s, -1)
+        out = dot_product_attention(
+            q[:, None], new_k[:, None], new_v[:, None],
+            compute_dtype=compute_dtype,
+            ctx_k=_paged_dequant(flat_k, fk_scale, tok_idx),
+            ctx_v=_paged_dequant(flat_v, fv_scale, tok_idx),
+            ctx_len=ctx_len)
+        return out[:, 0]
+    if impl != "pallas":
+        raise ValueError(f"unknown paged_decode_attention impl "
+                         f"{impl!r}; use 'auto', 'pallas' or 'xla'")
+    from analytics_zoo_tpu.ops.pallas.paged_attention import (
+        paged_decode_pallas,
+        tuned_paged_block_gather,
+    )
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    if block_gather is None:
+        block_gather = tuned_paged_block_gather(
+            bs, s, h, d, k_pool.dtype, mb=block_tables.shape[1])
+    return paged_decode_pallas(
+        q, new_k, new_v, k_pool, v_pool, block_tables, ctx_len,
+        k_scale=k_scale, v_scale=v_scale, block_gather=block_gather,
+        interpret=interpret)

@@ -33,6 +33,8 @@ from analytics_zoo_tpu.serving.generation.engine import (  # noqa: F401
 from analytics_zoo_tpu.serving.generation.kv_cache import (  # noqa: F401
     BlockAllocator,
     PagedKVCache,
+    dequantize_kv_tokens,
+    quantize_kv_tokens,
 )
 from analytics_zoo_tpu.serving.generation.model import (  # noqa: F401
     CausalLM,
@@ -48,4 +50,5 @@ from analytics_zoo_tpu.serving.generation.scheduler import (  # noqa: F401
 __all__ = ["BlockAllocator", "CausalLM", "GenerationEngine",
            "GenerationStream", "PagedKVCache", "QueueFull",
            "RequestTooLarge", "Sequence", "SlotScheduler",
+           "dequantize_kv_tokens", "quantize_kv_tokens",
            "sample_tokens"]

@@ -9,11 +9,16 @@ compiled program: steady-state serving triggers ZERO recompiles after
 per-sequence over power-of-two length buckets, so any prompt length
 hits one of O(log max_context) compiled programs.
 
-Paging: the engine gathers each lane's cached K/V from the pool by its
-block table (`pool[:, 0][:, token_idx]` — a plain XLA gather), hands the
-contiguous view to the model's KV-cache read path, and scatters the new
-tokens' K/V back into block slots.  Inactive lanes carry the null block
-table and scribble into block 0 (kv_cache.py).
+Paging: the decode step hands the pool and each lane's block table to
+the model's paged path, and the paged-attention kernel
+(ops/pallas/paged_attention.py via ops.attention.paged_decode_attention)
+gathers blocks by table index INSIDE the kernel — no contiguous
+[S, C, h, d] context tensor is materialized (`decode_attention=
+"concat"` keeps the legacy XLA-gather+concat path as the bench
+baseline).  New tokens' K/V are scattered back into block slots —
+quantized on write when the pool is int8 (`kv_quantization`, default
+from OrcaContext.kv_cache_quantization).  Inactive lanes carry the
+null block table and scribble into block 0 (kv_cache.py).
 
 Streaming: `submit()` returns a `GenerationStream`; the engine loop
 pushes each sampled token as it exists, so a consumer (the HTTP
@@ -42,7 +47,11 @@ from analytics_zoo_tpu.observability import (
     request_log,
     step_clock,
 )
-from analytics_zoo_tpu.serving.generation.kv_cache import PagedKVCache
+from analytics_zoo_tpu.serving.generation.kv_cache import (
+    PagedKVCache,
+    dequantize_kv_tokens,
+    quantize_kv_tokens,
+)
 from analytics_zoo_tpu.serving.generation.sampling import sample_tokens
 from analytics_zoo_tpu.serving.generation.scheduler import (
     Sequence,
@@ -117,7 +126,9 @@ class GenerationEngine:
                  prefill_buckets: Optional[Seq[int]] = None,
                  prefill_token_budget: int = 2048,
                  cache_dtype=jnp.float32, registry=None, seed: int = 0,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 kv_quantization: str = "auto",
+                 decode_attention: str = "paged"):
         if model.max_position_len < max_context:
             raise ValueError(
                 f"model.max_position_len {model.max_position_len} < "
@@ -126,12 +137,33 @@ class GenerationEngine:
         self.params = jax.device_put(params)
         self.max_slots = max_slots
         self.max_context = max_context
+        if decode_attention not in ("paged", "concat"):
+            raise ValueError(
+                f"decode_attention must be 'paged' or 'concat', got "
+                f"{decode_attention!r}")
+        #: "paged" (default) routes the decode step through
+        #: ops.attention.paged_decode_attention (block-table gather
+        #: inside the kernel on TPU); "concat" keeps the legacy
+        #: gather+concat-attend path (the bench baseline / parity
+        #: oracle)
+        self.decode_attention = decode_attention
+        if kv_quantization == "auto":
+            from analytics_zoo_tpu.common.context import OrcaContext
+            kv_quantization = OrcaContext.kv_cache_quantization
+        self.kv_quantization = kv_quantization
+        self._quantized = kv_quantization == "int8"
         if num_blocks is None:
             # comfortable default: every lane can hold a full context
             num_blocks = max_slots * (-(-max_context // block_size)) + 1
         self.cache = PagedKVCache(
             model.n_block, num_blocks, block_size, model.n_head,
-            model.hidden_size // model.n_head, dtype=cache_dtype)
+            model.hidden_size // model.n_head, dtype=cache_dtype,
+            quantization=kv_quantization)
+        #: functional scale state fed to the jitted steps alongside
+        #: `cache.kv` — a 1-element placeholder when quantization is
+        #: off (the steps return it untouched)
+        self._kv_scale = (self.cache.kv_scale if self._quantized
+                          else jnp.zeros((1,), jnp.float32))
         if prefill_buckets is None:
             prefill_buckets = []
             b = min(16, max_context)
@@ -208,12 +240,23 @@ class GenerationEngine:
     def _kv_pool_stats(self):
         alloc = self.cache.allocator
         used = alloc.capacity - alloc.available()
-        pool_bytes = self.cache.nbytes
+        nb = self.cache.num_blocks
+        # logical = bytes the cached tokens represent dequantized at
+        # the cache dtype; physical = bytes actually resident (int8
+        # values + scale vectors).  Both ride the memory_kv_pool_*
+        # gauge family so the quantization residency win is a live
+        # number, not a datasheet claim (docs/observability.md).
+        logical = self.cache.logical_nbytes
+        physical = self.cache.physical_nbytes
         return {
             "blocks_used": used,
             "blocks_capacity": alloc.capacity,
-            "pool_bytes": pool_bytes,
-            "used_bytes": pool_bytes * used // self.cache.num_blocks,
+            "pool_bytes": physical,
+            "used_bytes": physical * used // nb,
+            "pool_bytes_logical": logical,
+            "pool_bytes_physical": physical,
+            "used_bytes_logical": logical * used // nb,
+            "used_bytes_physical": physical * used // nb,
         }
 
     # ------------------------------------------------------------------
@@ -223,12 +266,36 @@ class GenerationEngine:
     def _build_steps(self) -> None:
         model = self.model
         bs = self.cache.block_size
+        nb = self.cache.num_blocks
         max_pos = model.max_position_len
-        # buffer donation lets XLA update the KV pool in place; the CPU
-        # backend ignores donation and warns, so only donate off-CPU
-        donate = ((1,) if jax.devices()[0].platform != "cpu" else ())
+        quantized = self._quantized
+        paged = self.decode_attention == "paged"
+        # buffer donation lets XLA update the KV pool (and its scale
+        # vectors) in place; the CPU backend ignores donation and
+        # warns, so only donate off-CPU
+        donate = ((1, 2) if jax.devices()[0].platform != "cpu" else ())
 
-        def prefill(params, kv, tokens, length, block_table,
+        def write_kv(kv, kv_scale, dest, new_k, new_v):
+            # new_k/new_v [L, n, h, d] at token destinations dest [n];
+            # int8 mode quantizes on block write (per-token-slot
+            # symmetric scales — kv_cache.quantize_kv_tokens), so a
+            # dequantized pool never exists and appends never touch
+            # already-written slots
+            if quantized:
+                qk, sk = quantize_kv_tokens(new_k)
+                qv, sv = quantize_kv_tokens(new_v)
+                kv = kv.at[:, 0, dest].set(qk)
+                kv = kv.at[:, 1, dest].set(qv)
+                kv_scale = kv_scale.at[:, 0, dest].set(sk)
+                kv_scale = kv_scale.at[:, 1, dest].set(sv)
+            else:
+                kv = kv.at[:, 0, dest].set(
+                    new_k.astype(kv.dtype))
+                kv = kv.at[:, 1, dest].set(
+                    new_v.astype(kv.dtype))
+            return kv, kv_scale
+
+        def prefill(params, kv, kv_scale, tokens, length, block_table,
                     temperature, top_k, rng):
             # tokens [1, B] (bucket-padded), length scalar, block_table
             # [max_blocks]; writes KV for the `length` real tokens and
@@ -242,37 +309,63 @@ class GenerationEngine:
             dest = block_table[jnp.arange(B) // bs] * bs \
                 + jnp.arange(B) % bs
             dest = jnp.where(jnp.arange(B) < length, dest, 0)
-            kv = kv.at[:, 0, dest].set(new_k[:, 0])
-            kv = kv.at[:, 1, dest].set(new_v[:, 0])
+            kv, kv_scale = write_kv(kv, kv_scale, dest,
+                                    new_k[:, 0], new_v[:, 0])
             last = logits[0, length - 1]
             nxt = sample_tokens(last[None], rng, temperature, top_k)[0]
-            return kv, nxt, last
+            return kv, kv_scale, nxt, last
 
-        def decode(params, kv, tokens, block_tables, ctx_len, active,
-                   temperature, top_k, rng):
+        def decode(params, kv, kv_scale, tokens, block_tables, ctx_len,
+                   active, temperature, top_k, rng):
             # ONE static-shape step for all lanes: tokens [S] (each
             # lane's pending token), ctx_len [S] (= its position),
             # block_tables [S, max_blocks], active [S] lane mask
             S, MB = block_tables.shape
-            tok_idx = (block_tables[:, :, None] * bs
-                       + jnp.arange(bs)[None, None, :]).reshape(S, -1)
-            ctx_k = kv[:, 0][:, tok_idx]        # [L, S, C, h, d]
-            ctx_v = kv[:, 1][:, tok_idx]
             pos = jnp.minimum(ctx_len, max_pos - 1)
-            logits, new_k, new_v = model.apply(
-                {"params": params}, tokens[:, None], pos[:, None],
-                ctx_k=ctx_k, ctx_v=ctx_v, ctx_len=ctx_len)
+            if paged:
+                # the block table rides into the attention op; the
+                # kernel gathers pool blocks by table index itself
+                # (ops/pallas/paged_attention.py) — no [S, C, h, d]
+                # context tensor is ever materialized
+                kvp = kv.reshape(kv.shape[0], 2, nb, bs,
+                                 *kv.shape[-2:])
+                scl = (kv_scale.reshape(kv.shape[0], 2, nb, bs)
+                       if quantized else None)
+                logits, new_k, new_v = model.apply(
+                    {"params": params}, tokens[:, None], pos[:, None],
+                    kv_pool=kvp, kv_scale=scl,
+                    block_tables=block_tables, ctx_len=ctx_len)
+            else:
+                tok_idx = (block_tables[:, :, None] * bs
+                           + jnp.arange(bs)[None, None, :]
+                           ).reshape(S, -1)
+                ctx_k = kv[:, 0][:, tok_idx]    # [L, S, C, h, d]
+                ctx_v = kv[:, 1][:, tok_idx]
+                if quantized:
+                    ctx_k = dequantize_kv_tokens(
+                        ctx_k, kv_scale[:, 0][:, tok_idx])
+                    ctx_v = dequantize_kv_tokens(
+                        ctx_v, kv_scale[:, 1][:, tok_idx])
+                logits, new_k, new_v = model.apply(
+                    {"params": params}, tokens[:, None], pos[:, None],
+                    ctx_k=ctx_k, ctx_v=ctx_v, ctx_len=ctx_len)
             dest = block_tables[jnp.arange(S), ctx_len // bs] * bs \
                 + ctx_len % bs
             dest = jnp.where(active, dest, 0)   # dead lanes → null block
-            kv = kv.at[:, 0, dest].set(new_k[:, :, 0])
-            kv = kv.at[:, 1, dest].set(new_v[:, :, 0])
+            kv, kv_scale = write_kv(kv, kv_scale, dest,
+                                    new_k[:, :, 0], new_v[:, :, 0])
             last = jnp.where(active[:, None], logits[:, 0], 0.0)
             nxt = sample_tokens(last, rng, temperature, top_k)
-            return kv, nxt, last
+            return kv, kv_scale, nxt, last
 
         self._prefill_jit = jax.jit(prefill, donate_argnums=donate)
         self._decode_jit = jax.jit(decode, donate_argnums=donate)
+
+    def _store_kv_state(self, kv, kv_scale) -> None:
+        self.cache.kv = kv
+        self._kv_scale = kv_scale
+        if self._quantized:
+            self.cache.kv_scale = kv_scale
 
     @property
     def decode_compile_count(self) -> int:
@@ -290,16 +383,19 @@ class GenerationEngine:
             one = jnp.zeros(1, jnp.float32)
             onek = jnp.zeros(1, jnp.int32)
             for b in self.scheduler.prefill_buckets:
-                self.cache.kv, _, _ = self._prefill_jit(
-                    self.params, self.cache.kv,
+                kv, scl, _, _ = self._prefill_jit(
+                    self.params, self.cache.kv, self._kv_scale,
                     jnp.zeros((1, b), jnp.int32), jnp.int32(1),
                     jnp.zeros(MB, jnp.int32), one, onek, self._rng)
+                self._store_kv_state(kv, scl)
             S = self.max_slots
-            self.cache.kv, _, _ = self._decode_jit(
-                self.params, self.cache.kv, jnp.zeros(S, jnp.int32),
+            kv, scl, _, _ = self._decode_jit(
+                self.params, self.cache.kv, self._kv_scale,
+                jnp.zeros(S, jnp.int32),
                 jnp.zeros((S, MB), jnp.int32), jnp.zeros(S, jnp.int32),
                 jnp.zeros(S, bool), jnp.zeros(S, jnp.float32),
                 jnp.zeros(S, jnp.int32), self._rng)
+            self._store_kv_state(kv, scl)
             # everything above compiled here: live traffic is warm
             self._goodput_warm.add("decode")
             self._goodput_warm.update(
@@ -399,11 +495,12 @@ class GenerationEngine:
         rec.lap("host_input")
         t0 = now()
         rec.cold = ("prefill", bucket) not in self._goodput_warm
-        self.cache.kv, nxt, _ = self._prefill_jit(
-            self.params, self.cache.kv, jnp.asarray(tokens),
-            jnp.int32(L), jnp.asarray(table),
+        kv, scl, nxt, _ = self._prefill_jit(
+            self.params, self.cache.kv, self._kv_scale,
+            jnp.asarray(tokens), jnp.int32(L), jnp.asarray(table),
             jnp.full(1, seq.temperature, jnp.float32),
             jnp.full(1, seq.top_k, jnp.int32), self._next_rng())
+        self._store_kv_state(kv, scl)
         rec.lap(None)
         nxt = int(nxt)            # token fetch = device fence
         rec.lap("device_compute")
@@ -439,11 +536,12 @@ class GenerationEngine:
         rec.lap("host_input")
         t0 = now()
         rec.cold = "decode" not in self._goodput_warm
-        self.cache.kv, nxt, _ = self._decode_jit(
-            self.params, self.cache.kv, jnp.asarray(tokens),
-            jnp.asarray(tables), jnp.asarray(ctx_len),
-            jnp.asarray(active), jnp.asarray(temp),
-            jnp.asarray(top_k), self._next_rng())
+        kv, scl, nxt, _ = self._decode_jit(
+            self.params, self.cache.kv, self._kv_scale,
+            jnp.asarray(tokens), jnp.asarray(tables),
+            jnp.asarray(ctx_len), jnp.asarray(active),
+            jnp.asarray(temp), jnp.asarray(top_k), self._next_rng())
+        self._store_kv_state(kv, scl)
         rec.lap(None)
         nxt = np.asarray(nxt)     # token fetch = device fence
         rec.lap("device_compute")

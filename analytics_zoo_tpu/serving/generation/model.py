@@ -2,12 +2,14 @@
 
 A small GPT-style stack (token+position embeds, post-LN blocks like
 `keras.layers.self_attention.TransformerBlock`, tied-free Dense head)
-whose attention is `ops.attention.dot_product_attention` in BOTH modes:
-full causal self-attention for prefill, and the KV-cache read path
-(`ctx_k/ctx_v/ctx_len`) for decode.  Every call also RETURNS the new
-tokens' per-layer keys/values — the model never touches the paged pool;
-the engine scatters them into block slots outside (model.py stays pure,
-paging stays in engine.py).
+whose attention routes through `ops.attention` in EVERY mode: full
+causal self-attention for prefill, `paged_decode_attention` (the
+Pallas paged kernel / its bit-matching XLA fallback) for decode over
+the block pool, and the legacy concat read path (`ctx_k/ctx_v`) kept
+as the parity oracle.  Every call also RETURNS the new tokens'
+per-layer keys/values — the model never WRITES the paged pool; the
+engine quantizes (int8 mode) and scatters them into block slots
+outside (model.py stays pure, paging stays in engine.py).
 
 compute_dtype defaults to float32 so KV-cached decode matches the
 full-sequence recompute to tight fp tolerance (tested); serve bf16 on a
@@ -16,10 +18,16 @@ real TPU by passing compute_dtype=jnp.bfloat16.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax.numpy as jnp
 
-from analytics_zoo_tpu.ops.attention import dot_product_attention
+from analytics_zoo_tpu.ops.attention import (
+    dot_product_attention,
+    paged_decode_attention,
+)
+from analytics_zoo_tpu.ops.normalization import LayerNorm
 
 
 class CausalLM(nn.Module):
@@ -28,9 +36,19 @@ class CausalLM(nn.Module):
 
     Prefill: pass `token_mask` [batch, t] (1 = real token) and no ctx —
     full causal attention over the (bucket-padded) prompt.
-    Decode: pass `ctx_k`/`ctx_v` [n_block, batch, ctx, heads, head_dim]
-    (gathered from the paged pool) and `ctx_len` [batch] — the new
-    tokens attend over [cache ; themselves]."""
+    Paged decode (t == 1): pass `kv_pool` [n_block, 2, num_blocks,
+    block_size, heads, head_dim] (the engine's pool, block-major view),
+    `block_tables` [batch, max_blocks], `ctx_len` [batch] — and
+    `kv_scale` [n_block, 2, num_blocks, block_size] when the pool is
+    int8 — each new token attends over [its block table ; itself]
+    through `ops.attention.paged_decode_attention`.
+    Concat decode (parity oracle): pass `ctx_k`/`ctx_v` [n_block,
+    batch, ctx, heads, head_dim] (gathered from the pool) and
+    `ctx_len` [batch].
+
+    `paged_attention_impl` pins the paged dispatch ("pallas"/"xla";
+    None = auto: Pallas on TPU) — tests use "pallas" to drive the real
+    kernel through the CPU interpreter."""
 
     vocab: int
     hidden_size: int = 64
@@ -39,11 +57,16 @@ class CausalLM(nn.Module):
     intermediate_size: int = 256
     max_position_len: int = 2048
     compute_dtype: jnp.dtype = jnp.float32
+    paged_attention_impl: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids, positions, token_mask=None,
-                 ctx_k=None, ctx_v=None, ctx_len=None):
+                 ctx_k=None, ctx_v=None, ctx_len=None,
+                 kv_pool=None, kv_scale=None, block_tables=None):
         b, t = input_ids.shape
+        if kv_pool is not None and t != 1:
+            raise ValueError("the paged decode path is q_len=1 per "
+                             f"lane; got t={t}")
         h = self.n_head
         hd = self.hidden_size // h
         x = nn.Embed(self.vocab, self.hidden_size,
@@ -51,7 +74,7 @@ class CausalLM(nn.Module):
         x = x + nn.Embed(self.max_position_len, self.hidden_size,
                          name="position_embed"
                          )(positions.astype(jnp.int32))
-        x = nn.LayerNorm(name="embed_ln")(x)
+        x = LayerNorm(name="embed_ln")(x)
 
         additive_mask = None
         if token_mask is not None:
@@ -69,7 +92,18 @@ class CausalLM(nn.Module):
             # raw per-token keys/values before attention consumes them
             new_k.append(k.astype(jnp.float32))
             new_v.append(v.astype(jnp.float32))
-            if ctx_k is not None:
+            if kv_pool is not None:
+                a = paged_decode_attention(
+                    q[:, 0], k[:, 0], v[:, 0],
+                    kv_pool[i, 0], kv_pool[i, 1], block_tables,
+                    ctx_len,
+                    k_scale=(None if kv_scale is None
+                             else kv_scale[i, 0]),
+                    v_scale=(None if kv_scale is None
+                             else kv_scale[i, 1]),
+                    impl=self.paged_attention_impl or "auto",
+                    compute_dtype=self.compute_dtype)[:, None]
+            elif ctx_k is not None:
                 a = dot_product_attention(
                     q, k, v, compute_dtype=self.compute_dtype,
                     ctx_k=ctx_k[i], ctx_v=ctx_v[i], ctx_len=ctx_len)
@@ -80,14 +114,14 @@ class CausalLM(nn.Module):
             a = nn.Dense(self.hidden_size, dtype=self.compute_dtype,
                          name=f"{blk}_proj")(
                              a.reshape(b, t, self.hidden_size))
-            x = nn.LayerNorm(name=f"{blk}_ln1")(x + a.astype(x.dtype))
+            x = LayerNorm(name=f"{blk}_ln1")(x + a.astype(x.dtype))
             f = nn.Dense(self.intermediate_size,
                          dtype=self.compute_dtype,
                          name=f"{blk}_fc1")(x)
             f = nn.gelu(f)
             f = nn.Dense(self.hidden_size, dtype=self.compute_dtype,
                          name=f"{blk}_fc2")(f)
-            x = nn.LayerNorm(name=f"{blk}_ln2")(x + f.astype(x.dtype))
+            x = LayerNorm(name=f"{blk}_ln2")(x + f.astype(x.dtype))
 
         logits = nn.Dense(self.vocab, name="lm_head")(x)
         return (logits.astype(jnp.float32),

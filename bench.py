@@ -590,6 +590,27 @@ def attn_kernel_utilization(iters: int = 10):
         out["bias_gelu_pallas_speedup_h768"] = round(speedup, 3)
     except Exception as e:
         out["fused_kernel_bench_error"] = f"{type(e).__name__}: {e}"[:120]
+    # decode-shaped tuning (the paged_decode key family): search the
+    # block-gather candidates on a real TPU (winners persist like the
+    # flash keys); off-TPU resolve lookup-only — the backend gate
+    # again, searching interpret-mode Pallas on CPU is a hang
+    try:
+        from analytics_zoo_tpu.ops.pallas.paged_attention import (
+            tune_paged_decode, tuned_paged_block_gather)
+        if on_tpu:
+            g_bf16 = tune_paged_decode(16, 8, 8, 64, jnp.bfloat16)
+            g_int8 = tune_paged_decode(16, 8, 8, 64, jnp.int8)
+        else:
+            g_bf16 = tuned_paged_block_gather(16, 8, 8, 64,
+                                              jnp.bfloat16,
+                                              allow_search=False)
+            g_int8 = tuned_paged_block_gather(16, 8, 8, 64, jnp.int8,
+                                              allow_search=False)
+        out["paged_decode_block_gather_bs16_d64"] = g_bf16
+        out["paged_decode_block_gather_bs16_d64_int8"] = g_int8
+    except Exception as e:
+        out["paged_decode_tuning_error"] = \
+            f"{type(e).__name__}: {e}"[:120]
     return out
 
 
@@ -759,7 +780,15 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
     request lifecycle log, the per-request TTFT/TPOT p50/p99 each mode
     delivered (the SLO-facing decomposition: continuous batching wins
     on TTFT because nobody waits for a group barrier).  Asserts the
-    lifecycle invariant TTFT <= e2e on every request."""
+    lifecycle invariant TTFT <= e2e on every request.
+
+    PR 6 adds the decode-path decomposition on the same mixed
+    workload: paged-attention decode vs the legacy gather+concat path
+    (`paged_vs_concat_tokens_per_sec`, asserting the paged path's TPOT
+    p50 is no worse within noise), and an f16-pool vs int8-quantized-
+    pool pair (`kv_bytes_per_token_{f16,int8}`, asserting the >= 1.8x
+    block residency win off the physical-bytes gauge and TPOT parity
+    within noise)."""
     import jax
     import jax.numpy as jnp
 
@@ -783,18 +812,19 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
     reqs = [(list(rng.integers(0, 512, int(l))), int(n))
             for l, n in zip(lens, news)]
 
-    def run(mode: str):
+    def run(mode: str, engine=None):
+        engine = eng if engine is None else engine
         t0 = time.monotonic()
         if mode == "continuous":
-            streams = [eng.submit(p, max_new_tokens=n)
+            streams = [engine.submit(p, max_new_tokens=n)
                        for p, n in reqs]
-            eng.run_until_idle()
+            engine.run_until_idle()
         else:
             streams = []
             for g in range(0, len(reqs), slots):
-                batch = [eng.submit(p, max_new_tokens=n)
+                batch = [engine.submit(p, max_new_tokens=n)
                          for p, n in reqs[g:g + slots]]
-                eng.run_until_idle()     # group barrier = static
+                engine.run_until_idle()  # group barrier = static
                 streams.extend(batch)
         wall = time.monotonic() - t0
         tokens = sum(len(s.tokens()) for s in streams)
@@ -836,6 +866,52 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
     cont_tput, cont_streams = run("continuous")
     cont_lat = request_latencies(cont_streams, "continuous")
     static_lat = request_latencies(static_streams, "static")
+
+    # ---- paged vs concat decode path, same workload, same params ----
+    eng_concat = GenerationEngine(model, params, max_slots=slots,
+                                  block_size=16, max_context=576,
+                                  decode_attention="concat")
+    eng_concat.warmup()
+    concat_tput, concat_streams = run("continuous", eng_concat)
+    concat_lat = request_latencies(concat_streams, "concat")
+    if cont_lat["tpot_p50_ms"] > concat_lat["tpot_p50_ms"] * 1.10:
+        raise RuntimeError(
+            f"paged decode TPOT p50 {cont_lat['tpot_p50_ms']}ms worse "
+            f"than the concat path's {concat_lat['tpot_p50_ms']}ms "
+            "beyond noise — the kernel lost to the path it replaces")
+
+    # ---- f16 pool vs int8-quantized pool (residency + TPOT) ----
+    eng_f16 = GenerationEngine(model, params, max_slots=slots,
+                               block_size=16, max_context=576,
+                               cache_dtype=jnp.float16)
+    eng_f16.warmup()
+    f16_tput, f16_streams = run("continuous", eng_f16)
+    f16_lat = request_latencies(f16_streams, "paged_f16")
+    eng_int8 = GenerationEngine(model, params, max_slots=slots,
+                                block_size=16, max_context=576,
+                                cache_dtype=jnp.float16,
+                                kv_quantization="int8")
+    eng_int8.warmup()
+    int8_tput, int8_streams = run("continuous", eng_int8)
+    int8_lat = request_latencies(int8_streams, "paged_int8")
+    if eng_int8.decode_compile_count != 1:
+        raise RuntimeError(
+            f"int8 decode compiled {eng_int8.decode_compile_count}x — "
+            "quantized writes broke the one-static-shape contract")
+    # residency off the live physical-bytes gauge fields: logical =
+    # what these tokens cost at f16, physical = int8 values + scales
+    int8_stats = eng_int8._kv_pool_stats()
+    residency = (int8_stats["pool_bytes_logical"]
+                 / int8_stats["pool_bytes_physical"])
+    if residency < 1.8:
+        raise RuntimeError(
+            f"int8 pool residency {residency:.2f}x vs f16 < 1.8x")
+    if int8_lat["tpot_p50_ms"] > f16_lat["tpot_p50_ms"] * 1.15:
+        raise RuntimeError(
+            f"int8 TPOT p50 {int8_lat['tpot_p50_ms']}ms worse than "
+            f"the f16 paged path's {f16_lat['tpot_p50_ms']}ms beyond "
+            "noise")
+    ntok = eng_int8.cache.num_blocks * eng_int8.cache.block_size
     return {
         "generation_continuous_tokens_per_sec": round(cont_tput, 1),
         "generation_static_tokens_per_sec": round(static_tput, 1),
@@ -854,6 +930,26 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
         "generation_static_ttft_p99_ms": static_lat["ttft_p99_ms"],
         "generation_static_tpot_p50_ms": static_lat["tpot_p50_ms"],
         "generation_static_tpot_p99_ms": static_lat["tpot_p99_ms"],
+        # decode-path decomposition (PR 6): paged kernel vs the
+        # gather+concat path it replaced, on identical traffic
+        "paged_vs_concat_tokens_per_sec": round(
+            cont_tput / concat_tput, 3),
+        "generation_concat_tokens_per_sec": round(concat_tput, 1),
+        "generation_concat_tpot_p50_ms": concat_lat["tpot_p50_ms"],
+        "generation_concat_tpot_p99_ms": concat_lat["tpot_p99_ms"],
+        # KV residency: physical bytes per pool token slot, f16 pool
+        # vs int8 pool (values + per-token-slot scales)
+        "kv_bytes_per_token_f16":
+            eng_f16.cache.physical_nbytes // ntok,
+        "kv_bytes_per_token_int8":
+            eng_int8.cache.physical_nbytes // ntok,
+        "kv_int8_residency_vs_f16": round(residency, 3),
+        "generation_f16_tpot_p50_ms": f16_lat["tpot_p50_ms"],
+        "generation_f16_tpot_p99_ms": f16_lat["tpot_p99_ms"],
+        "generation_int8_tpot_p50_ms": int8_lat["tpot_p50_ms"],
+        "generation_int8_tpot_p99_ms": int8_lat["tpot_p99_ms"],
+        "generation_int8_tokens_per_sec": round(int8_tput, 1),
+        "generation_f16_tokens_per_sec": round(f16_tput, 1),
     }
 
 
@@ -958,11 +1054,13 @@ def main():
 
     generation = {}
     try:
-        # continuous-vs-static generation (several hundred decode
-        # dispatches: ~10s local, ~1-2 min over a tunneled device) —
-        # last in the ledger, never at the primary metric's expense
+        # continuous-vs-static generation plus the PR 6 decode-path
+        # decomposition (paged vs concat, f16 vs int8 pools — four
+        # engines, a few hundred decode dispatches each: ~40s local,
+        # longer over a tunneled device) — last in the ledger, never
+        # at the primary metric's expense
         remaining = budget - (time.monotonic() - t_start)
-        if remaining < 120:
+        if remaining < 150:
             raise TimeoutError(f"only {remaining:.0f}s left")
         generation = generation_metrics()
     except Exception as e:

@@ -1,15 +1,16 @@
 #!/usr/bin/env python
-"""Lint: models and keras layers must route attention and LayerNorm
-through the `ops` dispatch layer.
+"""Lint: models, keras layers AND the generation decode path must
+route attention and LayerNorm through the `ops` dispatch layer.
 
 The fused Pallas kernels (flash attention, fused LayerNorm, the
-bias+GELU epilogue — docs/kernels.md) only reach a model if it goes
-through the dispatch points (`ops.attention`, `ops.pallas.flash_attention`,
-`ops.normalization.layer_norm`/`LayerNorm`, `ops.dense`): an ad-hoc
-`flax.linen.LayerNorm` or a hand-rolled scores-softmax einsum silently
-opts that model out of every kernel win AND out of the autotuner.
-This check fails the build when such a reimplementation appears under
-`analytics_zoo_tpu/models/` or `analytics_zoo_tpu/keras/layers/`:
+bias+GELU epilogue, paged decode attention — docs/kernels.md) only
+reach a model if it goes through the dispatch points (`ops.attention`,
+`ops.pallas.flash_attention`, `ops.normalization.layer_norm`/
+`LayerNorm`, `ops.dense`): an ad-hoc `flax.linen.LayerNorm` or a
+hand-rolled scores-softmax einsum silently opts that model out of
+every kernel win AND out of the autotuner.  This check fails the build
+when such a reimplementation appears under `analytics_zoo_tpu/models/`
+or `analytics_zoo_tpu/keras/layers/`:
 
   * `nn.LayerNorm(` / `linen.LayerNorm(` / `import ... LayerNorm` —
     use `analytics_zoo_tpu.ops.normalization.LayerNorm` (same params).
@@ -17,6 +18,15 @@ This check fails the build when such a reimplementation appears under
     "bhqk,bkhd" combine) — use `ops.attention.dot_product_attention`
     or `ops.pallas.flash_attention` (string mentions in docstrings
     count too: the signature IS the reimplementation).
+
+`analytics_zoo_tpu/serving/generation/` (the decode hot path) is held
+to the same einsum rule PLUS a stricter one: no direct Pallas imports
+(`ops.pallas.*`, `jax.experimental.pallas`, `pallas_call`).  Decode
+attention must go through `ops.attention.paged_decode_attention` /
+`dot_product_attention` — a raw concat-attend einsum or a privately
+wired kernel in the engine would silently bitrot the decode path off
+the tuned paged kernel (or pin it to one kernel version), invisible to
+every parity test that pins ops/.
 
 Run directly (`python scripts/check_kernel_dispatch.py`) or via the
 tier-1 wrapper `tests/test_kernel_dispatch.py`.  Exit code 0 = clean.
@@ -30,11 +40,6 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO, "analytics_zoo_tpu")
-#: directories whose code must dispatch through ops/
-SCANNED_DIRS = (
-    os.path.join(PACKAGE, "models"),
-    os.path.join(PACKAGE, "keras", "layers"),
-)
 
 PATTERNS = (
     (re.compile(r"\bnn\.LayerNorm\s*\("),
@@ -48,10 +53,35 @@ PATTERNS = (
      "ops.pallas.flash_attention"),
 )
 
+#: the decode path additionally may not wire kernels privately — the
+#: ops.attention dispatch layer is where impl choice, the autotuner
+#: and the XLA fallback live
+GENERATION_PATTERNS = PATTERNS + (
+    (re.compile(r"ops\.pallas\b"),
+     "import nothing from ops.pallas here — dispatch through "
+     "ops.attention.paged_decode_attention"),
+    (re.compile(r"jax\.experimental[.\s]+import\s+pallas"
+                r"|jax\.experimental\.pallas|\bpallas_call\b"),
+     "no raw Pallas in the decode path — dispatch through "
+     "ops.attention.paged_decode_attention"),
+)
+
+#: directories whose code must dispatch through ops/, with the pattern
+#: set each is held to
+SCANNED = (
+    (os.path.join(PACKAGE, "models"), PATTERNS),
+    (os.path.join(PACKAGE, "keras", "layers"), PATTERNS),
+    (os.path.join(PACKAGE, "serving", "generation"),
+     GENERATION_PATTERNS),
+)
+
+#: back-compat alias (tests iterate SCANNED_DIRS)
+SCANNED_DIRS = tuple(root for root, _pats in SCANNED)
+
 
 def find_violations():
     violations = []
-    for root in SCANNED_DIRS:
+    for root, patterns in SCANNED:
         for dirpath, _dirnames, filenames in os.walk(root):
             for fn in sorted(filenames):
                 if not fn.endswith(".py"):
@@ -59,7 +89,7 @@ def find_violations():
                 path = os.path.join(dirpath, fn)
                 with open(path, encoding="utf-8") as f:
                     for lineno, line in enumerate(f, 1):
-                        for pat, fix in PATTERNS:
+                        for pat, fix in patterns:
                             if pat.search(line):
                                 violations.append(
                                     (os.path.relpath(path, REPO),

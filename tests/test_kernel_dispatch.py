@@ -2,7 +2,10 @@
 red if models/ or keras/layers/ grow an ad-hoc `nn.LayerNorm` or a
 hand-rolled attention-scores einsum instead of routing through the
 `ops` dispatch layer (which is where the fused Pallas kernels and the
-autotuner live — docs/kernels.md)."""
+autotuner live — docs/kernels.md), or if serving/generation/ (the
+decode hot path) grows a raw concat-attend einsum or a direct Pallas
+import instead of dispatching through
+`ops.attention.paged_decode_attention`."""
 
 import os
 import subprocess
@@ -46,3 +49,25 @@ def test_lint_detects_violation():
     assert not matches(
         "from analytics_zoo_tpu.ops.normalization import LayerNorm")
     assert not matches("out = dot_product_attention(q, k, v)")
+
+    # the decode path's stricter set: raw einsums AND direct Pallas
+    # imports are both reimplementations there
+    def gen_matches(line):
+        return any(pat.search(line)
+                   for pat, _fix in mod.GENERATION_PATTERNS)
+
+    assert gen_matches('s = jnp.einsum("bqhd,bkhd->bhqk", q, keys)')
+    assert gen_matches(
+        "from analytics_zoo_tpu.ops.pallas.paged_attention "
+        "import paged_decode_pallas")
+    assert gen_matches("from jax.experimental import pallas as pl")
+    assert gen_matches("out = pl.pallas_call(kernel, ...)(x)")
+    # the sanctioned decode dispatch stays legal
+    assert not gen_matches(
+        "from analytics_zoo_tpu.ops.attention import "
+        "paged_decode_attention")
+    assert not gen_matches("a = paged_decode_attention(q, k, v, kp, "
+                           "vp, tables, ctx_len)")
+    # serving/generation IS scanned
+    assert any(r.endswith(os.path.join("serving", "generation"))
+               for r in mod.SCANNED_DIRS)
