@@ -64,6 +64,9 @@ class OrcaContextMeta(type):
     _slo_targets = None
     _request_log_size = 256
     _memory_sample_interval_s = 1.0
+    _fault_plan = None
+    _background_checkpointing = False
+    _slo_shed_attainment = None
 
     # --- TPU runtime state ---
     _mesh = None
@@ -324,6 +327,62 @@ class OrcaContextMeta(type):
                 "memory_sample_interval_s must be >= 0 or None")
         cls._memory_sample_interval_s = (None if value is None
                                          else float(value))
+
+    @property
+    def fault_plan(cls):
+        """Armed fault-injection plan (resilience/faults.py;
+        docs/fault-tolerance.md).  None (default) leaves every
+        injection site a no-op.  Accepts a `FaultPlan` or its dict
+        form, ``{"seed": 0, "faults": [{"site": ..., "action": ...,
+        "at": N, "times": 1}, ...]}``; firing is deterministic in the
+        plan (hit indices / seeded probabilities), never wall time.
+        Arming a plan changes NO jitted program — the zero-recompile
+        contracts hold with faults armed."""
+        return cls._fault_plan
+
+    @fault_plan.setter
+    def fault_plan(cls, value):
+        if value is None:
+            cls._fault_plan = None
+            return
+        from analytics_zoo_tpu.resilience.faults import FaultPlan
+        cls._fault_plan = FaultPlan.from_config(value)
+
+    @property
+    def background_checkpointing(cls):
+        """True routes Estimator trigger saves through the
+        `BackgroundCheckpointer` (resilience/checkpointing.py): the
+        critical path pays one device->host snapshot, the atomic
+        write->rename->commit-marker protocol runs on a writer thread,
+        and the save cost shows up in the goodput ``checkpoint``
+        bucket leaving the step wall.  False (default) keeps saves
+        synchronous (still committed via the same atomic protocol)."""
+        return cls._background_checkpointing
+
+    @background_checkpointing.setter
+    def background_checkpointing(cls, value):
+        cls._background_checkpointing = bool(value)
+
+    @property
+    def slo_shed_attainment(cls):
+        """SLO-aware overload shedding threshold for the generation
+        engine (None = off, the default).  When set (0 < x <= 1) and
+        `slo_targets` are configured, `GenerationEngine.submit` sheds
+        new requests (QueueFull -> HTTP 503 with Retry-After) while
+        the rolling SLO attainment is below the threshold and the
+        waiting queue is at least `slo_shed_min_queue` deep — load is
+        turned away by the latency objective it would violate, not by
+        a blind `max_queue` constant."""
+        return cls._slo_shed_attainment
+
+    @slo_shed_attainment.setter
+    def slo_shed_attainment(cls, value):
+        if value is not None:
+            value = float(value)
+            if not 0.0 < value <= 1.0:
+                raise ValueError(
+                    "slo_shed_attainment must be in (0, 1] or None")
+        cls._slo_shed_attainment = value
 
     @property
     def kernel_tuning_mode(cls):

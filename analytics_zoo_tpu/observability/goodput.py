@@ -18,6 +18,12 @@ loop (`spmd_train`, `spmd_eval`, `generation_prefill`,
                            attributed explicitly by their call sites
                            (multi-host barriers; 0 on single-process
                            runs)
+* ``checkpoint``         — save cost paid ON the hot loop's critical
+                           path: the full committed write for sync
+                           saves, only the device->host snapshot +
+                           enqueue when background checkpointing is
+                           armed (the shrinkage of this bucket IS the
+                           async win — bench asserts it)
 * ``overhead``           — everything else: Python dispatch, scheduler
                            bookkeeping, metric accumulation
 
@@ -54,7 +60,7 @@ from analytics_zoo_tpu.observability.registry import (
 )
 
 BUCKETS = ("compile", "host_input", "device_compute",
-           "blocked_collective", "overhead")
+           "blocked_collective", "checkpoint", "overhead")
 
 #: bounded ring of FENCED step slices ({clock, ts (wall), dur_s,
 #: buckets, cold}) — what observability/timeline.py exports as goodput

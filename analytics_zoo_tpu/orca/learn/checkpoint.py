@@ -2,60 +2,84 @@
 `find_latest_checkpoint`, /root/reference/pyzoo/zoo/orca/learn/utils.py:24,
 and the DP-1 retry-restore loop, Topology.scala:1255-1310).
 
-Multi-host note: orbax writes a sharded checkpoint cooperatively from all
-processes, which is the TPU-native analog of the reference's rank-0
-authoritative state save (torch_runner.py:369-410).
+Crash consistency (r7): every save goes through ONE atomic commit
+protocol — `write_committed`:
 
-Async saves are PLATFORM-GATED (r5, VERDICT r4 weak #3).  Async writes
-were implemented twice in r4 (orbax StandardCheckpointer driven from a
-daemon thread, then orbax AsyncCheckpointer per save, closed by a
-finisher thread): both variants left the process in a state where a
-LATER multi-device `jit` dispatch with collectives aborted inside
-XLA:**CPU** (SIGABRT in pxla `__call__`, reproducible with
-tests/test_failure_handling.py + tests/_fsdp_cases.py in ONE process
-— the shipped tests/test_fsdp.py wrapper isolates the cases in child
-processes precisely because of this class of abort).  That is a CPU
-runtime artifact; punishing the TPU path for it means a BERT-scale
-training pause on every checkpoint trigger.  So:
-  * platform != "cpu" (the real TPU path): `AsyncCheckpointer` — the
-    save returns after the device->host copy; serialization overlaps
-    the next training steps.  At most ONE save is in flight (a new save
-    drains the previous), and restores/exit drain first.
-  * platform == "cpu" (tests, hermetic CI): blocking save, as before.
-`ZOO_ASYNC_CHECKPOINT=0|1` overrides the gate either way.
+    1. orbax-write the state into a hidden sibling temp dir,
+    2. `os.replace` the temp dir onto the final path (atomic on the
+       POSIX stores training writes to),
+    3. write the epoch/step sidecar (`<path>.meta.json`), then the
+       commit marker (`<path>.commit`, itself written temp->rename and
+       fsynced).
+
+`find_latest_checkpoint` trusts ONLY the marker: a crash at ANY point
+before step 3 leaves either an invisible temp dir or a marker-less
+directory, both skipped — an elastic restart provably never loads a
+torn or uncommitted write (pinned by tests/test_checkpoint_crash.py,
+which kills the writer at every phase via the fault plan).  Legacy
+directories written by plain orbax (no marker anywhere in the parent)
+keep working through the orbax-finalized fallback.
+
+Async saves: the r4 orbax-AsyncCheckpointer experiments left XLA:CPU
+aborting inside later collective dispatches when driven from a thread,
+so background saves now run through the resilience layer's
+`BackgroundCheckpointer` instead — the caller thread snapshots the
+state to host numpy and the writer thread runs this module's
+`write_committed` over host arrays only (nothing XLA owns ever crosses
+the thread boundary).  The platform gate is unchanged: async by
+default off-CPU, sync on CPU; `ZOO_ASYNC_CHECKPOINT=0|1` overrides,
+and `OrcaContext.background_checkpointing` arms it explicitly for
+Estimator trigger saves.  Transient checkpoint I/O errors retry under
+a deterministic `RetryPolicy`.
+
+Fault-injection sites (docs/fault-tolerance.md): `checkpoint.
+before_write` / `mid_write` / `before_rename` / `before_commit` /
+`after_commit` / `load`.
 """
 
 from __future__ import annotations
 
 import atexit
+import json
 import logging
 import os
 import re
-from typing import Optional
+import shutil
+import time
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-#: ONE long-lived AsyncCheckpointer (orbax's intended usage: save,
-#: wait_until_finished before the next save/restore, close at exit) —
-#: created lazily on the first async save
-_ASYNC_CKPTR = None
+from analytics_zoo_tpu.resilience.faults import fault_point
+from analytics_zoo_tpu.resilience.retry import RetryPolicy
+
+#: marker suffix of the commit protocol; the marker's presence is the
+#: definition of "this checkpoint is durable"
+COMMIT_SUFFIX = ".commit"
+
+#: transient-I/O retry for the orbax write/read calls (deterministic
+#: backoff; OSError only — a corrupt checkpoint must fail loudly)
+_IO_RETRY = RetryPolicy(max_attempts=3, backoff_s=0.1,
+                        name="checkpoint_io")
+
+_tmp_counter = 0
 
 
 def async_save_enabled() -> bool:
-    """True when saves go through orbax's AsyncCheckpointer.  Gated to
-    non-CPU platforms — the r4 XLA:CPU rendezvous abort (module
-    docstring) is a CPU artifact; `ZOO_ASYNC_CHECKPOINT` overrides.
+    """True when unqualified saves run in the background
+    (BackgroundCheckpointer).  Gated to non-CPU platforms — the r4
+    XLA:CPU thread abort (module docstring) plus CPU CI determinism;
+    `ZOO_ASYNC_CHECKPOINT` overrides.
 
     Tunnel opt-out: under a proxied device (JAX_PLATFORMS=axon) the
     async path is counterproductive and stays off.  Measured at a
-    1.36 GB BERT-scale state: AsyncCheckpointer blocks ~85 s in its
-    device->host copy (a bare `jax.device_get` over the tunnel runs at
-    ~17 MB/s) while the SYNC save completes in ~17 s, because orbax's
-    blocking path streams device->disk with internal concurrency.  On a
-    directly-attached TPU host the copy runs at PCIe/HBM speeds and
-    async returns in a fraction of the write time — which is the case
+    1.36 GB BERT-scale state: the device->host snapshot runs at
+    ~17 MB/s over the tunnel (~85 s blocked) while the sync orbax save
+    streams device->disk with internal concurrency in ~17 s.  On a
+    directly-attached TPU host the snapshot runs at PCIe/HBM speeds
+    and the save returns in a fraction of the write time — the case
     the gate targets."""
     env = os.environ.get("ZOO_ASYNC_CHECKPOINT")
     if env is not None:
@@ -66,60 +90,103 @@ def async_save_enabled() -> bool:
 
 
 def wait_for_checkpoints():
-    """Block until any in-flight async save has committed.  Called
-    before a new async save (bounds in-flight state copies at one),
-    before any restore (read-your-write), and at interpreter exit (no
-    torn checkpoints on clean shutdown)."""
-    if _ASYNC_CKPTR is not None:
-        _ASYNC_CKPTR.wait_until_finished()
+    """Block until any in-flight background save has committed.
+    Called before any restore (read-your-write) and at interpreter
+    exit (no lost saves on clean shutdown).  Write FAILURES do not
+    raise here — the pure read paths that call this skip the missing
+    checkpoint anyway; `BackgroundCheckpointer.drain()` is where a
+    failed write surfaces."""
+    from analytics_zoo_tpu.resilience.checkpointing import (
+        drain_background)
+    drain_background(raise_on_error=False)
 
 
-def _close_async():
-    global _ASYNC_CKPTR
-    if _ASYNC_CKPTR is not None:
-        ckptr, _ASYNC_CKPTR = _ASYNC_CKPTR, None
+atexit.register(wait_for_checkpoints)
+
+
+def write_committed(path: str, state,
+                    meta: Optional[Dict[str, Any]] = None) -> str:
+    """The atomic commit protocol (module docstring).  `state` may be
+    device arrays (sync path) or a host snapshot (background writer).
+    Returns `path`, durable on return."""
+    global _tmp_counter
+    path = os.path.abspath(path)
+    parent, name = os.path.split(path)
+    os.makedirs(parent, exist_ok=True)
+    fault_point("checkpoint.before_write", path=path)
+    # sweep temp leftovers of CRASHED previous saves of this same
+    # target (a killed writer cleans nothing up — recovery happens on
+    # the next save, not in the crashing process)
+    for stale in os.listdir(parent):
+        if stale.startswith(f".tmp-{name}-"):
+            shutil.rmtree(os.path.join(parent, stale),
+                          ignore_errors=True)
+    _tmp_counter += 1
+    tmp = os.path.join(parent,
+                       f".tmp-{name}-{os.getpid()}-{_tmp_counter}")
+
+    def _orbax_write():
+        ckptr = ocp.StandardCheckpointer()
         try:
+            ckptr.save(tmp, state, force=True)
             ckptr.wait_until_finished()
         finally:
-            # a failed background write must not also leak the
-            # checkpointer's threads/resources
             ckptr.close()
 
+    _IO_RETRY.run(_orbax_write, retryable=(OSError,))
+    fault_point("checkpoint.mid_write", path=tmp)
+    fault_point("checkpoint.before_rename", path=path)
+    if os.path.isdir(path):
+        # overwrite (force semantics): UN-commit before destroying the
+        # old version — a crash between these steps must leave the
+        # path marker-less, never marked-but-torn
+        if os.path.exists(path + COMMIT_SUFFIX):
+            os.remove(path + COMMIT_SUFFIX)
+        shutil.rmtree(path)
+    os.replace(tmp, path)
+    fault_point("checkpoint.before_commit", path=path)
+    if meta is not None:
+        _atomic_write_json(path + ".meta.json", dict(meta))
+    _atomic_write_json(path + COMMIT_SUFFIX,
+                       {"name": name, "wall_time": time.time(),
+                        **({"meta": dict(meta)} if meta else {})})
+    fault_point("checkpoint.after_commit", path=path)
+    from analytics_zoo_tpu.observability import get_registry
+    get_registry().counter(
+        "checkpoint_committed_total",
+        help="checkpoints whose commit marker landed").inc()
+    return path
 
-atexit.register(_close_async)
+
+def _atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
-def save_checkpoint(path: str, state, block: Optional[bool] = None) -> str:
-    """Write `state` to `path`.  `block=None` -> platform gate
-    (async on TPU, sync on CPU); the async path returns once the
-    device->host copy is done and the directory write continues in
-    orbax's background thread.
+def save_checkpoint(path: str, state, block: Optional[bool] = None,
+                    meta: Optional[Dict[str, Any]] = None) -> str:
+    """Write `state` to `path` via the commit protocol.  `block=None`
+    -> platform gate (background off-CPU, sync on CPU).
 
-    DURABILITY: on the async path the returned path is NOT yet durable
-    — the directory may still be mid-write (or torn, on stores without
-    atomic rename) when this returns.  In-process readers are covered
-    (`load_checkpoint`/`find_latest_checkpoint` drain via
-    `wait_for_checkpoints` first), but before handing the path to
-    ANOTHER process, or gating external work on its existence, call
-    `wait_for_checkpoints()` yourself."""
+    DURABILITY: on the background path the returned path is NOT yet
+    durable — the commit marker lands on the writer thread.
+    In-process readers are covered (`load_checkpoint`/
+    `find_latest_checkpoint` drain first), but before handing the path
+    to ANOTHER process, or gating external work on its existence, call
+    `wait_for_checkpoints()` (or `BackgroundCheckpointer.drain()`,
+    which also surfaces write failures) yourself."""
     path = os.path.abspath(path)
     if block is None:
         block = not async_save_enabled()
     if block:
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(path, state, force=True)
-        ckptr.wait_until_finished()
-        ckptr.close()
-        return path
-    global _ASYNC_CKPTR
-    if _ASYNC_CKPTR is None:
-        _ASYNC_CKPTR = ocp.AsyncCheckpointer(
-            ocp.StandardCheckpointHandler())
-    else:
-        wait_for_checkpoints()
-    _ASYNC_CKPTR.save(path, args=ocp.args.StandardSave(state),
-                      force=True)
-    return path
+        return write_committed(path, state, meta=meta)
+    from analytics_zoo_tpu.resilience.checkpointing import (
+        get_background_checkpointer)
+    return get_background_checkpointer().submit(path, state, meta=meta)
 
 
 def load_checkpoint(path: str, target_state):
@@ -132,9 +199,12 @@ def load_checkpoint(path: str, target_state):
     stacked before mapping onto the target."""
     wait_for_checkpoints()          # read-your-write for async saves
     path = os.path.abspath(path)
+    fault_point("checkpoint.load", path=path)
     ckptr = ocp.StandardCheckpointer()
     try:
-        restored = ckptr.restore(path, target_state)
+        restored = _IO_RETRY.run(
+            lambda: ckptr.restore(path, target_state),
+            retryable=(OSError,))
     except Exception:
         raw = ckptr.restore(path)
         converted = _stack_block_subtrees(raw)
@@ -203,15 +273,19 @@ def _stack_block_subtrees(tree):
     return out
 
 
+def has_commit_marker(path: str) -> bool:
+    """Marker AND directory: a marker whose directory vanished (crash
+    mid-overwrite on a non-atomic store) is not a loadable commit."""
+    return os.path.isfile(path + COMMIT_SUFFIX) and os.path.isdir(path)
 
 
-def _is_committed(path: str) -> bool:
-    """False for a checkpoint directory whose (async) write never
-    finalized — e.g. the job was preempted mid-save.  Local-fs orbax
-    saves commit via atomic tmp-dir rename, but GCS-style destinations
-    mark completion with a commit file instead; `find_latest` must skip
-    torn directories or an elastic restart crashes on its newest
-    checkpoint instead of resuming from the intact previous one."""
+def _is_committed_legacy(path: str) -> bool:
+    """Pre-marker fallback for directories written by plain orbax.
+    Local-fs orbax saves commit via atomic tmp-dir rename, but
+    GCS-style destinations mark completion with a commit file instead;
+    torn directories must be skipped or an elastic restart crashes on
+    its newest checkpoint instead of resuming from the intact previous
+    one."""
     try:
         from orbax.checkpoint.utils import is_checkpoint_finalized
         if not is_checkpoint_finalized(path):
@@ -239,6 +313,14 @@ def _is_committed(path: str) -> bool:
 
 def find_latest_checkpoint(model_dir: str,
                            version: Optional[int] = None) -> str:
+    """Newest COMMITTED `ckpt-N` under `model_dir`.
+
+    Commit policy: when ANY candidate carries a `.commit` marker the
+    directory is running the r7 protocol — marker-less candidates are
+    presumed uncommitted (a crash between rename and marker) and
+    skipped, counted in `checkpoint_torn_skipped_total`.  A directory
+    with no markers at all is legacy (plain orbax writers) and falls
+    back to the orbax-finalized predicate."""
     wait_for_checkpoints()          # an in-flight save IS the latest
     pat = re.compile(r"^ckpt-(\d+)$")
     candidates = []
@@ -253,7 +335,19 @@ def find_latest_checkpoint(model_dir: str,
             if v == version:
                 return p
         raise FileNotFoundError(f"no checkpoint version {version}")
-    committed = [c for c in candidates if _is_committed(c[1])]
+    marked = [c for c in candidates if has_commit_marker(c[1])]
+    if marked:
+        skipped = len(candidates) - len(marked)
+        if skipped:
+            from analytics_zoo_tpu.observability import get_registry
+            get_registry().counter(
+                "checkpoint_torn_skipped_total",
+                help="uncommitted/torn checkpoint directories skipped "
+                     "by find_latest_checkpoint").inc(skipped)
+        committed = marked
+    else:
+        committed = [c for c in candidates
+                     if _is_committed_legacy(c[1])]
     if not committed:
         raise FileNotFoundError(
             f"only uncommitted (torn) checkpoints under {model_dir}: "

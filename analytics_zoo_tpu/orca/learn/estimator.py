@@ -49,6 +49,7 @@ from analytics_zoo_tpu.orca.learn import optimizers as optim_mod
 from analytics_zoo_tpu.orca.learn.spmd import SPMDEngine
 from analytics_zoo_tpu.orca.learn.trigger import EveryEpoch, Trigger
 from analytics_zoo_tpu.orca.learn.utils import HostDataset
+from analytics_zoo_tpu.resilience.retry import RetryPolicy
 
 
 class Estimator:
@@ -256,8 +257,16 @@ class Estimator:
             trigger = EveryEpoch()
         start_epoch = self._epoch
         target_epoch = self._epoch + epochs
-        retries_left = (OrcaContext.failure_retry_times
-                        if max_failures is None else max_failures)
+        # the reference's DP-1 retry-restore loop as a typed policy
+        # (resilience/retry.py): deterministic exponential backoff from
+        # the configured interval, budget from failure_retry_times
+        budget = (OrcaContext.failure_retry_times
+                  if max_failures is None else max_failures)
+        retry_policy = RetryPolicy(
+            max_attempts=budget + 1,
+            backoff_s=OrcaContext.failure_retry_interval_s,
+            name="estimator_fit")
+        failures = 0
         pending_restore = False
 
         # flight recorder: armed (excepthook + faulthandler) for the
@@ -298,22 +307,23 @@ class Estimator:
                     except (NaNLossError, KeyboardInterrupt):
                         raise
                     except Exception as e:
-                        if retries_left <= 0 or not self.model_dir:
+                        failures += 1
+                        if failures > budget or not self.model_dir:
                             raise
-                        retries_left -= 1
                         self.retries += 1
+                        retry_policy.record_retry(e)
                         flight_recorder.record(
                             "fit_retry",
                             error=f"{type(e).__name__}: {e}",
-                            retries_left=retries_left)
+                            retries_left=budget - failures)
                         log_event("fit_retry",
                                   error=f"{type(e).__name__}: {e}",
-                                  retries_left=retries_left)
+                                  retries_left=budget - failures)
                         logger.warning(
                             "training failed (%s: %s); restoring latest "
                             "checkpoint and retrying (%d retries left)",
-                            type(e).__name__, e, retries_left)
-                        time.sleep(OrcaContext.failure_retry_interval_s)
+                            type(e).__name__, e, budget - failures)
+                        time.sleep(retry_policy.backoff(failures))
                         pending_restore = True
         except KeyboardInterrupt:
             raise
@@ -325,6 +335,13 @@ class Estimator:
                 extra={"epoch": self._epoch, "retries": self.retries})
             raise
         finally:
+            # quiesce the background checkpoint writer: after fit
+            # returns (or raises) every triggered save is durable —
+            # write failures were already logged/flight-recorded by
+            # the writer
+            from analytics_zoo_tpu.resilience.checkpointing import (
+                drain_background)
+            drain_background(raise_on_error=False)
             if wd is not None:
                 wd.stop()
                 self._engine.watchdog = None
@@ -395,19 +412,24 @@ class Estimator:
             self.val_summary.append(vstats)
             self._tb_log("validation", vstats, step)
             log_event("validation_epoch", **vstats)
+        nan_msg = None
+        if stats.get("nan_steps"):
+            nan_msg = (
+                f"{int(stats['nan_steps'])} training step(s) in epoch "
+                f"{self._epoch} had non-finite loss/gradients and were "
+                "skipped")
+        if nan_msg and nan_policy == "raise":
+            # raise-mode treats a NaN epoch as FAILED: no checkpoint is
+            # written for it (a supervisor restarting on NaNLossError
+            # must resume from the last clean epoch, not persist the
+            # skipped-step trajectory).  Summaries above stay, so a
+            # caller catching the error still sees consistent state.
+            raise NaNLossError(nan_msg)
         if trigger and self.model_dir and trigger(
                 epoch=self._epoch, step=step, epoch_end=True):
             self.save_checkpoint()
-        # epoch bookkeeping (summary, checkpoint) is complete before a NaN
-        # abort, so a caller catching NaNLossError sees consistent state;
-        # the offending steps themselves never touched the params
-        if stats.get("nan_steps"):
-            msg = (f"{int(stats['nan_steps'])} training step(s) in epoch "
-                   f"{self._epoch} had non-finite loss/gradients and were "
-                   "skipped")
-            if nan_policy == "raise":
-                raise NaNLossError(msg)
-            logger.warning(msg)
+        if nan_msg:
+            logger.warning(nan_msg)
 
     @staticmethod
     def _content_fingerprint(arrays) -> tuple:
@@ -646,22 +668,38 @@ class Estimator:
     def save_checkpoint(self, step: Optional[int] = None) -> str:
         """Write a step-versioned checkpoint under model_dir (reference
         checkpoint_trigger semantics, orca/learn/trigger.py + tf/estimator.py
-        save path).  A sidecar records the epoch cursor so failure
-        restores resume the correct epoch.
+        save path) through the atomic commit protocol
+        (orca/learn/checkpoint.py) — the epoch/step sidecar and commit
+        marker land together, so failure restores always resume the
+        correct epoch from a durable version.
+
+        With `OrcaContext.background_checkpointing` the save leaves
+        the critical path after one device->host snapshot; either way
+        the critical-path cost is recorded as a fenced goodput
+        "step" of the spmd_train clock whose wall lands in the
+        ``checkpoint`` bucket (GET /goodput shows the save cost —
+        and the async mode shows it leaving the loop).
 
         `step`: the global step to version the file with.  Mid-epoch
         callers (SeveralIteration triggers) MUST pass the loop-local
         step: the engine's host_step mirror only commits at epoch end,
         so reading it mid-epoch would stamp every checkpoint of the
         epoch with the same stale number (overwriting one another)."""
-        import json
+        from analytics_zoo_tpu.orca.learn.checkpoint import (
+            save_checkpoint)
         self._require_engine()
         if step is None:
             step = self._engine.host_step
         path = os.path.join(self.model_dir, f"ckpt-{step}")
-        self.save(path)
-        with open(path + ".meta.json", "w") as f:
-            json.dump({"epoch": self._epoch, "step": step}, f)
+        block = (False if OrcaContext.background_checkpointing
+                 else None)
+        rec = self._engine._clock_train.begin(force_fence=True)
+        try:
+            save_checkpoint(path, self._engine.state, block=block,
+                            meta={"epoch": self._epoch, "step": step})
+        finally:
+            rec.lap("checkpoint")
+            rec.end()
         return path
 
     def load_orca_checkpoint(self, path: str, version: Optional[int] = None):
@@ -672,6 +710,39 @@ class Estimator:
             find_latest_checkpoint)
         ckpt = find_latest_checkpoint(path, version)
         return self.load(ckpt)
+
+    @property
+    def epoch(self) -> int:
+        """The epoch cursor: epochs completed so far (fit trains
+        `epochs` MORE epochs from here; `resume_latest` restores it
+        from the checkpoint sidecar)."""
+        return self._epoch
+
+    def resume_latest(self) -> Optional[str]:
+        """Restore the newest COMMITTED checkpoint under `model_dir`,
+        including the epoch cursor from its sidecar — the one-call
+        resume an elastic restart (resilience/elastic.py) performs
+        before re-entering fit.  Returns the checkpoint path, or None
+        when nothing committed exists yet (fresh start)."""
+        import json
+
+        from analytics_zoo_tpu.orca.learn.checkpoint import (
+            find_latest_checkpoint)
+        if not self.model_dir:
+            raise ValueError("resume_latest needs model_dir")
+        try:
+            ckpt = find_latest_checkpoint(self.model_dir)
+        except (FileNotFoundError, OSError):
+            return None
+        self.load(ckpt)
+        try:
+            with open(ckpt + ".meta.json") as f:
+                # sidecar "epoch" = epochs COMPLETED at save time (the
+                # cursor the next fit continues from)
+                self._epoch = int(json.load(f)["epoch"])
+        except (FileNotFoundError, OSError, KeyError, ValueError):
+            pass  # pre-metadata checkpoint: keep the current cursor
+        return ckpt
 
     # ------------------------------------------------------------------
     # summaries

@@ -43,6 +43,7 @@ from analytics_zoo_tpu.observability import (
     step_clock,
     trace,
 )
+from analytics_zoo_tpu.resilience.faults import fault_point
 from analytics_zoo_tpu.parallel.sharding import (
     _count_device_put_bytes,
     batch_sharding,
@@ -78,6 +79,21 @@ class TrainState(struct.PyTreeNode):
     rng: jnp.ndarray
     # mutable model collections (e.g. BatchNorm stats); empty dict if unused
     model_state: Any = struct.field(default_factory=dict)
+
+
+def _poison_batch_nan(batch):
+    """Host-side NaN poisoning of ONE staged batch (the fault plan's
+    "nan" action): float feature/label leaves are multiplied by NaN
+    eagerly — identical shapes/dtypes/shardings, so the jitted step
+    re-dispatches with zero recompiles and its on-device isfinite
+    guard sees the poison exactly like an organic NaN step."""
+    def poison(a):
+        return a * jnp.nan if jnp.issubdtype(a.dtype, jnp.floating) \
+            else a
+    out = dict(batch)
+    out["features"] = jax.tree_util.tree_map(poison, batch["features"])
+    out["labels"] = jax.tree_util.tree_map(poison, batch["labels"])
+    return out
 
 
 def masked_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
@@ -461,6 +477,12 @@ class SPMDEngine:
         inside the jit.  Shuffling is a device-side full-row permutation
         per epoch."""
         self._annotate_mesh()
+        # fault-injection site (resilience/faults.py): the epoch-scan
+        # path is one dispatch, so its kill/stall granularity is the
+        # epoch ("nan" needs a host-visible batch — use the streaming
+        # path or the per-step loop below for that)
+        fault_point("train.epoch" if train else "eval.epoch",
+                    epoch=epoch)
         data = dds.data
         clock = self._clock_train if train else self._clock_eval
         sentinel = train and OrcaContext.nonfinite_watchdog
@@ -529,6 +551,8 @@ class SPMDEngine:
                    else self._eval_step_cached)
         kind = "train_cached" if train else "eval_cached"
         for i in range(dds.steps):
+            fault_point("train.step" if train else "eval.step",
+                        step=step + 1 if train else step)
             rec = clock.begin(force_fence=profile or sentinel)
             t0 = now() if profile else 0.0
             rec.cold = kind not in self._jit_warm
@@ -710,6 +734,14 @@ class SPMDEngine:
             except StopIteration:
                 break
             rec.lap("host_input")
+            # fault-injection site: "raise"/"crash" kill the worker
+            # here, "stall" wedges the loop for the watchdogs, "nan"
+            # poisons this batch host-side (zero-recompile — see
+            # _poison_batch_nan)
+            act = fault_point("train.step" if train else "eval.step",
+                              step=step + 1 if train else step)
+            if act == "nan" and train:
+                batch = _poison_batch_nan(batch)
             t0 = now() if profile else 0.0
             rec.cold = kind not in self._jit_warm
             with self._step_span(kind, step + 1 if train else step,

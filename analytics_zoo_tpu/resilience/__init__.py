@@ -1,0 +1,60 @@
+"""Resilience layer (fault tolerance across training and serving).
+
+Four pieces, one contract — kill-and-resume is a first-class, tested
+scenario instead of an ops afterthought:
+
+* `faults` — deterministic, seeded fault injection
+  (`OrcaContext.fault_plan`): named sites threaded into the train
+  step loops, every phase of the checkpoint commit protocol, the
+  decode loop and serving admission; each a no-op when unarmed and
+  recompile-free when armed.
+* `retry` — the typed `RetryPolicy` (max attempts, deterministic
+  exponential backoff, deadline) adopted by estimator fit retries,
+  checkpoint I/O, the serving client and the multichip dryrun
+  children.
+* `checkpointing` — `BackgroundCheckpointer`: saves leave the
+  critical path as one device->host snapshot; serialization + the
+  atomic tmp->rename->commit-marker protocol run on a writer thread
+  (`OrcaContext.background_checkpointing` arms it for Estimator
+  trigger saves).
+* `elastic` — `ElasticTrainingDriver`: runs the gang, watches
+  heartbeats, and restarts from the latest COMMITTED checkpoint under
+  a `RetryPolicy` budget.
+
+docs/fault-tolerance.md is the operator guide (fault-plan knobs, the
+commit protocol, a recovery walkthrough); the error taxonomy below is
+pinned by scripts/check_error_taxonomy.py.
+"""
+
+from analytics_zoo_tpu.resilience.checkpointing import (  # noqa: F401
+    BackgroundCheckpointer,
+    CheckpointWriteError,
+    drain_background,
+    get_background_checkpointer,
+)
+from analytics_zoo_tpu.resilience.elastic import (  # noqa: F401
+    ElasticRestartExceeded,
+    ElasticTrainingDriver,
+    WorkerCancelled,
+    WorkerContext,
+    touch_heartbeat,
+)
+from analytics_zoo_tpu.resilience.faults import (  # noqa: F401
+    Fault,
+    FaultInjected,
+    FaultPlan,
+    PoisonedRequestError,
+    SimulatedCrash,
+    SimulatedWorkerFailure,
+    fault_point,
+)
+from analytics_zoo_tpu.resilience.retry import RetryPolicy  # noqa: F401
+
+__all__ = [
+    "BackgroundCheckpointer", "CheckpointWriteError",
+    "ElasticRestartExceeded", "ElasticTrainingDriver", "Fault",
+    "FaultInjected", "FaultPlan", "PoisonedRequestError", "RetryPolicy",
+    "SimulatedCrash", "SimulatedWorkerFailure", "WorkerCancelled",
+    "WorkerContext", "drain_background", "fault_point",
+    "get_background_checkpointer", "touch_heartbeat",
+]

@@ -1,0 +1,169 @@
+"""Background (async) checkpointing off the training critical path.
+
+The critical-path cost of a save becomes ONE device->host snapshot
+(`jax.device_get`, recorded in `checkpoint_snapshot_seconds` and the
+goodput ``checkpoint`` bucket); serialization, the atomic
+tmp->rename->commit-marker protocol (orca/learn/checkpoint.py:
+`write_committed`) and fsync all run on a daemon writer thread over
+HOST numpy arrays only.  Keeping device buffers out of the writer
+thread is load-bearing: the r4 orbax-AsyncCheckpointer-from-a-thread
+experiments left XLA:CPU aborting in later collective dispatches
+(checkpoint.py module docstring) — a snapshot-first writer never hands
+the background thread anything XLA owns.
+
+At most ONE save is in flight: a new `submit` drains the previous
+(bounds staged state copies at one), `drain()` blocks until durable
+and re-raises a failed background write as `CheckpointWriteError`, and
+`checkpoint.wait_for_checkpoints()` drains the process-global writer
+so `find_latest_checkpoint`/`load_checkpoint` keep their
+read-your-write guarantee.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Dict, Optional
+
+
+class CheckpointWriteError(RuntimeError):
+    """A background checkpoint write failed; surfaced on the next
+    `drain()` so the failure cannot silently cost the restore point."""
+
+
+class BackgroundCheckpointer:
+    """One writer thread, one in-flight save, crash-consistent commits."""
+
+    def __init__(self, registry=None):
+        from analytics_zoo_tpu.observability import get_registry
+        reg = registry if registry is not None else get_registry()
+        self._h_snapshot = reg.histogram(
+            "checkpoint_snapshot_seconds",
+            help="critical-path device->host state snapshot time of "
+                 "background saves")
+        self._h_save = reg.histogram(
+            "checkpoint_save_seconds",
+            help="wall time of the full write->rename->commit protocol "
+                 "(background thread for async saves)")
+        self._lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._pending: Optional[tuple] = None
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._stop = False
+
+    # ------------------------------------------------------------------
+
+    def submit(self, path: str, state: Any,
+               meta: Optional[Dict[str, Any]] = None) -> str:
+        """Snapshot `state` to host and queue the committed write.
+        Returns `path` immediately; the path is durable only after the
+        commit marker lands (`drain()` to wait)."""
+        import jax
+
+        from analytics_zoo_tpu.observability import now
+        self.drain()                     # one in-flight save at most
+        t0 = now()
+        snapshot = jax.device_get(state)
+        self._h_snapshot.record(now() - t0)
+        with self._lock:
+            if self._error is not None:   # drain() raised already; but
+                self._error = None        # a fresh submit starts clean
+            self._pending = (path, snapshot, meta)
+            self._idle.clear()
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._writer, daemon=True,
+                    name="background-checkpointer")
+                self._thread.start()
+        self._wake.set()
+        return path
+
+    def _writer(self) -> None:
+        from analytics_zoo_tpu.observability import (
+            flight_recorder,
+            log_event,
+            now,
+        )
+        while True:
+            self._wake.wait()
+            self._wake.clear()
+            if self._stop:
+                return
+            with self._lock:
+                job, self._pending = self._pending, None
+            if job is None:
+                continue
+            path, snapshot, meta = job
+            t0 = now()
+            try:
+                from analytics_zoo_tpu.orca.learn.checkpoint import (
+                    write_committed)
+                write_committed(path, snapshot, meta=meta)
+                self._h_save.record(now() - t0)
+            except BaseException as e:
+                with self._lock:
+                    self._error = e
+                flight_recorder.record(
+                    "checkpoint_write_failed", path=path,
+                    error=f"{type(e).__name__}: {e}")
+                log_event("checkpoint_write_failed", path=path,
+                          error=f"{type(e).__name__}: {e}")
+            finally:
+                self._idle.set()
+
+    # ------------------------------------------------------------------
+
+    def busy(self) -> bool:
+        return not self._idle.is_set()
+
+    def drain(self, raise_on_error: bool = True) -> None:
+        """Block until the in-flight save committed (or failed).  A
+        failed write raises `CheckpointWriteError` here — exactly once
+        — unless `raise_on_error=False` (pure read paths that only
+        need quiescence, e.g. `find_latest_checkpoint`, which skips
+        the missing/uncommitted checkpoint anyway)."""
+        self._idle.wait()
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None and raise_on_error:
+            raise CheckpointWriteError(
+                f"background checkpoint write failed: "
+                f"{type(err).__name__}: {err}") from err
+        if err is not None:
+            with self._lock:     # keep it visible for a raising drain
+                self._error = err
+
+    def close(self) -> None:
+        self.drain(raise_on_error=False)
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# ----------------------------------------------------------------------
+
+_global_lock = threading.Lock()
+_global: Optional[BackgroundCheckpointer] = None
+
+
+def get_background_checkpointer() -> BackgroundCheckpointer:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = BackgroundCheckpointer()
+            atexit.register(_global.close)
+        return _global
+
+
+def drain_background(raise_on_error: bool = True) -> None:
+    """Drain the process-global writer if one exists (no-op —
+    and no writer-thread creation — otherwise)."""
+    with _global_lock:
+        writer = _global
+    if writer is not None:
+        writer.drain(raise_on_error=raise_on_error)

@@ -1,0 +1,346 @@
+"""Elastic restart driver — kill-and-resume as a first-class, tested
+scenario.
+
+A `jax.distributed` gang is all-or-nothing: when a member dies the
+survivors block in their next collective, so recovery means a
+SUPERVISOR that (1) detects the death, (2) tears the whole gang down,
+and (3) restarts the job from the latest *committed* checkpoint.  The
+reference delegated that role to the Spark driver + `ray_daemon.py`
+orphan reaping; `ElasticTrainingDriver` is the TPU-native equivalent,
+runnable two ways:
+
+* **in-process members** (callables) — worker threads beating a
+  heartbeat through their `WorkerContext`; death = an escaped
+  exception, stall = a stale heartbeat.  This is what makes
+  kill/stall/NaN recovery deterministic and testable inside one CPU
+  container (tests/test_elastic_restart.py) — no SIGKILL timing, no
+  subprocess scheduling jitter.
+* **subprocess members** (`spawn=` factory) — real processes,
+  liveness via `Popen.poll()` plus optional heartbeat FILES
+  (`touch_heartbeat`); on failure the survivors are SIGKILLed like a
+  preempted pod's job teardown.
+
+Every wait is deadline-based (`heartbeat_timeout_s`, `drain_timeout_s`,
+the restart policy's backoff/deadline) — there are no fixed sleeps to
+tune per machine.  Restarts consume a `RetryPolicy` budget with
+deterministic backoff; each one leaves a flight-recorder bundle, bumps
+`resilience_restarts_total` / `resilience_worker_deaths_total`, and
+resumes from `find_latest_checkpoint`, which only ever returns a
+checkpoint whose commit marker landed (orca/learn/checkpoint.py) — a
+kill mid-save costs at most the work since the previous commit, never
+a torn restore.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from analytics_zoo_tpu.resilience.retry import RetryPolicy
+
+
+class WorkerCancelled(RuntimeError):
+    """Raised out of `WorkerContext.heartbeat()` once the driver has
+    fenced this attempt — cooperative teardown of in-process members
+    (the thread analog of the supervisor's SIGKILL)."""
+
+
+class ElasticRestartExceeded(RuntimeError):
+    """The restart budget drained without a clean run."""
+
+
+class WorkerContext:
+    """What a worker function receives: identity, the resume source,
+    and the heartbeat it must feed."""
+
+    def __init__(self, worker_id: int, n_workers: int, attempt: int,
+                 resume_checkpoint: Optional[str]):
+        self.worker_id = worker_id
+        self.n_workers = n_workers
+        self.attempt = attempt
+        #: newest COMMITTED checkpoint path, or None on a fresh start
+        self.resume_checkpoint = resume_checkpoint
+        self._cancel = threading.Event()
+        self._last_beat = time.monotonic()
+
+    def heartbeat(self) -> None:
+        """Call once per unit of progress (step / scheduling round).
+        Raises `WorkerCancelled` after the driver fenced the attempt,
+        so a zombie member exits instead of racing the restarted job."""
+        if self._cancel.is_set():
+            raise WorkerCancelled(
+                f"worker {self.worker_id} cancelled by the elastic "
+                f"driver (attempt {self.attempt})")
+        self._last_beat = time.monotonic()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+
+class _ThreadMember:
+    def __init__(self, fn: Callable, ctx: WorkerContext):
+        self.ctx = ctx
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+        def run():
+            try:
+                self.result = fn(ctx)
+            except BaseException as e:
+                self.error = e
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(
+            target=run, daemon=True,
+            name=f"elastic-worker-{ctx.worker_id}")
+        self._thread.start()
+
+    def finished(self) -> bool:
+        return self._done.is_set()
+
+    def last_beat(self) -> float:
+        return self.ctx._last_beat
+
+    def cancel(self) -> None:
+        self.ctx._cancel.set()
+
+    def join(self, timeout: float) -> bool:
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+
+class _ProcessMember:
+    """Subprocess gang member: liveness from poll(), heartbeats from
+    the mtime of its `touch_heartbeat` file when one is configured."""
+
+    def __init__(self, proc, heartbeat_file: Optional[str]):
+        self.proc = proc
+        self.heartbeat_file = heartbeat_file
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self._t0 = time.monotonic()
+
+    def finished(self) -> bool:
+        rc = self.proc.poll()
+        if rc is None:
+            return False
+        if rc != 0 and self.error is None:
+            self.error = RuntimeError(
+                f"gang member pid {self.proc.pid} exited rc={rc}")
+        return True
+
+    def last_beat(self) -> float:
+        if self.heartbeat_file:
+            try:
+                mtime = os.path.getmtime(self.heartbeat_file)
+                # map the file's wall mtime onto the monotonic axis the
+                # staleness check uses
+                return time.monotonic() - max(0.0, time.time() - mtime)
+            except OSError:
+                pass
+        return self._t0
+
+    def cancel(self) -> None:
+        if self.proc.poll() is None:
+            try:
+                self.proc.kill()        # SIGKILL: a preempted member
+            except OSError:             # gets no goodbye either
+                pass
+
+    def join(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while self.proc.poll() is None:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+        return True
+
+
+def touch_heartbeat(directory: str, worker_id: int) -> str:
+    """Subprocess-member heartbeat: touch (and return) the per-worker
+    beat file the driver watches."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"heartbeat-{worker_id}")
+    with open(path, "a"):
+        os.utime(path, None)
+    return path
+
+
+class ElasticTrainingDriver:
+    """Run a gang, watch its heartbeats, restart from the latest
+    committed checkpoint until the job finishes or the restart budget
+    drains."""
+
+    def __init__(self, workers, *,
+                 checkpoint_dir: Optional[str] = None,
+                 restart: Optional[RetryPolicy] = None,
+                 heartbeat_timeout_s: float = 30.0,
+                 poll_interval_s: float = 0.02,
+                 drain_timeout_s: float = 10.0,
+                 spawn: Optional[Callable] = None,
+                 heartbeat_dir: Optional[str] = None,
+                 registry=None):
+        """`workers`: a callable (single member), a sequence of
+        callables (in-process gang), or — with `spawn` — an int member
+        count; `spawn(worker_id, resume_checkpoint, attempt)` must
+        return a started `subprocess.Popen`.  `heartbeat_dir` arms
+        file-mtime heartbeats for subprocess members (workers call
+        `touch_heartbeat(dir, worker_id)` per step); without it only
+        process death is detected for them."""
+        if callable(workers):
+            workers = [workers]
+        self._spawn = spawn
+        if spawn is not None:
+            self.n_workers = int(workers) if isinstance(workers, int) \
+                else len(list(workers))
+            self._worker_fns: Sequence[Callable] = ()
+        else:
+            self._worker_fns = list(workers)
+            self.n_workers = len(self._worker_fns)
+            if not self.n_workers:
+                raise ValueError("need at least one worker")
+        self.checkpoint_dir = checkpoint_dir
+        self.restart = restart if restart is not None else RetryPolicy(
+            max_attempts=3, backoff_s=0.2, name="elastic_restart")
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.heartbeat_dir = heartbeat_dir
+        #: attempt ledger: one entry per gang launch with its outcome
+        self.history: List[Dict[str, Any]] = []
+        self.restarts = 0
+        from analytics_zoo_tpu.observability import get_registry
+        reg = registry if registry is not None else get_registry()
+        self._c_restarts = reg.counter(
+            "resilience_restarts_total",
+            help="elastic-driver gang restarts")
+        self._c_deaths = reg.counter(
+            "resilience_worker_deaths_total",
+            help="gang members observed dead or stalled by the "
+                 "elastic driver")
+
+    # ------------------------------------------------------------------
+
+    def latest_committed(self) -> Optional[str]:
+        """Newest committed checkpoint under `checkpoint_dir` (None
+        before the first commit) — the only state a restart trusts."""
+        if not self.checkpoint_dir:
+            return None
+        from analytics_zoo_tpu.orca.learn.checkpoint import (
+            find_latest_checkpoint)
+        try:
+            return find_latest_checkpoint(self.checkpoint_dir)
+        except (FileNotFoundError, OSError):
+            return None
+
+    def _launch(self, attempt: int, resume: Optional[str]):
+        members = []
+        if self._spawn is not None:
+            for wid in range(self.n_workers):
+                hb = (os.path.join(self.heartbeat_dir,
+                                   f"heartbeat-{wid}")
+                      if self.heartbeat_dir else None)
+                members.append(_ProcessMember(
+                    self._spawn(wid, resume, attempt), hb))
+        else:
+            for wid, fn in enumerate(self._worker_fns):
+                ctx = WorkerContext(wid, self.n_workers, attempt,
+                                    resume)
+                members.append(_ThreadMember(fn, ctx))
+        return members
+
+    def _monitor(self, members) -> Dict[str, Any]:
+        """Poll liveness + heartbeat staleness until the gang finishes
+        or a member dies/stalls.  Returns the attempt verdict."""
+        while True:
+            dead, stalled, running = [], [], 0
+            now = time.monotonic()
+            for i, m in enumerate(members):
+                if m.finished():
+                    if m.error is not None:
+                        dead.append(i)
+                    continue
+                running += 1
+                if now - m.last_beat() > self.heartbeat_timeout_s:
+                    stalled.append(i)
+            if dead or stalled:
+                return {"ok": False, "dead": dead, "stalled": stalled,
+                        "errors": [
+                            f"{type(m.error).__name__}: {m.error}"
+                            for m in members if m.error is not None]}
+            if running == 0:
+                return {"ok": True}
+            time.sleep(self.poll_interval_s)
+
+    def _teardown(self, members) -> None:
+        """Gang semantics: one death fences everyone.  Cancel, then
+        drain with a deadline so a zombie can't race the restart."""
+        for m in members:
+            m.cancel()
+        deadline = time.monotonic() + self.drain_timeout_s
+        for m in members:
+            m.join(max(0.0, deadline - time.monotonic()))
+        # a cancelled member may have a save mid-flight: quiesce the
+        # background writer so the restart's find_latest sees a stable
+        # directory (its possibly-failed write is fine to drop)
+        from analytics_zoo_tpu.resilience.checkpointing import (
+            drain_background)
+        drain_background(raise_on_error=False)
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> List[Any]:
+        """Drive the job to completion.  Returns per-worker results
+        (in-process members; subprocess members return None).  Raises
+        `ElasticRestartExceeded` when the restart budget drains."""
+        from analytics_zoo_tpu.observability import (
+            flight_recorder,
+            log_event,
+        )
+        last_errors: List[str] = []
+        for attempt in range(1, self.restart.max_attempts + 1):
+            resume = self.latest_committed()
+            log_event("elastic_attempt", attempt=attempt,
+                      resume=resume or "")
+            members = self._launch(attempt, resume)
+            verdict = self._monitor(members)
+            if verdict["ok"]:
+                self.history.append({"attempt": attempt,
+                                     "resume": resume, "ok": True})
+                return [m.result for m in members]
+            self._teardown(members)
+            last_errors = verdict.get("errors") or [
+                f"stalled members {verdict['stalled']} (no heartbeat "
+                f"for {self.heartbeat_timeout_s}s)"]
+            n_bad = len(verdict["dead"]) + len(verdict["stalled"])
+            self._c_deaths.inc(n_bad)
+            self.history.append({"attempt": attempt, "resume": resume,
+                                 "ok": False, **verdict})
+            flight_recorder.dump(
+                "elastic_restart",
+                extra={"attempt": attempt, "dead": verdict["dead"],
+                       "stalled": verdict["stalled"],
+                       "errors": last_errors})
+            if attempt >= self.restart.max_attempts:
+                break
+            self.restarts += 1
+            self._c_restarts.inc()
+            self.restart.record_retry(RuntimeError(
+                "; ".join(last_errors)))
+            delay = self.restart.backoff(attempt)
+            if delay > 0:
+                time.sleep(delay)
+        raise ElasticRestartExceeded(
+            f"gang failed {self.restart.max_attempts} attempt(s); "
+            f"last errors: {last_errors}")
+
+
+# re-exported for subprocess worker scripts that only need the signal
+# name without importing the whole driver
+SIGKILL = getattr(signal, "SIGKILL", signal.SIGTERM)

@@ -1,0 +1,252 @@
+"""Deterministic, seeded fault injection (the chaos half of the
+resilience layer).
+
+The reference survives worker loss through the JVM retry-restore loop
+(Topology.scala:1255-1310) and Spark task re-execution — and proves it
+with integration rigs that kill real executors.  This module makes the
+same scenarios *unit-testable in one process*: named injection sites
+threaded into the hot paths (train step loops, every phase of the
+checkpoint commit protocol, the decode loop, serving admission) fire
+configured faults at deterministic hit indices.
+
+Usage::
+
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "train.step", "at": 10, "action": "raise"},
+        {"site": "generation.decode", "at": 3,
+         "action": "poison_request", "request_id": "victim"},
+    ]}
+
+Sites (each a no-op when unarmed; arming never touches a jitted
+program, so the zero-recompile contracts hold with the plan armed —
+asserted in tests/test_resilience.py):
+
+=========================== =============================================
+site                        threaded into
+=========================== =============================================
+``train.step``              SPMDEngine per-step loops (streaming + cached)
+``train.epoch``             SPMDEngine one-dispatch epoch-scan path
+``checkpoint.before_write`` commit protocol, before any byte is written
+``checkpoint.mid_write``    after the tmp-dir write, before rename
+``checkpoint.before_rename`` tmp dir complete, rename not yet executed
+``checkpoint.before_commit`` renamed into place, commit marker missing
+``checkpoint.after_commit`` marker durable (crash loses nothing)
+``checkpoint.load``         restore path (a broken load must consume
+                            retry budget, not escape it)
+``generation.decode``       engine decode round, before dispatch
+``serving.admission``       GenerationEngine.submit admission check
+=========================== =============================================
+
+Actions: ``raise`` (SimulatedWorkerFailure), ``crash``
+(SimulatedCrash — the checkpoint matrix's kill), ``torn_write``
+(truncate a just-written file, then SimulatedCrash), ``stall`` (sleep
+``delay_s``), ``poison_request`` (PoisonedRequestError carrying the
+victim request id), and caller-interpreted markers ``nan`` (the train
+loop poisons the batch host-side) / ``refuse`` (submit raises
+QueueFull).
+
+Determinism: a fault fires when its site's hit counter reaches ``at``
+(1-based), for ``times`` firings (default 1); ``prob`` instead draws
+from a PRNG seeded by ``(plan seed, site)`` — the firing pattern is a
+pure function of the plan, never of wall time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: actions fault_point resolves itself (raising / sleeping); the
+#: remaining actions ("nan", "refuse") are returned to the call site,
+#: which knows how to poison a batch or shed a request
+ACTIONS = ("raise", "crash", "torn_write", "stall", "poison_request",
+           "nan", "refuse")
+
+
+class FaultInjected(RuntimeError):
+    """Base of every injected failure — lets recovery code (and the
+    error-taxonomy lint) tell chaos from organic faults."""
+
+
+class SimulatedWorkerFailure(FaultInjected):
+    """An injected worker death (the SIGKILL'd pod member of the
+    reference's retry-restore scenario, in-process)."""
+
+
+class SimulatedCrash(FaultInjected):
+    """An injected process kill inside a checkpoint phase — the
+    crash-consistency matrix's instrument."""
+
+
+class PoisonedRequestError(FaultInjected):
+    """An injected decode-step failure attributable to ONE request;
+    the engine evicts that request and keeps serving the rest."""
+
+    def __init__(self, message: str, request_id: Optional[str] = None):
+        super().__init__(message)
+        self.request_id = request_id
+
+
+class Fault:
+    """One armed fault: a site, an action, and a deterministic firing
+    rule (`at`/`times`, or seeded `prob`)."""
+
+    __slots__ = ("site", "action", "at", "times", "delay_s",
+                 "request_id", "prob", "fired")
+
+    def __init__(self, site: str, action: str, at: int = 1,
+                 times: int = 1, delay_s: float = 0.5,
+                 request_id: Optional[str] = None,
+                 prob: Optional[float] = None):
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r}; valid: {ACTIONS}")
+        if at < 1:
+            raise ValueError("fault 'at' is a 1-based hit index")
+        self.site = str(site)
+        self.action = action
+        self.at = int(at)
+        self.times = int(times)
+        self.delay_s = float(delay_s)
+        self.request_id = request_id
+        self.prob = None if prob is None else float(prob)
+        self.fired = 0
+
+    def describe(self) -> Dict[str, Any]:
+        return {"site": self.site, "action": self.action, "at": self.at,
+                "times": self.times, "fired": self.fired}
+
+
+class FaultPlan:
+    """A seeded set of faults plus per-site hit counters.  Built from
+    a dict/list (``OrcaContext.fault_plan`` setter) or directly."""
+
+    def __init__(self, faults, seed: int = 0):
+        self.seed = int(seed)
+        self.faults: List[Fault] = [
+            f if isinstance(f, Fault) else Fault(**dict(f))
+            for f in faults]
+        self.hits: Dict[str, int] = {}
+        self._rngs: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, cfg) -> "FaultPlan":
+        if isinstance(cfg, FaultPlan):
+            return cfg
+        if isinstance(cfg, dict):
+            return cls(cfg.get("faults", []), seed=cfg.get("seed", 0))
+        return cls(list(cfg))
+
+    def _rng(self, site: str):
+        import numpy as np
+        rng = self._rngs.get(site)
+        if rng is None:
+            rng = self._rngs[site] = np.random.default_rng(
+                (self.seed, hash(site) & 0xFFFFFFFF))
+        return rng
+
+    def hit(self, site: str, ctx: Dict[str, Any]) -> Optional[Fault]:
+        """Count one hit of `site`; return the fault to fire, if any."""
+        with self._lock:
+            n = self.hits[site] = self.hits.get(site, 0) + 1
+            for f in self.faults:
+                if f.site != site or f.fired >= f.times:
+                    continue
+                if f.prob is not None:
+                    if float(self._rng(site).random()) >= f.prob:
+                        continue
+                elif n < f.at + f.fired:
+                    # fire at the at-th hit, then (times>1) every
+                    # subsequent hit until the budget drains
+                    continue
+                f.fired += 1
+                return f
+        return None
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [f.describe() for f in self.faults]
+
+
+def _active_plan() -> Optional[FaultPlan]:
+    from analytics_zoo_tpu.common.context import OrcaContext
+    return OrcaContext.fault_plan
+
+
+def _record_fire(fault: Fault, ctx: Dict[str, Any]) -> None:
+    # observability wiring is lazy so the unarmed fast path (and any
+    # process that never arms a plan) pays no import cost here
+    from analytics_zoo_tpu.observability import (
+        flight_recorder,
+        get_registry,
+        log_event,
+    )
+    get_registry().counter(
+        "resilience_faults_injected_total",
+        help="faults fired by the armed fault plan "
+             "(resilience/faults.py)").inc()
+    fields = {k: v for k, v in ctx.items()
+              if isinstance(v, (int, float, str, bool, list))}
+    flight_recorder.record("fault_injected", site=fault.site,
+                           action=fault.action, **fields)
+    log_event("fault_injected", site=fault.site, action=fault.action,
+              **fields)
+
+
+def _torn_write(path: str) -> None:
+    """Truncate the largest regular file under `path` — a torn write
+    frozen mid-flush — before the simulated kill."""
+    victim, size = None, -1
+    for dirpath, _dirs, files in os.walk(path):
+        for fn in files:
+            p = os.path.join(dirpath, fn)
+            try:
+                s = os.path.getsize(p)
+            except OSError:
+                continue
+            if s > size:
+                victim, size = p, s
+    if victim is not None:
+        with open(victim, "r+b") as f:
+            f.truncate(max(0, size // 2))
+
+
+def fault_point(site: str, **ctx) -> Optional[str]:
+    """The injection site hook.  Unarmed (no plan): returns None at
+    the cost of one attribute read.  Armed: counts the hit and, when a
+    fault fires, raises (``raise``/``crash``/``torn_write``/
+    ``poison_request``), sleeps (``stall``), or returns the action
+    string for the caller to interpret (``nan``/``refuse``)."""
+    plan = _active_plan()
+    if plan is None:
+        return None
+    fault = plan.hit(site, ctx)
+    if fault is None:
+        return None
+    _record_fire(fault, ctx)
+    if fault.action == "raise":
+        raise SimulatedWorkerFailure(
+            f"injected worker failure at {site} "
+            f"(hit {plan.hits.get(site)})")
+    if fault.action == "crash":
+        raise SimulatedCrash(f"injected crash at {site}")
+    if fault.action == "torn_write":
+        path = ctx.get("path")
+        if path and os.path.isdir(path):
+            _torn_write(path)
+        raise SimulatedCrash(f"injected torn write at {site}")
+    if fault.action == "stall":
+        time.sleep(fault.delay_s)
+        return "stall"
+    if fault.action == "poison_request":
+        rid = fault.request_id
+        ids = ctx.get("request_ids") or []
+        if rid is None or (ids and rid not in ids):
+            rid = ids[0] if ids else rid
+        raise PoisonedRequestError(
+            f"injected decode failure poisoning request {rid!r}",
+            request_id=rid)
+    return fault.action          # "nan" / "refuse": caller-interpreted

@@ -1,0 +1,105 @@
+"""Typed retry policy — ONE definition of "try again" for the whole
+framework.
+
+The reference scatters retry loops across the JVM training driver
+(`bigdl.failure.retryTimes`, Topology.scala:1255-1310), the serving
+client and the launcher scripts; this repo had grown the same ad-hoc
+spread (estimator fit loop, dryrun child respawns, client polling).
+`RetryPolicy` replaces them with a value object: max attempts,
+DETERMINISTIC exponential backoff (no jitter — test runs and replayed
+incidents see identical schedules), and an optional wall-clock
+deadline.  Adopters: `Estimator.fit`'s restore-and-resume loop, the
+checkpoint save/restore I/O (transient OSError), the serving client's
+503/Retry-After handling, and `__graft_entry__`'s multichip dryrun
+children.
+
+Every retry is counted (`resilience_retries_total`) and logged
+(`log_event("retry", ...)`) so a quietly-flapping dependency shows up
+in /metrics instead of only as latency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, Tuple, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with deterministic exponential backoff.
+
+    `backoff(attempt)` (attempt is 1-based) returns
+    ``backoff_s * multiplier**(attempt-1)`` capped at `max_backoff_s`;
+    `run(fn)` applies the policy, re-raising the last retryable error
+    once `max_attempts` or `deadline_s` is exhausted.  Non-retryable
+    exceptions propagate immediately."""
+
+    max_attempts: int = 3
+    backoff_s: float = 0.1
+    multiplier: float = 2.0
+    max_backoff_s: float = 30.0
+    deadline_s: Optional[float] = None
+    name: str = ""
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if self.backoff_s < 0 or self.multiplier < 1:
+            raise ValueError(
+                "backoff_s must be >= 0 and multiplier >= 1")
+
+    def backoff(self, attempt: int) -> float:
+        """Delay before retry number `attempt` (1-based)."""
+        return min(self.backoff_s * self.multiplier ** (attempt - 1),
+                   self.max_backoff_s)
+
+    def delays(self) -> Tuple[float, ...]:
+        """The full deterministic backoff schedule (one entry per
+        possible retry)."""
+        return tuple(self.backoff(i)
+                     for i in range(1, self.max_attempts))
+
+    def run(self, fn: Callable, *,
+            retryable: Tuple[Type[BaseException], ...] = (Exception,),
+            on_retry: Optional[Callable] = None,
+            sleep: Callable[[float], None] = time.sleep):
+        """Call `fn()` under the policy.  `on_retry(attempt, exc,
+        delay)` observes each retry decision; `sleep` is injectable for
+        tests.  The deadline covers sleeps AND the next attempt's start
+        (elapsed + pending delay past `deadline_s` stops retrying)."""
+        start = time.monotonic()
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn()
+            except retryable as e:
+                if attempt >= self.max_attempts:
+                    raise
+                delay = self.backoff(attempt)
+                if self.deadline_s is not None and \
+                        time.monotonic() - start + delay > self.deadline_s:
+                    raise
+                self.record_retry(e)
+                if on_retry is not None:
+                    on_retry(attempt, e, delay)
+                if delay > 0:
+                    sleep(delay)
+
+    def record_retry(self, exc: BaseException) -> None:
+        """Count + log one retry decision (also used by adopters that
+        keep their own loop shape, e.g. the estimator's
+        restore-and-resume cycle).  Best-effort: a client-only process
+        without the observability stack still retries fine."""
+        try:
+            from analytics_zoo_tpu.observability import (
+                get_registry,
+                log_event,
+            )
+        except Exception:
+            return
+        get_registry().counter(
+            "resilience_retries_total",
+            help="retries taken under a RetryPolicy "
+                 "(resilience/retry.py)").inc()
+        log_event("retry", policy=self.name or "anonymous",
+                  error=f"{type(exc).__name__}: {exc}")

@@ -13,6 +13,10 @@ from analytics_zoo_tpu.serving.config import (
     start_serving,
     stop_serving,
 )
+from analytics_zoo_tpu.serving.errors import (
+    ERROR_HTTP_STATUS,
+    http_status_for,
+)
 from analytics_zoo_tpu.serving.inference_model import InferenceModel
 from analytics_zoo_tpu.serving.quantize import (
     dequantize_params,
@@ -26,7 +30,7 @@ from analytics_zoo_tpu.serving.server import ServingServer
 #: serving deployment (client-only processes included) need not pay
 _GENERATION = ("GenerationEngine", "GenerationStream", "CausalLM",
                "PagedKVCache", "BlockAllocator", "SlotScheduler",
-               "sample_tokens")
+               "sample_tokens", "QueueFull", "RequestTooLarge")
 
 
 def __getattr__(name):
@@ -36,7 +40,8 @@ def __getattr__(name):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ = ["InferenceModel", "ServingServer", "InputQueue", "OutputQueue",
-           "GrpcInputQueue", "GrpcServingFrontend", "quantize_params",
+__all__ = ["ERROR_HTTP_STATUS", "InferenceModel", "ServingServer",
+           "InputQueue", "OutputQueue", "GrpcInputQueue",
+           "GrpcServingFrontend", "http_status_for", "quantize_params",
            "dequantize_params", "quantized_size_bytes", "ServingConfig",
            "start_serving", "stop_serving", *_GENERATION]

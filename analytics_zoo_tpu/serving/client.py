@@ -117,7 +117,7 @@ class InputQueue:
     def generate(self, tokens, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id: Optional[int] = None, timeout: float = 300.0,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None, retry=None):
         """Streaming generation client for POST /generate: a generator
         yielding token ids AS THE SERVER SAMPLES THEM (chunked ndjson
         lines decoded incrementally — first token arrives at decode
@@ -129,27 +129,68 @@ class InputQueue:
         `request_id` (optional) is sent as the X-Request-Id header;
         the id the server echoed back — success or error — lands in
         `self.last_request_id`, the key for the server's request
-        lifecycle log (/timeline, flight bundles)."""
+        lifecycle log (/timeline, flight bundles).
+
+        `retry` (a `resilience.RetryPolicy`) bounds re-submission when
+        the server sheds (503) or the connection is refused: the
+        client sleeps the server's Retry-After hint when one is sent
+        (capped at the policy's `max_backoff_s`), else the policy's
+        deterministic backoff, and re-sends the SAME X-Request-Id so
+        the whole journey shares one lifecycle-log record trail.
+        Retries happen only before the first token — a broken stream
+        is never silently re-run."""
         payload = {"tokens": [int(t) for t in tokens],
                    "max_new_tokens": max_new_tokens,
                    "temperature": temperature, "top_k": top_k,
                    "eos_id": eos_id}
+        if retry is not None and request_id is None:
+            # a stable id across attempts is the point of retrying
+            import uuid
+            request_id = f"cli-{uuid.uuid4().hex[:12]}"
         headers = {"Content-Type": "application/json"}
         if request_id is not None:
             headers["X-Request-Id"] = str(request_id)
-        req = urllib.request.Request(
-            f"{self.base}/generate", data=json.dumps(payload).encode(),
-            headers=headers)
         self.last_request_id = None
-        try:
-            resp = urllib.request.urlopen(req, timeout=timeout)
-        except urllib.error.HTTPError as e:
-            self.last_request_id = e.headers.get("X-Request-Id")
+        self.last_retries = 0
+        max_attempts = retry.max_attempts if retry is not None else 1
+        resp = None
+        for attempt in range(1, max_attempts + 1):
+            req = urllib.request.Request(
+                f"{self.base}/generate",
+                data=json.dumps(payload).encode(), headers=headers)
             try:
-                err = json.loads(e.read()).get("error", str(e))
-            except Exception:
-                err = str(e)
-            raise RuntimeError(f"serving error: {err}") from None
+                resp = urllib.request.urlopen(req, timeout=timeout)
+                break
+            except urllib.error.HTTPError as e:
+                self.last_request_id = e.headers.get("X-Request-Id")
+                retry_after = e.headers.get("Retry-After")
+                try:
+                    err = json.loads(e.read()).get("error", str(e))
+                except Exception:
+                    err = str(e)
+                if retry is None or e.code != 503 or \
+                        attempt >= max_attempts:
+                    raise RuntimeError(
+                        f"serving error: {err}") from None
+                delay = retry.backoff(attempt)
+                if retry_after:
+                    try:
+                        # honor the server's estimate, bounded by the
+                        # policy so a bad hint cannot park the client
+                        delay = min(float(retry_after),
+                                    retry.max_backoff_s)
+                    except ValueError:
+                        pass
+                retry.record_retry(e)
+                self.last_retries += 1
+                time.sleep(delay)
+            except urllib.error.URLError as e:
+                # connection refused/reset before any response
+                if retry is None or attempt >= max_attempts:
+                    raise
+                retry.record_retry(e)
+                self.last_retries += 1
+                time.sleep(retry.backoff(attempt))
         self.last_request_id = resp.headers.get("X-Request-Id")
         with resp:
             for raw in resp:           # http.client de-chunks for us

@@ -1,0 +1,49 @@
+"""Error taxonomy — the single source of truth mapping every typed
+exception in the serving and resilience layers to an HTTP status.
+
+Keyed by CLASS NAME (not class object) so this table imports with zero
+dependencies — a client-only process, the lint
+(scripts/check_error_taxonomy.py) and the HTTP layer all read the same
+dict.  The lint enforces three invariants over every exception class
+defined under `analytics_zoo_tpu/serving/` and
+`analytics_zoo_tpu/resilience/`:
+
+1. it is exported from its package's ``__all__`` (callers can catch it
+   by name without deep imports),
+2. it has an entry here (the HTTP layer never guesses a status),
+3. it is documented in docs/fault-tolerance.md's taxonomy table.
+"""
+
+from __future__ import annotations
+
+#: exception class name -> HTTP status the serving layer answers with.
+#: 4xx = the request's fault (do not retry unchanged); 503 = back off
+#: and retry (responses carry Retry-After); 500 = server-side fault.
+ERROR_HTTP_STATUS = {
+    # serving/generation admission + geometry
+    "RequestTooLarge": 413,
+    "QueueFull": 503,
+    # resilience: injected faults (chaos is a server-side 5xx; a
+    # poisoned request's eviction is shed-shaped, hence 503)
+    "FaultInjected": 500,
+    "SimulatedWorkerFailure": 500,
+    "SimulatedCrash": 500,
+    "PoisonedRequestError": 503,
+    # resilience: recovery machinery
+    "WorkerCancelled": 503,
+    "ElasticRestartExceeded": 500,
+    "CheckpointWriteError": 500,
+}
+
+
+def http_status_for(exc: BaseException, default: int = 500) -> int:
+    """Resolve an exception (walking its MRO, so subclasses inherit
+    their base's mapping) to an HTTP status."""
+    for klass in type(exc).__mro__:
+        status = ERROR_HTTP_STATUS.get(klass.__name__)
+        if status is not None:
+            return status
+    return default
+
+
+__all__ = ["ERROR_HTTP_STATUS", "http_status_for"]

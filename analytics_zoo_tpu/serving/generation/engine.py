@@ -52,6 +52,10 @@ from analytics_zoo_tpu.serving.generation.kv_cache import (
     dequantize_kv_tokens,
     quantize_kv_tokens,
 )
+from analytics_zoo_tpu.resilience.faults import (
+    PoisonedRequestError,
+    fault_point,
+)
 from analytics_zoo_tpu.serving.generation.sampling import sample_tokens
 from analytics_zoo_tpu.serving.generation.scheduler import (
     Sequence,
@@ -68,9 +72,18 @@ class RequestTooLarge(ValueError):
 
 
 class QueueFull(RuntimeError):
-    """Admission control: the engine's waiting queue is at `max_queue`.
-    The HTTP layer maps it to 503 — shed load at the door instead of
-    queueing unboundedly."""
+    """Admission control: the waiting queue is at `max_queue`, OR the
+    SLO-aware shedder (`OrcaContext.slo_shed_attainment`) is turning
+    load away while attainment is below target.  The HTTP layer maps
+    it to 503 and forwards `retry_after_s` as the Retry-After header —
+    shed load at the door with a comeback hint instead of queueing
+    unboundedly."""
+
+    def __init__(self, message: str,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        #: backoff hint for the client (None -> the server's default)
+        self.retry_after_s = retry_after_s
 
 
 class GenerationStream:
@@ -128,7 +141,8 @@ class GenerationEngine:
                  cache_dtype=jnp.float32, registry=None, seed: int = 0,
                  max_queue: Optional[int] = None,
                  kv_quantization: str = "auto",
-                 decode_attention: str = "paged"):
+                 decode_attention: str = "paged",
+                 slo_shed_min_queue: Optional[int] = None):
         if model.max_position_len < max_context:
             raise ValueError(
                 f"model.max_position_len {model.max_position_len} < "
@@ -184,6 +198,12 @@ class GenerationEngine:
         #: many waiting requests (None = unbounded, the library
         #: default; servers should bound it)
         self.max_queue = max_queue
+        #: floor on the waiting-queue depth before SLO-attainment
+        #: shedding kicks in (OrcaContext.slo_shed_attainment): never
+        #: shed an empty queue just because attainment dipped.
+        #: Default: one queued request per decode lane.
+        self.slo_shed_min_queue = (max_slots if slo_shed_min_queue
+                                   is None else int(slo_shed_min_queue))
         self._rng = jax.random.PRNGKey(seed)
         self._lock = threading.RLock()
         self._wake = threading.Event()
@@ -405,6 +425,41 @@ class GenerationEngine:
     # request intake
     # ------------------------------------------------------------------
 
+    def retry_after_s(self) -> float:
+        """Comeback hint attached to shed (503) responses: the queue's
+        estimated drain time from the measured decode cadence — depth
+        x mean decode-step wall — clamped to [0.05s, 10s] (0.5s before
+        any decode has been measured)."""
+        depth = len(self.scheduler.waiting)
+        if self._h_decode.calls:
+            mean = self._h_decode.total / self._h_decode.calls
+            return float(min(10.0, max(0.05, (depth + 1) * mean)))
+        return 0.5
+
+    def _shed_reason(self) -> Optional[str]:
+        """Why a new request should be turned away right now (None =
+        admit).  Two gates: the hard `max_queue` bound, and — when
+        `OrcaContext.slo_targets` + `slo_shed_attainment` are set —
+        the SLO-aware shedder: attainment below target with at least
+        `slo_shed_min_queue` requests already waiting means admitting
+        more load would spend latency the objective does not have
+        (ROADMAP item 5: slo.py *drives* 503s instead of judging
+        after the fact)."""
+        depth = len(self.scheduler.waiting)
+        if self.max_queue is not None and depth >= self.max_queue:
+            return (f"{depth} requests already waiting "
+                    f"(max_queue={self.max_queue})")
+        from analytics_zoo_tpu.common.context import OrcaContext
+        thr = OrcaContext.slo_shed_attainment
+        if thr is not None and OrcaContext.slo_targets:
+            from analytics_zoo_tpu.observability import get_slo_tracker
+            att = get_slo_tracker().attainment()
+            if att == att and att < thr and \
+                    depth >= self.slo_shed_min_queue:
+                return (f"shedding under SLO pressure: attainment "
+                        f"{att:.3f} < {thr} with {depth} waiting")
+        return None
+
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0,
                eos_id: Optional[int] = None,
@@ -434,11 +489,17 @@ class GenerationEngine:
             raise RequestTooLarge(
                 f"request needs {self.cache.blocks_for(total)} KV "
                 f"blocks, pool holds {self.cache.allocator.capacity}")
-        if self.max_queue is not None and \
-                len(self.scheduler.waiting) >= self.max_queue:
-            raise QueueFull(
-                f"{len(self.scheduler.waiting)} requests already "
-                f"waiting (max_queue={self.max_queue})")
+        shed = self._shed_reason()
+        if shed is not None:
+            raise QueueFull(shed, retry_after_s=self.retry_after_s())
+        # fault-injection site (resilience/faults.py): "refuse" sheds
+        # this request exactly like an organic overload — the client's
+        # RetryPolicy + Retry-After path is testable on demand
+        act = fault_point("serving.admission",
+                          queue_depth=len(self.scheduler.waiting))
+        if act == "refuse":
+            raise QueueFull("injected admission refusal (fault plan)",
+                            retry_after_s=self.retry_after_s())
         rid = request_log.start(request_id, prompt_len=len(prompt),
                                 max_new_tokens=int(max_new_tokens))
         seq = Sequence(prompt, max_new_tokens=max_new_tokens,
@@ -534,6 +595,12 @@ class GenerationEngine:
             temp[i] = seq.temperature
             top_k[i] = seq.top_k
         rec.lap("host_input")
+        # fault-injection site: "poison_request" raises
+        # PoisonedRequestError BEFORE dispatch (no KV/state change
+        # happened, so surviving lanes replay this round untouched);
+        # "stall" wedges the loop for the watchdog
+        fault_point("generation.decode",
+                    request_ids=[s.request_id for s in lanes.values()])
         t0 = now()
         rec.cold = "decode" not in self._goodput_warm
         kv, scl, nxt, _ = self._decode_jit(
@@ -552,6 +619,30 @@ class GenerationEngine:
             self._emit(seq, nxt[i])
         rec.end()
 
+    def _evict_poisoned(self, e: PoisonedRequestError) -> None:
+        """Graceful degradation: a step failure attributable to ONE
+        request evicts exactly that request — tagged 503 in the
+        lifecycle log, flight bundle dumped — and the engine keeps
+        serving everyone else.  Caller holds the lock."""
+        victim = None
+        for seq in self.scheduler.running():
+            if seq.request_id == e.request_id:
+                victim = seq
+                break
+        get_registry().counter(
+            "resilience_evictions_total",
+            help="requests evicted individually after an attributable "
+                 "step failure (engine kept serving)").inc()
+        log_event("generation_request_evicted",
+                  request_id=e.request_id, error=str(e))
+        request_log.event(e.request_id, "evicted", code=503,
+                          error=str(e))
+        flight_recorder.dump(
+            "generation_request_evicted",
+            extra={"request_id": e.request_id, "error": str(e)})
+        if victim is not None:
+            self._finish(victim, f"error: evicted ({e})")
+
     def step(self) -> bool:
         """One scheduling round: admit (prefill) → grow/preempt for
         decode capacity → one decode step.  Returns whether any device
@@ -563,7 +654,10 @@ class GenerationEngine:
                 did = True
             self.scheduler.ensure_decode_capacity()
             if self.scheduler.running():
-                self._decode_all()
+                try:
+                    self._decode_all()
+                except PoisonedRequestError as e:
+                    self._evict_poisoned(e)
                 did = True
             if self.watchdog is not None:
                 self.watchdog.beat()

@@ -30,8 +30,12 @@ Endpoints:
                    header (or a generated id) keys the per-request
                    lifecycle log and is echoed back on every response;
                    errors map to 400 (malformed) / 413 (can never fit)
-                   / 503 (admission queue full), each tagged with the
-                   request id in log_event and the request log.
+                   / 503 (queue full, or the SLO-aware shedder —
+                   OrcaContext.slo_shed_attainment), each tagged with
+                   the request id in log_event and the request log.
+                   503 bodies/headers carry Retry-After (the engine's
+                   queue-drain estimate) which the client's
+                   RetryPolicy honors (docs/fault-tolerance.md).
   GET  /healthz  — liveness + records served
   GET  /metrics  — Prometheus text exposition: this server's per-op
                    latency summaries (serving_queue_wait_seconds,
@@ -198,13 +202,15 @@ class ServingServer:
                           client=self.client_address[0])
 
             def _json(self, code: int, payload: Dict[str, Any],
-                      request_id: Optional[str] = None):
+                      request_id: Optional[str] = None,
+                      headers: Optional[Dict[str, str]] = None):
                 body = json.dumps(payload).encode()
                 self._body(code, body, "application/json",
-                           request_id=request_id)
+                           request_id=request_id, headers=headers)
 
             def _body(self, code: int, body: bytes, ctype: str,
-                      request_id: Optional[str] = None):
+                      request_id: Optional[str] = None,
+                      headers: Optional[Dict[str, str]] = None):
                 server._c_requests.inc()
                 if code >= 400:
                     server._c_http_errors.inc()
@@ -220,6 +226,8 @@ class ServingServer:
                 self.send_header("Content-Length", str(len(body)))
                 if request_id is not None:
                     self.send_header("X-Request-Id", request_id)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -323,11 +331,21 @@ class ServingServer:
                     self.headers.get("X-Request-Id")
                     or request_log.new_request_id())
 
-                def reject(code: int, msg: str):
+                def reject(code: int, msg: str,
+                           retry_after_s: Optional[float] = None):
                     request_log.reject(rid, code, msg)
-                    self._json(code,
-                               {"error": msg, "request_id": rid},
-                               request_id=rid)
+                    payload = {"error": msg, "request_id": rid}
+                    headers = None
+                    if code == 503:
+                        # every shed carries a comeback hint so a
+                        # well-behaved client (InputQueue with a
+                        # RetryPolicy) backs off by the server's
+                        # estimate instead of hammering the door
+                        ra = retry_after_s if retry_after_s else 1.0
+                        payload["retry_after_s"] = round(ra, 3)
+                        headers = {"Retry-After": f"{ra:.3f}"}
+                    self._json(code, payload, request_id=rid,
+                               headers=headers)
 
                 try:
                     req = json.loads(body)
@@ -354,7 +372,9 @@ class ServingServer:
                     reject(413, str(e))
                     return
                 except QueueFull as e:
-                    reject(503, str(e))
+                    reject(503, str(e),
+                           retry_after_s=getattr(e, "retry_after_s",
+                                                 None))
                     return
                 except ValueError as e:
                     reject(400, str(e))

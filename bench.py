@@ -214,6 +214,66 @@ def ncf_combined_throughput(batch: int, steps: int):
             goodput)
 
 
+def ncf_checkpoint_goodput(batch: int = 16384, steps: int = 8):
+    """Background vs sync checkpointing on an NCF fit window
+    (resilience layer, r7): identical model/data/epochs with an
+    EveryEpoch trigger saving the full ~190MB train state each epoch.
+    Asserts the two invariants the subsystem promises: the goodput
+    buckets — now including ``checkpoint`` — still sum to the fenced
+    wall within 5% (via _goodput_fields), and goodput_ratio(async) >=
+    goodput_ratio(sync): with `OrcaContext.background_checkpointing`
+    the save cost visibly leaves the critical path (one device->host
+    snapshot stays; serialization + commit move to the writer
+    thread)."""
+    import tempfile
+
+    from analytics_zoo_tpu.common.context import OrcaContext
+    from analytics_zoo_tpu.observability import step_clock
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+    from analytics_zoo_tpu.resilience.checkpointing import (
+        drain_background)
+
+    u, i, y = _ncf_data(batch * steps)
+    prev_fence = OrcaContext.goodput_sample_every
+    prev_bg = OrcaContext.background_checkpointing
+    OrcaContext.goodput_sample_every = 1
+    out = {}
+    ratios = {}
+    try:
+        for mode, bg in (("sync", False), ("async", True)):
+            OrcaContext.background_checkpointing = bg
+            with tempfile.TemporaryDirectory() as d:
+                est = Estimator.from_flax(
+                    _ncf_model(),
+                    loss="sparse_categorical_crossentropy",
+                    optimizer="adam", learning_rate=1e-3, model_dir=d)
+                # warmup epoch: compiles + the first (cold) save
+                est.fit({"x": [u, i], "y": y}, epochs=1,
+                        batch_size=batch, shuffle=False)
+                drain_background()
+                step_clock("spmd_train").reset()
+                est.fit({"x": [u, i], "y": y}, epochs=2,
+                        batch_size=batch, shuffle=False)
+                drain_background()   # async saves land before reading
+                g = _goodput_fields("spmd_train")  # sum-to-wall gate
+                assert "goodput_error" not in g, g
+                ratios[mode] = g["goodput_ratio"]
+                out[f"goodput_ckpt_{mode}_ratio"] = g["goodput_ratio"]
+                out[f"goodput_ckpt_{mode}_checkpoint_s"] = g.get(
+                    "goodput_checkpoint_s", 0.0)
+        assert out["goodput_ckpt_sync_checkpoint_s"] > 0, (
+            "sync saves recorded no checkpoint bucket", out)
+        assert ratios["async"] >= ratios["sync"], (
+            "async checkpointing did not leave the critical path: "
+            f"{out}")
+        out["goodput_ckpt_async_vs_sync"] = round(
+            ratios["async"] / max(ratios["sync"], 1e-9), 3)
+    finally:
+        OrcaContext.goodput_sample_every = prev_fence
+        OrcaContext.background_checkpointing = prev_bg
+    return out
+
+
 def ncf_raw_throughput(platform: str, batch: int, steps: int,
                        warmup: int) -> float:
     """The raw jax.jit loop on `platform` — since r5 used ONLY for the
@@ -1027,6 +1087,17 @@ def main():
 
     est_tput, raw_tput, goodput = ncf_combined_throughput(batch, steps)
 
+    ckpt = {}
+    try:
+        # resilience window (r7): sync vs background checkpointing on
+        # a small NCF fit — ~45s warm, after the primary metric
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining < 90:
+            raise TimeoutError(f"only {remaining:.0f}s left")
+        ckpt = ncf_checkpoint_goodput()
+    except Exception as e:
+        ckpt = {"ckpt_goodput_error": f"{type(e).__name__}: {e}"[:160]}
+
     longctx = {}
     try:  # quick (~10s warm): never risks the primary metric
         longctx = {"flash_attention_seq16k_fwdbwd_ms":
@@ -1091,6 +1162,7 @@ def main():
             "estimator_vs_raw": round(est_tput / raw_tput, 3),
             "cpu_raw_samples_per_sec": round(cpu, 1) if cpu else None,
             **goodput,
+            **ckpt,
             **longctx,
             **serving,
             **generation,

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Lint: every typed exception in the serving and resilience layers is
+exported, mapped to an HTTP status, and documented.
+
+A typed exception is an API: callers catch it by name, the HTTP layer
+answers with a status derived from it, and an operator debugging a 5xx
+needs its meaning written down.  Each of those three edges rots
+independently — a class renamed in code leaves a dead doc row, a new
+exception without a status entry makes the HTTP layer guess.  This
+check pins all three statically:
+
+* scan ``analytics_zoo_tpu/serving/`` and
+  ``analytics_zoo_tpu/resilience/`` for ``class X(...)`` definitions
+  whose base list names an exception (``...Error``/``...Exception`` or
+  another scanned exception class — transitive);
+* each found class must appear, as a quoted name, in SOME ``__all__``
+  list under the scanned trees (exported);
+* each must be a key of ``ERROR_HTTP_STATUS`` in
+  ``analytics_zoo_tpu/serving/errors.py`` with a sane status
+  (100-599);
+* each must appear in ``docs/fault-tolerance.md`` (the taxonomy
+  table);
+* and the REVERSE: every ``ERROR_HTTP_STATUS`` key must still name a
+  scanned class — no dead mapping entries.
+
+Run directly (``python scripts/check_error_taxonomy.py``) or via the
+tier-1 wrapper ``tests/test_error_taxonomy.py``.  Exit code 0 = clean.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = (os.path.join(REPO, "analytics_zoo_tpu", "serving"),
+             os.path.join(REPO, "analytics_zoo_tpu", "resilience"))
+ERRORS_PY = os.path.join(REPO, "analytics_zoo_tpu", "serving",
+                         "errors.py")
+DOCS = os.path.join(REPO, "docs", "fault-tolerance.md")
+
+CLASS_RE = re.compile(r"^class\s+(\w+)\(([^)]*)\)\s*:", re.M)
+ALL_RE = re.compile(r"__all__\s*=\s*\[([^\]]*)\]", re.S)
+STATUS_RE = re.compile(r"[\"'](\w+)[\"']\s*:\s*(\d+)")
+
+
+def _py_files(dirs=SCAN_DIRS):
+    for base in dirs:
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def find_exception_classes(sources=None) -> Dict[str, Tuple[str, int]]:
+    """{class_name: (relpath, lineno)} for every exception class in
+    the scanned sources.  `sources` ({path: text}) is injectable for
+    the wrapper test's self-check."""
+    if sources is None:
+        sources = {}
+        for path in _py_files():
+            with open(path, encoding="utf-8") as f:
+                sources[path] = f.read()
+    # transitive closure: a class is an exception if a base NAME ends
+    # in Error/Exception/Warning, or is itself a found exception
+    found: Dict[str, Tuple[str, int]] = {}
+    classes = []
+    for path, text in sorted(sources.items()):
+        for m in CLASS_RE.finditer(text):
+            bases = [b.strip().split(".")[-1]
+                     for b in m.group(2).split(",") if b.strip()]
+            lineno = text.count("\n", 0, m.start()) + 1
+            classes.append((m.group(1), bases,
+                            os.path.relpath(path, REPO), lineno))
+    changed = True
+    while changed:
+        changed = False
+        for name, bases, rel, lineno in classes:
+            if name in found:
+                continue
+            for b in bases:
+                if (b.endswith(("Error", "Exception", "Warning"))
+                        or b in found):
+                    found[name] = (rel, lineno)
+                    changed = True
+                    break
+    return found
+
+
+def _exported_names(sources=None) -> set:
+    if sources is None:
+        sources = {}
+        for path in _py_files():
+            with open(path, encoding="utf-8") as f:
+                sources[path] = f.read()
+    names = set()
+    for text in sources.values():
+        for block in ALL_RE.findall(text):
+            names.update(re.findall(r"[\"'](\w+)[\"']", block))
+    return names
+
+
+def _status_table(errors_text=None) -> Dict[str, int]:
+    if errors_text is None:
+        with open(ERRORS_PY, encoding="utf-8") as f:
+            errors_text = f.read()
+    m = re.search(r"ERROR_HTTP_STATUS\s*=\s*\{(.*?)\}", errors_text,
+                  re.S)
+    if not m:
+        return {}
+    return {name: int(code)
+            for name, code in STATUS_RE.findall(m.group(1))}
+
+
+def find_violations(sources=None, errors_text=None,
+                    docs_text=None) -> List[str]:
+    classes = find_exception_classes(sources)
+    exported = _exported_names(sources)
+    statuses = _status_table(errors_text)
+    if docs_text is None:
+        try:
+            with open(DOCS, encoding="utf-8") as f:
+                docs_text = f.read()
+        except OSError:
+            docs_text = ""
+    out = []
+    for name, (rel, lineno) in sorted(classes.items()):
+        where = f"{rel}:{lineno}"
+        if name not in exported:
+            out.append(f"{where}: {name} not exported from any "
+                       "__all__ in serving/ or resilience/")
+        if name not in statuses:
+            out.append(f"{where}: {name} missing from "
+                       "ERROR_HTTP_STATUS (serving/errors.py)")
+        elif not 100 <= statuses[name] <= 599:
+            out.append(f"{where}: {name} maps to invalid HTTP status "
+                       f"{statuses[name]}")
+        if name not in docs_text:
+            out.append(f"{where}: {name} undocumented in "
+                       "docs/fault-tolerance.md")
+    for name in sorted(statuses):
+        if name not in classes:
+            out.append(f"serving/errors.py: ERROR_HTTP_STATUS entry "
+                       f"{name!r} names no exception class in the "
+                       "scanned tree (dead mapping)")
+    return out
+
+
+def main() -> int:
+    violations = find_violations()
+    if not violations:
+        print("check_error_taxonomy: clean "
+              f"({len(find_exception_classes())} typed exceptions)")
+        return 0
+    print("check_error_taxonomy: violations:", file=sys.stderr)
+    for v in violations:
+        print(f"  {v}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -415,7 +415,14 @@ def test_flash_lse_cotangent_grads_match_reference():
                                    atol=2e-4)
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [
+    False,
+    # ~12s warm (PR 7 budget trim): the causal variant leaves the
+    # tier-1 gate; the non-causal param keeps ring-flash vs
+    # ring-einsum parity in the gate, and the causal MASKING path
+    # stays covered by the sp-mesh block test below
+    pytest.param(True, marks=pytest.mark.slow),
+])
 def test_ring_flash_matches_ring_einsum(causal):
     """impl='flash' ring (per-shard Pallas + lse merge) must equal the
     einsum ring in outputs AND gradients on a 4-device sp mesh

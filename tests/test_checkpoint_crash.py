@@ -1,0 +1,199 @@
+"""Crash-consistency matrix for the checkpoint commit protocol
+(orca/learn/checkpoint.py, docs/fault-tolerance.md): a kill injected
+at EVERY phase of the write→rename→commit-marker sequence must leave
+`find_latest_checkpoint` returning the previous COMMITTED version,
+and loading it must be bit-exact — never a torn or uncommitted
+directory.  Also pins the background writer's failure surfacing, the
+marker-vs-legacy resolution policy, and stale-temp sweeping."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.context import OrcaContext
+from analytics_zoo_tpu.observability import get_registry
+from analytics_zoo_tpu.orca.learn.checkpoint import (
+    COMMIT_SUFFIX,
+    find_latest_checkpoint,
+    has_commit_marker,
+    load_checkpoint,
+    save_checkpoint,
+    write_committed,
+)
+from analytics_zoo_tpu.resilience import (
+    BackgroundCheckpointer,
+    CheckpointWriteError,
+    SimulatedCrash,
+)
+
+#: every phase of the protocol a kill can land in, with the action
+#: that models it ("torn_write" additionally truncates a data file —
+#: the mid-flush state a real kill -9 freezes)
+CRASH_SITES = [
+    ("checkpoint.before_write", "crash"),
+    ("checkpoint.mid_write", "torn_write"),
+    ("checkpoint.before_rename", "crash"),
+    ("checkpoint.before_commit", "crash"),
+]
+
+
+def _state(scale=1.0):
+    r = np.random.default_rng(11)
+    return {"w": (scale * r.normal(size=(6, 4))).astype(np.float32),
+            "step": np.asarray(scale * 7, np.float32)}
+
+
+def _zeros():
+    return {"w": np.zeros((6, 4), np.float32),
+            "step": np.zeros((), np.float32)}
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    OrcaContext.fault_plan = None
+    yield
+    OrcaContext.fault_plan = None
+
+
+@pytest.mark.parametrize("site,action", CRASH_SITES,
+                         ids=[s for s, _ in CRASH_SITES])
+def test_kill_at_every_phase_preserves_latest_committed(
+        tmp_path, site, action):
+    """The matrix: baseline committed ckpt-0, then a save of ckpt-1
+    killed at `site` — find_latest must return ckpt-0 and load it
+    BIT-exact."""
+    d = str(tmp_path)
+    baseline = _state()
+    p0 = save_checkpoint(os.path.join(d, "ckpt-0"), baseline)
+    assert has_commit_marker(p0)
+
+    OrcaContext.fault_plan = {"faults": [
+        {"site": site, "action": action}]}
+    with pytest.raises(SimulatedCrash):
+        save_checkpoint(os.path.join(d, "ckpt-1"), _state(scale=2.0))
+    OrcaContext.fault_plan = None
+
+    latest = find_latest_checkpoint(d)
+    assert latest == p0, (latest, sorted(os.listdir(d)))
+    restored = load_checkpoint(latest, _zeros())
+    for k in baseline:
+        assert np.array_equal(np.asarray(restored[k]),
+                              np.asarray(baseline[k])), k
+
+
+def test_crash_after_commit_loses_nothing(tmp_path):
+    """A kill AFTER the marker landed is a clean save: ckpt-1 is the
+    latest and loads the new state bit-exact."""
+    d = str(tmp_path)
+    save_checkpoint(os.path.join(d, "ckpt-0"), _state())
+    newer = _state(scale=3.0)
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "checkpoint.after_commit", "action": "crash"}]}
+    with pytest.raises(SimulatedCrash):
+        save_checkpoint(os.path.join(d, "ckpt-1"), newer)
+    OrcaContext.fault_plan = None
+    latest = find_latest_checkpoint(d)
+    assert latest.endswith("ckpt-1")
+    restored = load_checkpoint(latest, _zeros())
+    assert np.array_equal(np.asarray(restored["w"]),
+                          np.asarray(newer["w"]))
+
+
+def test_torn_skips_are_counted_and_meta_rides_the_commit(tmp_path):
+    d = str(tmp_path)
+    c = get_registry().counter(
+        "checkpoint_torn_skipped_total",
+        help="uncommitted/torn checkpoint directories skipped "
+             "by find_latest_checkpoint")
+    before = c.value
+    save_checkpoint(os.path.join(d, "ckpt-0"), _state(),
+                    meta={"epoch": 4, "step": 40})
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "checkpoint.before_commit", "action": "crash"}]}
+    with pytest.raises(SimulatedCrash):
+        save_checkpoint(os.path.join(d, "ckpt-1"), _state())
+    OrcaContext.fault_plan = None
+    assert find_latest_checkpoint(d).endswith("ckpt-0")
+    assert c.value == before + 1      # the marker-less ckpt-1 dir
+    with open(os.path.join(d, "ckpt-0.meta.json")) as f:
+        assert json.load(f)["epoch"] == 4
+
+
+def test_background_writer_failure_surfaces_on_drain(tmp_path):
+    """A fault inside the background write is not silent: drain()
+    raises CheckpointWriteError once, and the torn write never
+    becomes the latest."""
+    d = str(tmp_path)
+    save_checkpoint(os.path.join(d, "ckpt-0"), _state())
+    writer = BackgroundCheckpointer()
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "checkpoint.before_commit", "action": "crash"}]}
+    writer.submit(os.path.join(d, "ckpt-1"), _state(scale=2.0))
+    with pytest.raises(CheckpointWriteError, match="injected crash"):
+        writer.drain()
+    OrcaContext.fault_plan = None
+    assert find_latest_checkpoint(d).endswith("ckpt-0")
+    # recovered: the next submit commits fine through the same writer
+    writer.submit(os.path.join(d, "ckpt-2"), _state(scale=3.0))
+    writer.drain()
+    assert find_latest_checkpoint(d).endswith("ckpt-2")
+    writer.close()
+
+
+def test_marker_policy_legacy_and_mixed(tmp_path):
+    """Resolution rules: a marker-less directory tree (legacy plain-
+    orbax writers) resolves through the orbax-finalized fallback; once
+    ANY marker exists, marker-less siblings are presumed uncommitted."""
+    import orbax.checkpoint as ocp
+
+    d = str(tmp_path)
+    legacy = os.path.join(d, "ckpt-0")
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(legacy, _state())
+    ckptr.wait_until_finished()
+    ckptr.close()
+    assert not has_commit_marker(legacy)
+    assert find_latest_checkpoint(d) == legacy    # legacy fallback
+    # a NEW-protocol save arrives: markers now govern, and the newest
+    # marker wins even against a newer marker-less directory
+    save_checkpoint(os.path.join(d, "ckpt-1"), _state())
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "checkpoint.before_commit", "action": "crash"}]}
+    with pytest.raises(SimulatedCrash):
+        save_checkpoint(os.path.join(d, "ckpt-2"), _state())
+    OrcaContext.fault_plan = None
+    assert find_latest_checkpoint(d).endswith("ckpt-1")
+
+
+def test_stale_temp_swept_and_invisible(tmp_path):
+    """A crashed writer's temp dir never matches ckpt-N (invisible to
+    find_latest) and is swept by the next save of the same target."""
+    d = str(tmp_path)
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "checkpoint.before_rename", "action": "crash"}]}
+    with pytest.raises(SimulatedCrash):
+        write_committed(os.path.join(d, "ckpt-0"), _state())
+    OrcaContext.fault_plan = None
+    leftovers = [n for n in os.listdir(d) if n.startswith(".tmp-")]
+    assert leftovers, "expected the crashed writer's temp dir"
+    with pytest.raises(FileNotFoundError):
+        find_latest_checkpoint(d)
+    write_committed(os.path.join(d, "ckpt-0"), _state())
+    assert not [n for n in os.listdir(d) if n.startswith(".tmp-")]
+    assert find_latest_checkpoint(d).endswith("ckpt-0")
+
+
+def test_marker_without_directory_is_not_committed(tmp_path):
+    """A marker whose directory vanished (kill mid-overwrite on a
+    non-atomic store) must not resolve."""
+    d = str(tmp_path)
+    p0 = save_checkpoint(os.path.join(d, "ckpt-0"), _state())
+    save_checkpoint(os.path.join(d, "ckpt-1"), _state())
+    # simulate: ckpt-1's dir destroyed, marker left behind
+    import shutil
+    shutil.rmtree(os.path.join(d, "ckpt-1"))
+    assert os.path.exists(os.path.join(d, "ckpt-1" + COMMIT_SUFFIX))
+    assert not has_commit_marker(os.path.join(d, "ckpt-1"))
+    assert find_latest_checkpoint(d) == p0

@@ -1,0 +1,91 @@
+"""Tier-1 wiring for scripts/check_error_taxonomy.py: the build goes
+red if a typed exception in serving/ or resilience/ is not exported,
+has no ERROR_HTTP_STATUS entry, is undocumented in
+docs/fault-tolerance.md, or if the mapping table carries a dead
+entry."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_error_taxonomy.py")
+
+
+def _load():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("azt_error_lint",
+                                                  SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_error_taxonomy_clean():
+    proc = subprocess.run([sys.executable, SCRIPT],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        "error-taxonomy violations crept in:\n" + proc.stderr)
+
+
+def test_mapping_matches_live_classes():
+    """The name-keyed table resolves the REAL classes (keys are not
+    just strings that happen to lint clean), and MRO resolution gives
+    subclasses their base's status."""
+    from analytics_zoo_tpu import resilience
+    from analytics_zoo_tpu.serving.errors import (
+        ERROR_HTTP_STATUS,
+        http_status_for,
+    )
+    from analytics_zoo_tpu.serving.generation import (
+        QueueFull,
+        RequestTooLarge,
+    )
+    assert http_status_for(RequestTooLarge("x")) == 413
+    assert http_status_for(QueueFull("x")) == 503
+    assert http_status_for(
+        resilience.PoisonedRequestError("x", request_id="r")) == 503
+    assert http_status_for(resilience.SimulatedCrash("x")) == 500
+
+    class Unmapped(RuntimeError):
+        pass
+
+    assert http_status_for(Unmapped(), default=500) == 500
+    for name in ERROR_HTTP_STATUS:
+        assert hasattr(resilience, name) or name in (
+            "RequestTooLarge", "QueueFull"), name
+
+
+def test_lint_detects_violations():
+    """Self-check on synthetic sources: the scanner finds transitive
+    exception subclasses and flags each missing edge; a clean
+    synthetic tree passes."""
+    mod = _load()
+    sources = {
+        "/x/analytics_zoo_tpu/serving/a.py":
+            "class BaseThing(RuntimeError):\n    pass\n\n"
+            "class Child(BaseThing):\n    pass\n\n"
+            "class NotAnError(object):\n    pass\n\n"
+            "__all__ = ['BaseThing']\n",
+    }
+    errors_text = 'ERROR_HTTP_STATUS = {\n    "BaseThing": 500,\n' \
+                  '    "Ghost": 503,\n}\n'
+    docs_text = "`BaseThing` is documented."
+    got = mod.find_violations(sources=sources, errors_text=errors_text,
+                              docs_text=docs_text)
+    text = "\n".join(got)
+    # Child: transitive subclass, missing all three edges
+    assert "Child not exported" in text
+    assert "Child missing from ERROR_HTTP_STATUS" in text
+    assert "Child undocumented" in text
+    # dead mapping entry flagged; plain classes ignored
+    assert "Ghost" in text and "NotAnError" not in text
+    # repaired tree is clean
+    sources["/x/analytics_zoo_tpu/serving/a.py"] = (
+        "class BaseThing(RuntimeError):\n    pass\n\n"
+        "__all__ = ['BaseThing']\n")
+    errors_text = 'ERROR_HTTP_STATUS = {\n    "BaseThing": 500,\n}\n'
+    assert mod.find_violations(sources=sources,
+                               errors_text=errors_text,
+                               docs_text=docs_text) == []

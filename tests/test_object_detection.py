@@ -153,6 +153,11 @@ def test_roi_align_exact_on_constant_patch():
     assert (pooled[1, :, 1, 1] > pooled[1, :, 0, 1]).all()
 
 
+@pytest.mark.slow   # ~16s warm (PR 7 budget trim): sibling tier-1
+# coverage: test_ssd_trains_and_detects_squares keeps the
+# detection-trains-and-localizes contract (anchors, box decode, NMS
+# path) in the gate at ~10s; faster-rcnn's two-stage specifics stay
+# covered by the box_utils/roi unit tests in this file.
 def test_faster_rcnn_trains_and_detects_squares():
     import jax.numpy as jnp
     from analytics_zoo_tpu.models.image.objectdetection import (

@@ -442,7 +442,7 @@ def test_goodput_endpoint(obs_server):
     for name, table in payload["clocks"].items():
         assert set(table["buckets_s"]) == {
             "compile", "host_input", "device_compute",
-            "blocked_collective", "overhead"}, name
+            "blocked_collective", "checkpoint", "overhead"}, name
         assert table["steps"] >= table["fenced_steps"] >= 0
     # the aggregate gauge rides /metrics too
     parsed = parse_prometheus_text(_get(obs_server, "/metrics"))

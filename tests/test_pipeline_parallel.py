@@ -335,6 +335,13 @@ def test_dead_tick_gating_policies_agree(pp_mesh):
                                np.asarray(outs[False][2]), atol=1e-6)
 
 
+@pytest.mark.slow   # ~21s warm (PR 7 budget trim): deliberately
+# cache-less (the module fixture disables the poisoned persistent
+# cache), so it pays fresh XLA:CPU compiles EVERY tier-1 run and is
+# rendezvous-flake-prone under load.  Sibling tier-1 coverage: the
+# multichip dryrun's pipeline stage runs the same dp x pp x fsdp
+# composition in a cache-less child (driver-verified), and the other
+# tests in this file keep pipeline_apply/1f1b semantics in the gate.
 def test_pipeline_fsdp_composition_shards_and_trains():
     """r5 (VERDICT ask 5): dp x pp x fsdp — stage stacks shard
     "pp:0,fsdp", embed/head shard "fsdp", and the pipelined estimator

@@ -1,0 +1,406 @@
+"""The resilience layer (analytics_zoo_tpu/resilience/,
+docs/fault-tolerance.md): fault-plan determinism, the typed
+RetryPolicy, and the acceptance fault matrix over the real stack —
+worker kill and injected-NaN auto-recovery with loss parity through
+Estimator + ElasticTrainingDriver, poisoned-request eviction that
+never kills the engine, SLO-driven shedding with Retry-After honored
+by the client's RetryPolicy, and the zero-recompile contracts on the
+default train step and the decode loop WITH the resilience layer
+armed.  (Worker-stall recovery and the checkpoint crash matrix live
+in tests/test_elastic_restart.py / tests/test_checkpoint_crash.py.)"""
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+import jax
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.common.context import OrcaContext
+from analytics_zoo_tpu.observability import get_registry
+from analytics_zoo_tpu.resilience import (
+    ElasticTrainingDriver,
+    FaultPlan,
+    RetryPolicy,
+    SimulatedWorkerFailure,
+    fault_point,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_knobs():
+    OrcaContext.fault_plan = None
+    prev_bg = OrcaContext.background_checkpointing
+    yield
+    OrcaContext.fault_plan = None
+    OrcaContext.background_checkpointing = prev_bg
+    OrcaContext.slo_shed_attainment = None
+    OrcaContext.slo_targets = None
+
+
+# ----------------------------------------------------------------------
+# fault plan + retry policy units
+# ----------------------------------------------------------------------
+
+def test_fault_plan_is_deterministic_and_bounded():
+    plan = FaultPlan([{"site": "a", "at": 3, "action": "raise",
+                       "times": 2}])
+    OrcaContext.fault_plan = plan
+    assert fault_point("a") is None          # hit 1
+    assert fault_point("a") is None          # hit 2
+    for _ in range(2):                       # hits 3, 4: times=2
+        with pytest.raises(SimulatedWorkerFailure):
+            fault_point("a")
+    assert fault_point("a") is None          # budget drained
+    assert plan.snapshot()[0]["fired"] == 2
+    # sites are independent counters
+    assert fault_point("b") is None
+
+
+def test_fault_plan_seeded_prob_is_reproducible():
+    def firing_pattern(seed):
+        plan = FaultPlan([{"site": "p", "action": "nan", "prob": 0.5,
+                           "times": 1000}], seed=seed)
+        OrcaContext.fault_plan = plan
+        out = [fault_point("p") is not None for _ in range(32)]
+        OrcaContext.fault_plan = None
+        return out
+
+    a, b = firing_pattern(seed=4), firing_pattern(seed=4)
+    assert a == b and any(a) and not all(a)
+    assert firing_pattern(seed=5) != a
+
+
+def test_fault_point_unarmed_is_noop_and_caller_marker_actions():
+    assert fault_point("anything", step=3) is None
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "m", "at": 1, "action": "nan"},
+        {"site": "r", "at": 1, "action": "refuse"}]}
+    assert fault_point("m") == "nan"
+    assert fault_point("r") == "refuse"
+
+
+def test_fault_firings_are_counted():
+    c = get_registry().counter(
+        "resilience_faults_injected_total",
+        help="faults fired by the armed fault plan "
+             "(resilience/faults.py)")
+    before = c.value
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "c", "at": 1, "action": "nan"}]}
+    fault_point("c")
+    assert c.value == before + 1
+
+
+def test_retry_policy_schedule_and_run():
+    p = RetryPolicy(max_attempts=4, backoff_s=0.1, multiplier=2.0,
+                    max_backoff_s=0.25)
+    assert p.delays() == (0.1, 0.2, 0.25)    # capped, deterministic
+    calls, slept = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert p.run(flaky, retryable=(OSError,),
+                 sleep=slept.append) == "ok"
+    assert len(calls) == 3 and slept == [0.1, 0.2]
+
+    # non-retryable propagates immediately
+    def wrong_type():
+        calls.append(1)
+        raise ValueError("no")
+
+    calls.clear()
+    with pytest.raises(ValueError):
+        p.run(wrong_type, retryable=(OSError,), sleep=slept.append)
+    assert len(calls) == 1
+
+    # budget exhaustion re-raises the last error
+    def always():
+        raise OSError("forever")
+
+    with pytest.raises(OSError, match="forever"):
+        p.run(always, retryable=(OSError,), sleep=lambda _s: None)
+
+
+def test_retry_policy_deadline_stops_early():
+    p = RetryPolicy(max_attempts=10, backoff_s=100.0,
+                    deadline_s=0.01)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        p.run(always, retryable=(OSError,), sleep=lambda _s: None)
+    assert len(calls) == 1      # the 100s backoff would blow 0.01s
+
+
+# ----------------------------------------------------------------------
+# training fault matrix: kill + NaN auto-recover with loss parity
+# ----------------------------------------------------------------------
+
+class _Net(nn.Module):
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        h = nn.tanh(nn.Dense(16)(x))
+        return nn.Dense(2)(h)
+
+
+def _data():
+    r = np.random.default_rng(5)
+    x = r.normal(size=(128, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    return x, y
+
+
+EPOCHS = 4
+
+
+def _fit_job(model_dir, x, y, nan_policy="warn"):
+    """One driver attempt: resume from the newest committed
+    checkpoint (epoch cursor included) and train the REMAINING
+    epochs.  max_failures=0 pins the division of labor — in-process
+    fit retries stay out of the way, the driver is the supervisor."""
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+
+    def job(ctx):
+        est = Estimator.from_flax(
+            _Net(), loss="sparse_categorical_crossentropy",
+            optimizer="sgd", learning_rate=0.1, model_dir=model_dir)
+        est.resume_latest()
+        if est.epoch < EPOCHS:
+            est.fit({"x": x, "y": y}, epochs=EPOCHS - est.epoch,
+                    batch_size=32, shuffle=False, max_failures=0,
+                    nan_policy=nan_policy)
+        return est.evaluate({"x": x, "y": y}, batch_size=64)["loss"]
+    return job
+
+
+@pytest.fixture(scope="module")
+def control_loss(tmp_path_factory):
+    init_orca_context(cluster_mode="local")
+    x, y = _data()
+    d = str(tmp_path_factory.mktemp("control"))
+    OrcaContext.fault_plan = None
+    loss = ElasticTrainingDriver(_fit_job(d, x, y),
+                                 checkpoint_dir=d).run()[0]
+    return loss
+
+
+def test_worker_kill_autorecovers_with_loss_parity(tmp_path,
+                                                   control_loss):
+    """SimulatedWorkerFailure at epoch 2, step 2 escapes fit
+    (max_failures=0), the driver restarts, resume_latest picks the
+    epoch-1 committed checkpoint, and the replayed trajectory matches
+    the uninterrupted loss."""
+    x, y = _data()
+    d = str(tmp_path)
+    # 4 steps/epoch: hit 10 = epoch 2, step 2 (ckpt of epoch 1 exists)
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "train.step", "at": 10, "action": "raise"}]}
+    drv = ElasticTrainingDriver(
+        _fit_job(d, x, y), checkpoint_dir=d,
+        restart=RetryPolicy(max_attempts=3, backoff_s=0.05,
+                            name="kill_matrix"))
+    got = drv.run()[0]
+    assert drv.restarts == 1
+    assert drv.history[1]["resume"] is not None
+    np.testing.assert_allclose(got, control_loss, rtol=1e-6)
+
+
+def test_injected_nan_step_autorecovers_with_loss_parity(
+        tmp_path, control_loss):
+    """A host-poisoned NaN batch (zero-recompile injection) trips the
+    on-device guard; nan_policy='raise' fails the epoch WITHOUT
+    checkpointing the skipped-step trajectory; the driver replays the
+    epoch cleanly from the last committed state — parity, not a
+    silently skipped update."""
+    x, y = _data()
+    d = str(tmp_path)
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "train.step", "at": 10, "action": "nan"}]}
+    drv = ElasticTrainingDriver(
+        _fit_job(d, x, y, nan_policy="raise"), checkpoint_dir=d,
+        restart=RetryPolicy(max_attempts=3, backoff_s=0.05,
+                            name="nan_matrix"))
+    got = drv.run()[0]
+    assert drv.restarts == 1
+    assert "NaNLossError" in drv.history[0]["errors"][0]
+    np.testing.assert_allclose(got, control_loss, rtol=1e-6)
+
+
+def test_train_step_zero_recompile_with_resilience_armed(tmp_path):
+    """The zero-recompile contract holds with the whole layer armed:
+    a (never-firing) fault plan + background checkpointing through an
+    epoch of training and a triggered save -> ONE compiled train-step
+    variant, and the engine state advanced."""
+    init_orca_context(cluster_mode="local")
+    x, y = _data()
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "train.step", "at": 10 ** 9, "action": "raise"}]}
+    OrcaContext.background_checkpointing = True
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+    est = Estimator.from_flax(
+        _Net(), loss="sparse_categorical_crossentropy",
+        optimizer="sgd", learning_rate=0.1, model_dir=str(tmp_path))
+    est.fit({"x": x, "y": y}, epochs=2, batch_size=32, shuffle=False)
+    size = est._engine._train_step._cache_size
+    if size is not None:
+        assert size() == 1, "train step recompiled under faults/bg-ckpt"
+    from analytics_zoo_tpu.orca.learn.checkpoint import (
+        find_latest_checkpoint)
+    assert find_latest_checkpoint(str(tmp_path))  # committed save
+
+
+# ----------------------------------------------------------------------
+# serving fault matrix: eviction, shedding, client retry
+# ----------------------------------------------------------------------
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from analytics_zoo_tpu.serving.generation import (
+        CausalLM,
+        GenerationEngine,
+    )
+    import jax.numpy as jnp
+
+    model = CausalLM(vocab=VOCAB, hidden_size=32, n_head=4, n_block=2,
+                     intermediate_size=64, max_position_len=256)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        jnp.arange(8)[None])["params"]
+    eng = GenerationEngine(model, params, max_slots=4, block_size=8,
+                           max_context=64)
+    eng.warmup()
+    return eng
+
+
+def test_poisoned_request_evicted_engine_survives(engine):
+    """An injected decode failure attributable to one request evicts
+    exactly that request (tagged 503 in the lifecycle log, counted),
+    every other stream completes in full, the engine keeps serving,
+    and the decode step never recompiles."""
+    from analytics_zoo_tpu.observability import request_log
+
+    rng = np.random.default_rng(3)
+    prompts = {f"req-{j}": list(rng.integers(0, VOCAB, 5 + j))
+               for j in range(3)}
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "generation.decode", "at": 3,
+         "action": "poison_request", "request_id": "req-1"}]}
+    c = get_registry().counter(
+        "resilience_evictions_total",
+        help="requests evicted individually after an attributable "
+             "step failure (engine kept serving)")
+    before = c.value
+    streams = {rid: engine.submit(p, max_new_tokens=8, request_id=rid)
+               for rid, p in prompts.items()}
+    engine.run_until_idle()
+    OrcaContext.fault_plan = None
+
+    victim = streams["req-1"]
+    assert victim.finish_reason.startswith("error: evicted")
+    assert len(victim.tokens()) < 8
+    for rid in ("req-0", "req-2"):     # survivors complete in full
+        assert len(streams[rid].tokens()) == 8
+        assert streams[rid].finish_reason == "length"
+    assert c.value == before + 1
+    rec = request_log.get("req-1")
+    assert any(e["kind"] == "evicted" and e.get("code") == 503
+               for e in rec["events"])
+    # engine alive: a fresh request completes
+    post = engine.submit(prompts["req-0"], max_new_tokens=4,
+                         request_id="req-after")
+    engine.run_until_idle()
+    assert len(post.tokens()) == 4
+    assert engine.decode_compile_count == 1   # zero-recompile, armed
+
+
+def test_slo_attainment_drives_shedding(engine):
+    """With targets configured and attainment below the threshold,
+    submit sheds once the queue is at least slo_shed_min_queue deep —
+    the blind max_queue bound is no longer the only defense — and the
+    QueueFull carries a queue-drain Retry-After estimate."""
+    from analytics_zoo_tpu.observability import reset_slo_tracker
+    from analytics_zoo_tpu.serving.generation import QueueFull
+
+    OrcaContext.slo_targets = {"e2e_s": 0.001}
+    OrcaContext.slo_shed_attainment = 0.99
+    tracker = reset_slo_tracker()
+    tracker.observe({"e2e_s": 5.0})          # attainment -> 0.0
+    assert tracker.attainment() == 0.0
+    engine.slo_shed_min_queue = 2
+    try:
+        s1 = engine.submit([1, 2, 3], max_new_tokens=2)
+        s2 = engine.submit([1, 2, 3], max_new_tokens=2)
+        with pytest.raises(QueueFull, match="SLO pressure") as ei:
+            engine.submit([1, 2, 3], max_new_tokens=2)
+        assert ei.value.retry_after_s > 0
+    finally:
+        OrcaContext.slo_targets = None
+        OrcaContext.slo_shed_attainment = None
+        reset_slo_tracker()
+        engine.run_until_idle()              # drain s1/s2
+        s1.tokens(), s2.tokens()
+
+
+def test_shed_backoff_success_with_request_id_preserved(engine):
+    """Satellite: shed -> backoff -> success through the HTTP stack.
+    The server's 503 carries Retry-After; the client's RetryPolicy
+    honors it and re-sends the SAME X-Request-Id, so the rejection
+    and the eventual success share one id trail."""
+    from analytics_zoo_tpu.observability import request_log
+    from analytics_zoo_tpu.serving import InputQueue, ServingServer
+
+    srv = ServingServer(generation_engine=engine).start()
+    try:
+        OrcaContext.fault_plan = {"faults": [
+            {"site": "serving.admission", "at": 1,
+             "action": "refuse"}]}
+        iq = InputQueue(srv.host, srv.port)
+        toks = list(iq.generate(
+            [5, 6, 7], max_new_tokens=6, request_id="shed-me",
+            retry=RetryPolicy(max_attempts=3, backoff_s=0.05,
+                              name="client_shed")))
+        assert len(toks) == 6
+        assert iq.last_retries == 1
+        assert iq.last_request_id == "shed-me"
+        rec = request_log.get("shed-me")
+        assert rec is not None and rec["status"] == "finished"
+
+        # without a retry policy the same shed surfaces as an error
+        OrcaContext.fault_plan = {"faults": [
+            {"site": "serving.admission", "at": 1,
+             "action": "refuse"}]}
+        with pytest.raises(RuntimeError, match="injected admission"):
+            list(iq.generate([5, 6, 7], max_new_tokens=2))
+    finally:
+        OrcaContext.fault_plan = None
+        srv.stop()
+    assert engine.decode_compile_count == 1
+
+
+def test_generation_stall_fault_trips_only_wallclock(engine):
+    """The stall action wedges one decode round for its configured
+    delay and then the request completes — the deterministic
+    instrument behind watchdog/stall testing (the full stall-recovery
+    story is the elastic driver's, tests/test_elastic_restart.py)."""
+    from analytics_zoo_tpu.observability import now
+
+    OrcaContext.fault_plan = {"faults": [
+        {"site": "generation.decode", "at": 1, "action": "stall",
+         "delay_s": 0.2}]}
+    t0 = now()
+    out = engine.generate([9, 10, 11], max_new_tokens=3)
+    OrcaContext.fault_plan = None
+    assert len(out) == 3
+    assert now() - t0 >= 0.2
+    assert engine.decode_compile_count == 1

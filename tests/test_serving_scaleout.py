@@ -32,6 +32,10 @@ def _save_tiny_model(tmp_path):
     return model.save_model(str(tmp_path / "m")), model
 
 
+@pytest.mark.slow   # ~17s warm (PR 7 budget trim): pure worker-pool
+# fan-out.  Sibling tier-1 coverage: test_server_with_replicas_and_
+# image_payload drives the SAME pool through ServingServer end to end
+# (replica dispatch, per-worker serving counts) and stays in the gate.
 def test_worker_pool_fan_out_fan_in(tmp_path):
     from analytics_zoo_tpu.serving.worker_pool import WorkerPool
 
