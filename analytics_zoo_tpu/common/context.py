@@ -67,6 +67,9 @@ class OrcaContextMeta(type):
     _fault_plan = None
     _background_checkpointing = False
     _slo_shed_attainment = None
+    _prefix_caching = False
+    _chunked_prefill = False
+    _host_input_prefetch = 2
 
     # --- TPU runtime state ---
     _mesh = None
@@ -383,6 +386,64 @@ class OrcaContextMeta(type):
                 raise ValueError(
                     "slo_shed_attainment must be in (0, 1] or None")
         cls._slo_shed_attainment = value
+
+    @property
+    def prefix_caching(cls):
+        """Radix-tree prompt-prefix reuse in the generation engine
+        (serving/generation/prefix_cache.py; docs/generation.md).
+        False (default) keeps the engine bitwise-identical to the
+        pre-cache behavior: every request prefills its full prompt and
+        owns its KV blocks exclusively.  True: on admission the
+        scheduler looks up the longest cached whole-block prompt
+        prefix, shares those blocks (copy-on-write guarded, refcounted
+        in `BlockAllocator`), prefills only the tail, and commits full
+        prompt blocks back to the radix tree; unreferenced cached
+        blocks are LRU-evicted under pool pressure before any running
+        lane is preempted.  Read at engine construction (pass
+        `GenerationEngine(prefix_caching=...)` to override per
+        engine)."""
+        return cls._prefix_caching
+
+    @prefix_caching.setter
+    def prefix_caching(cls, value):
+        cls._prefix_caching = bool(value)
+
+    @property
+    def chunked_prefill(cls):
+        """Chunked prefill in the generation engine (default False).
+        When True, a long prompt's prefill is split across scheduling
+        rounds in `prefill_token_budget`-bounded chunks, with a decode
+        step for every running lane BETWEEN chunks — a 32k-token
+        prompt no longer stalls every active lane for its whole
+        prefill (the TTFT/TPOT histograms and SLO attainment gauge are
+        the regression gate).  Read at engine construction
+        (`GenerationEngine(chunked_prefill=...)` overrides).  The
+        decode program is untouched either way: the one-static-shape
+        zero-recompile contract holds with chunking armed (asserted in
+        tests and bench)."""
+        return cls._chunked_prefill
+
+    @chunked_prefill.setter
+    def chunked_prefill(cls, value):
+        cls._chunked_prefill = bool(value)
+
+    @property
+    def host_input_prefetch(cls):
+        """Host-input double-buffering depth for the SPMD host-
+        streaming train/eval loops (orca/learn/spmd.py).  With depth
+        N >= 1 the engine keeps N batches staged ahead and assembles +
+        `device_put`s the NEXT batch while the CURRENT step runs on
+        the device, so the goodput ``host_input`` bucket shrinks
+        toward zero (bench's prefetch window asserts it).  0 disables
+        prefetching: each batch is assembled synchronously before its
+        step (the comparison baseline).  Default 2."""
+        return cls._host_input_prefetch
+
+    @host_input_prefetch.setter
+    def host_input_prefetch(cls, value):
+        if int(value) < 0:
+            raise ValueError("host_input_prefetch must be >= 0")
+        cls._host_input_prefetch = int(value)
 
     @property
     def kernel_tuning_mode(cls):

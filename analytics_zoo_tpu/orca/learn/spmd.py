@@ -590,23 +590,53 @@ class SPMDEngine:
             return {}
         return self._fetch_totals(totals)
 
-    def _prefetch(self, batch_iter, depth: int = 2):
-        """Stage host batches onto the devices ahead of consumption.
+    class _HostPrefetcher:
+        """Double-buffered host→device input staging
+        (`OrcaContext.host_input_prefetch`).
 
-        `put_batch` issues an *asynchronous* device transfer (single-host
-        fast path in `shard_batch`), so staging `depth` batches ahead on
-    this thread overlaps batch k+1's host→HBM copy with step k's compute
-        — no background thread (a Python prefetch thread contends on the
-        GIL with step dispatch and was measured 5x slower end-to-end)."""
-        from collections import deque
+        `put_batch` issues an *asynchronous* device transfer
+        (single-host fast path in `shard_batch`), so with depth >= 1
+        the loop pops an ALREADY-staged batch at the top of each step
+        (the ``host_input`` goodput lap shrinks to a deque pop) and
+        stages the next one RIGHT AFTER dispatching the step — batch
+        k+1's numpy assembly and host→HBM copy run while step k
+        computes on the device, so on a fenced step the staging wall
+        hides inside the device wait.  No background thread: a Python
+        prefetch thread contends on the GIL with step dispatch and was
+        measured 5x slower end-to-end.  depth == 0 disables the
+        overlap: each batch is assembled synchronously inside its own
+        step (the comparison baseline bench's prefetch window times
+        this path against)."""
 
-        staged = deque()
-        for hb in batch_iter:
-            staged.append(self.put_batch(hb))
-            if len(staged) > depth:
-                yield staged.popleft()
-        while staged:
-            yield staged.popleft()
+        def __init__(self, engine: "SPMDEngine", batch_iter,
+                     depth: int):
+            from collections import deque
+
+            self._put = engine.put_batch
+            self._it = iter(batch_iter)
+            self.depth = max(0, int(depth))
+            self._staged = deque()
+            self._done = False
+            self.stage(self.depth)
+
+        def stage(self, n: int = 1) -> None:
+            """Assemble + device_put up to `n` more batches."""
+            for _ in range(n):
+                if self._done:
+                    return
+                try:
+                    hb = next(self._it)
+                except StopIteration:
+                    self._done = True
+                    return
+                self._staged.append(self._put(hb))
+
+        def pop(self):
+            """Next staged batch (staging inline when nothing is
+            buffered — the depth-0 path), or None at exhaustion."""
+            if not self._staged and not self._done:
+                self.stage(1)
+            return self._staged.popleft() if self._staged else None
 
     def _annotate_mesh(self):
         """Stamp the enclosing span (estimator.epoch, a bench harness,
@@ -707,8 +737,13 @@ class SPMDEngine:
         The loop never syncs with the device: stats are accumulated in a
         device-side total (one tiny jitted add per step, dispatched
         asynchronously) and fetched once at the end of the epoch, and input
-        batches are staged onto devices `depth` ahead on this same thread
-        (see `_prefetch`) — so the accelerator pipeline stays full
+        batches are double-buffered `OrcaContext.host_input_prefetch`
+        ahead on this same thread — the NEXT batch is assembled and
+        `device_put` right after the CURRENT step's dispatch, so host
+        input staging overlaps device compute and the goodput
+        ``host_input`` bucket measures only a deque pop (see
+        `_HostPrefetcher`; depth 0 restores synchronous per-step
+        staging) — so the accelerator pipeline stays full
         (VERDICT r1 weak #2).  Exceptions: every
         `OrcaContext.goodput_sample_every`-th step is closed with a
         `block_until_ready` fence so the goodput clock can decompose it
@@ -724,14 +759,16 @@ class SPMDEngine:
         kind = "train" if train else "eval"
         clock = self._clock_train if train else self._clock_eval
         sentinel = train and OrcaContext.nonfinite_watchdog
-        it = iter(self._prefetch(batch_iter))
+        pre = self._HostPrefetcher(self, batch_iter,
+                                   OrcaContext.host_input_prefetch)
         while True:
             rec = clock.begin(force_fence=profile or sentinel)
-            try:
-                # pulling the next staged batch IS the host-input cost
-                # (HostDataset assembly + async device_put)
-                batch = next(it)
-            except StopIteration:
+            # with prefetch this pops an already-staged batch (staging
+            # happened inside the PREVIOUS step's device window); at
+            # depth 0 it assembles + device_puts inline, so the whole
+            # host-input cost lands in this lap
+            batch = pre.pop()
+            if batch is None:
                 break
             rec.lap("host_input")
             # fault-injection site: "raise"/"crash" kill the worker
@@ -753,6 +790,12 @@ class SPMDEngine:
                 else:
                     stats = self._eval_step(self.state, batch)
             rec.lap("compile" if rec.cold else None)
+            if pre.depth > 0:
+                # double buffering: assemble + device_put the NEXT
+                # batch while THIS step runs on the device — on a
+                # fenced step the staging wall hides inside the
+                # device_compute wait below
+                pre.stage(1)
             if rec.fenced:
                 # opt-in / sampled: blocking per step defeats async
                 # dispatch, but gives true per-step wall time

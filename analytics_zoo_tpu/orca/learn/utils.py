@@ -208,7 +208,8 @@ class _StreamingHostDataset(HostDataset):
     def _shard_iter(self, order: np.ndarray):
         """Yield extracted shards in `order`, loading one ahead on a
         background thread (pickle/pandas IO releases the GIL; the device
-        upload itself stays on the caller thread — see SPMDEngine._prefetch).
+        upload itself stays on the caller thread — see
+        SPMDEngine._HostPrefetcher).
         If the consumer abandons the generator mid-epoch, the `finally`
         sets `stop` so the loader exits instead of blocking on q.put
         forever holding shard memory."""

@@ -34,6 +34,7 @@ site                        threaded into
 ``checkpoint.load``         restore path (a broken load must consume
                             retry budget, not escape it)
 ``generation.decode``       engine decode round, before dispatch
+``generation.prefix_lookup`` prefix-cache radix lookup on admission
 ``serving.admission``       GenerationEngine.submit admission check
 =========================== =============================================
 

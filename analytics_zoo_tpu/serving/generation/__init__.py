@@ -4,7 +4,8 @@ The reference's Cluster Serving layer streams fixed-shape record
 batches; generative workloads need the opposite shape of pipeline —
 iteration-level scheduling over a paged KV cache (vLLM-style
 PagedAttention block tables; Orca-style join/leave between decode
-steps).  Four pieces, one subsystem:
+steps; SGLang-style radix-tree prefix reuse).  Five pieces, one
+subsystem:
 
 * `PagedKVCache` / `BlockAllocator` — fixed-size KV blocks in one
   preallocated device buffer, host-side free-list allocation,
@@ -14,6 +15,10 @@ steps).  Four pieces, one subsystem:
   admission, sequences join/leave between steps via the active-slot
   mask so steady-state serving never changes a compiled shape
   (scheduler.py).
+* `PrefixCache` — radix tree over token-id block chunks mapping
+  prompt prefixes to committed, refcount-shared KV pool blocks with
+  copy-on-write and LRU eviction (prefix_cache.py;
+  `OrcaContext.prefix_caching`).
 * `CausalLM` — a GPT-style decoder on
   `ops.attention.dot_product_attention`'s KV-cache read path
   (model.py), with greedy/temperature/top-k sampling (sampling.py).
@@ -39,6 +44,9 @@ from analytics_zoo_tpu.serving.generation.kv_cache import (  # noqa: F401
 from analytics_zoo_tpu.serving.generation.model import (  # noqa: F401
     CausalLM,
 )
+from analytics_zoo_tpu.serving.generation.prefix_cache import (  # noqa: F401,E501
+    PrefixCache,
+)
 from analytics_zoo_tpu.serving.generation.sampling import (  # noqa: F401
     sample_tokens,
 )
@@ -48,7 +56,7 @@ from analytics_zoo_tpu.serving.generation.scheduler import (  # noqa: F401
 )
 
 __all__ = ["BlockAllocator", "CausalLM", "GenerationEngine",
-           "GenerationStream", "PagedKVCache", "QueueFull",
-           "RequestTooLarge", "Sequence", "SlotScheduler",
+           "GenerationStream", "PagedKVCache", "PrefixCache",
+           "QueueFull", "RequestTooLarge", "Sequence", "SlotScheduler",
            "dequantize_kv_tokens", "quantize_kv_tokens",
            "sample_tokens"]

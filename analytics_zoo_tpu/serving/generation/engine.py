@@ -24,6 +24,19 @@ Streaming: `submit()` returns a `GenerationStream`; the engine loop
 pushes each sampled token as it exists, so a consumer (the HTTP
 /generate chunked response) emits tokens with per-token latency, not
 per-request.
+
+Prefix caching + chunked prefill (`OrcaContext.prefix_caching` /
+`OrcaContext.chunked_prefill`, both default off → the legacy paths are
+bitwise untouched): with either on, prefill runs through ONE extra
+compiled family — the chunk step, which attends over the
+already-written pool context and writes a bucket-sized slab of new
+positions — so a prefix-cache hit prefills only the uncovered tail,
+and (chunked mode) a long prompt spreads its prefill across scheduling
+rounds under the existing token budget instead of stalling every
+running lane.  The radix tree, refcounted block sharing and
+copy-on-write live in prefix_cache.py + scheduler.py; the decode
+program is identical in every mode, so the zero-recompile contract
+survives with everything armed.
 """
 
 from __future__ import annotations
@@ -56,6 +69,7 @@ from analytics_zoo_tpu.resilience.faults import (
     PoisonedRequestError,
     fault_point,
 )
+from analytics_zoo_tpu.serving.generation.prefix_cache import PrefixCache
 from analytics_zoo_tpu.serving.generation.sampling import sample_tokens
 from analytics_zoo_tpu.serving.generation.scheduler import (
     Sequence,
@@ -142,7 +156,8 @@ class GenerationEngine:
                  max_queue: Optional[int] = None,
                  kv_quantization: str = "auto",
                  decode_attention: str = "paged",
-                 slo_shed_min_queue: Optional[int] = None):
+                 slo_shed_min_queue: Optional[int] = None,
+                 prefix_caching="auto", chunked_prefill="auto"):
         if model.max_position_len < max_context:
             raise ValueError(
                 f"model.max_position_len {model.max_position_len} < "
@@ -161,11 +176,27 @@ class GenerationEngine:
         #: gather+concat-attend path (the bench baseline / parity
         #: oracle)
         self.decode_attention = decode_attention
+        from analytics_zoo_tpu.common.context import OrcaContext
         if kv_quantization == "auto":
-            from analytics_zoo_tpu.common.context import OrcaContext
             kv_quantization = OrcaContext.kv_cache_quantization
         self.kv_quantization = kv_quantization
         self._quantized = kv_quantization == "int8"
+        #: radix-tree prompt-prefix reuse (prefix_cache.py) — "auto"
+        #: reads OrcaContext.prefix_caching; off (the default) keeps
+        #: the engine bitwise-identical to the pre-cache behavior
+        if prefix_caching == "auto":
+            prefix_caching = OrcaContext.prefix_caching
+        self.prefix_caching = bool(prefix_caching)
+        #: chunked prefill — "auto" reads OrcaContext.chunked_prefill;
+        #: on, long prompts prefill in token-budget-bounded chunks
+        #: with decode steps for the other lanes in between
+        if chunked_prefill == "auto":
+            chunked_prefill = OrcaContext.chunked_prefill
+        self.chunked_prefill = bool(chunked_prefill)
+        #: either feature routes prefill through the chunk step (the
+        #: ctx-aware prefill program); both off keeps the legacy
+        #: whole-prompt prefill path untouched
+        self._use_chunks = self.prefix_caching or self.chunked_prefill
         if num_blocks is None:
             # comfortable default: every lane can hold a full context
             num_blocks = max_slots * (-(-max_context // block_size)) + 1
@@ -191,9 +222,21 @@ class GenerationEngine:
             raise ValueError(
                 f"largest prefill bucket {max(prefill_buckets)} < "
                 f"max_context {max_context}")
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        self.prefix_cache = (PrefixCache(self.cache, registry=reg)
+                             if self.prefix_caching else None)
         self.scheduler = SlotScheduler(
             self.cache, max_slots, max_context, prefill_buckets,
-            prefill_token_budget)
+            prefill_token_budget, prefix_cache=self.prefix_cache,
+            chunk_mode=self._use_chunks)
+        #: chunked-prefill chunk size cap: the LARGEST prefill bucket
+        #: that fits the per-round token budget (at least the smallest
+        #: bucket), so every chunk maps onto one warmed bucket program
+        fitting = [b for b in self.scheduler.prefill_buckets
+                   if b <= prefill_token_budget]
+        self._chunk_cap = (max(fitting) if fitting
+                           else self.scheduler.prefill_buckets[0])
         #: admission control: submit() raises QueueFull beyond this
         #: many waiting requests (None = unbounded, the library
         #: default; servers should bound it)
@@ -210,8 +253,6 @@ class GenerationEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-        reg = registry if registry is not None else get_registry()
-        self.registry = reg
         self._c_tokens = reg.counter(
             "generation_tokens_total",
             help="tokens sampled (prefill first-tokens + decode)")
@@ -238,6 +279,11 @@ class GenerationEngine:
         reg.gauge("generation_preemptions",
                   fn=lambda: self.scheduler.n_preemptions,
                   help="sequences preempted under cache pressure")
+        self._c_cow = (reg.counter(
+            "prefix_cache_cow_copies_total",
+            help="shared blocks copy-on-write un-shared before a "
+                 "decode write (0 in normal operation — see "
+                 "prefix_cache.py)") if self.prefix_caching else None)
         #: KV-pool occupancy rides the memory-telemetry track too, so
         #: the timeline draws cache pressure under the request slices
         memory.register_provider("kv_pool", self._kv_pool_stats)
@@ -268,11 +314,21 @@ class GenerationEngine:
         # number, not a datasheet claim (docs/observability.md).
         logical = self.cache.logical_nbytes
         physical = self.cache.physical_nbytes
+        # shared = blocks with >1 live reference (prefix-cache tree +
+        # sequences); exclusive = singly-owned.  The split is the live
+        # residency win of prompt reuse: shared bytes serve N readers
+        # for one block's worth of HBM (docs/observability.md).
+        n_shared = alloc.n_shared()
         return {
             "blocks_used": used,
             "blocks_capacity": alloc.capacity,
+            "blocks_shared": n_shared,
+            "blocks_cached": (self.prefix_cache.n_blocks
+                              if self.prefix_cache is not None else 0),
             "pool_bytes": physical,
             "used_bytes": physical * used // nb,
+            "shared_bytes": physical * n_shared // nb,
+            "exclusive_bytes": physical * (used - n_shared) // nb,
             "pool_bytes_logical": logical,
             "pool_bytes_physical": physical,
             "used_bytes_logical": logical * used // nb,
@@ -378,7 +434,64 @@ class GenerationEngine:
             nxt = sample_tokens(last, rng, temperature, top_k)
             return kv, kv_scale, nxt, last
 
+        def chunk_prefill(params, kv, kv_scale, tokens, start, length,
+                          block_table, temperature, top_k, rng):
+            # one chunk of a (possibly prefix-matched, possibly
+            # chunked) prefill: tokens [1, B] (bucket-padded), start
+            # scalar = context tokens whose KV is already written
+            # (cached prefix + earlier chunks), length scalar = real
+            # tokens in this chunk.  The chunk attends over the
+            # already-written context (gathered from the pool by block
+            # table — the concat read path, causal semantics implied by
+            # ops.attention's ctx path) plus itself causally, writes
+            # its KV into block slots, and samples from its last real
+            # position — only the FINAL chunk's sample is consumed by
+            # the host.
+            B = tokens.shape[1]
+            rel = jnp.arange(B)
+            pos = jnp.minimum(start + rel, max_pos - 1)
+            tok_idx = (block_table[:, None] * bs
+                       + jnp.arange(bs)[None, :]).reshape(-1)
+            ctx_k = kv[:, 0][:, tok_idx][:, None]  # [L, 1, T, h, d]
+            ctx_v = kv[:, 1][:, tok_idx][:, None]
+            if quantized:
+                ctx_k = dequantize_kv_tokens(
+                    ctx_k, kv_scale[:, 0][:, tok_idx][:, None])
+                ctx_v = dequantize_kv_tokens(
+                    ctx_v, kv_scale[:, 1][:, tok_idx][:, None])
+            logits, new_k, new_v = model.apply(
+                {"params": params}, tokens, pos[None],
+                ctx_k=ctx_k, ctx_v=ctx_v,
+                ctx_len=jnp.reshape(start, (1,)).astype(jnp.int32))
+            dest = block_table[(start + rel) // bs] * bs \
+                + (start + rel) % bs
+            dest = jnp.where(rel < length, dest, 0)
+            kv, kv_scale = write_kv(kv, kv_scale, dest,
+                                    new_k[:, 0], new_v[:, 0])
+            last = logits[0, length - 1]
+            nxt = sample_tokens(last[None], rng, temperature, top_k)[0]
+            return kv, kv_scale, nxt, last
+
+        def copy_block(kv, kv_scale, src, dst):
+            # copy-on-write: duplicate one pool block's token slots
+            # (and their dequant scales) so a shared block becomes
+            # exclusively owned before it is written
+            rows = jax.lax.dynamic_slice_in_dim(kv, src * bs, bs,
+                                                axis=2)
+            kv = jax.lax.dynamic_update_slice_in_dim(kv, rows,
+                                                     dst * bs, axis=2)
+            if quantized:
+                srows = jax.lax.dynamic_slice_in_dim(
+                    kv_scale, src * bs, bs, axis=2)
+                kv_scale = jax.lax.dynamic_update_slice_in_dim(
+                    kv_scale, srows, dst * bs, axis=2)
+            return kv, kv_scale
+
         self._prefill_jit = jax.jit(prefill, donate_argnums=donate)
+        self._chunk_jit = jax.jit(chunk_prefill, donate_argnums=donate)
+        self._copy_block_jit = jax.jit(
+            copy_block,
+            donate_argnums=((0, 1) if donate else ()))
         self._decode_jit = jax.jit(decode, donate_argnums=donate)
 
     def _store_kv_state(self, kv, kv_scale) -> None:
@@ -396,18 +509,39 @@ class GenerationEngine:
         return size() if size is not None else -1
 
     def warmup(self) -> None:
-        """Compile the decode step and every prefill bucket on dummy
+        """Compile the decode step and every prefill bucket — of the
+        chunk-prefill program when prefix caching / chunked prefill is
+        on, of the legacy whole-prompt program otherwise — on dummy
         inputs (all writes land in the null block)."""
         with self._lock:
             MB = self.scheduler.max_blocks_per_seq
             one = jnp.zeros(1, jnp.float32)
             onek = jnp.zeros(1, jnp.int32)
+            chunk_buckets = [
+                b for b in self.scheduler.prefill_buckets
+                if not self.chunked_prefill or b <= self._chunk_cap]
             for b in self.scheduler.prefill_buckets:
-                kv, scl, _, _ = self._prefill_jit(
-                    self.params, self.cache.kv, self._kv_scale,
-                    jnp.zeros((1, b), jnp.int32), jnp.int32(1),
-                    jnp.zeros(MB, jnp.int32), one, onek, self._rng)
+                if self._use_chunks:
+                    if b not in chunk_buckets:
+                        continue
+                    kv, scl, _, _ = self._chunk_jit(
+                        self.params, self.cache.kv, self._kv_scale,
+                        jnp.zeros((1, b), jnp.int32), jnp.int32(0),
+                        jnp.int32(1), jnp.zeros(MB, jnp.int32),
+                        one, onek, self._rng)
+                else:
+                    kv, scl, _, _ = self._prefill_jit(
+                        self.params, self.cache.kv, self._kv_scale,
+                        jnp.zeros((1, b), jnp.int32), jnp.int32(1),
+                        jnp.zeros(MB, jnp.int32), one, onek, self._rng)
                 self._store_kv_state(kv, scl)
+            if self.prefix_cache is not None:
+                # the COW copy program (src=dst=null block: harmless)
+                kv, scl = self._copy_block_jit(
+                    self.cache.kv, self._kv_scale, jnp.int32(0),
+                    jnp.int32(0))
+                self._store_kv_state(kv, scl)
+                self._goodput_warm.add("copy")
             S = self.max_slots
             kv, scl, _, _ = self._decode_jit(
                 self.params, self.cache.kv, self._kv_scale,
@@ -418,8 +552,13 @@ class GenerationEngine:
             self._store_kv_state(kv, scl)
             # everything above compiled here: live traffic is warm
             self._goodput_warm.add("decode")
-            self._goodput_warm.update(
-                ("prefill", b) for b in self.scheduler.prefill_buckets)
+            if self._use_chunks:
+                self._goodput_warm.update(
+                    ("chunk", b) for b in chunk_buckets)
+            else:
+                self._goodput_warm.update(
+                    ("prefill", b)
+                    for b in self.scheduler.prefill_buckets)
 
     # ------------------------------------------------------------------
     # request intake
@@ -573,6 +712,97 @@ class GenerationEngine:
         self._emit(seq, nxt)
         rec.end()
 
+    # ------------------------------------------------------------------
+    # chunked / prefix-cached prefill (the chunk-step path)
+    # ------------------------------------------------------------------
+
+    def _prefill_round(self) -> bool:
+        """Spend this round's prefill token budget on the lanes still
+        prefilling (admit order).  Non-chunked mode covers a lane's
+        whole remaining tail in one chunk; chunked mode caps chunks at
+        `_chunk_cap` tokens so a long prompt yields to the decode step
+        between chunks.  The head chunk always proceeds (no
+        starvation), budget charges at bucket granularity like
+        admission always has."""
+        did = False
+        budget = self.scheduler.prefill_token_budget
+        first = True
+        for seq in self.scheduler.prefilling():
+            while seq.status == "prefilling":
+                remaining = seq.context_len - seq.prefill_pos
+                cap = (min(remaining, self._chunk_cap)
+                       if self.chunked_prefill else remaining)
+                bucket = self.scheduler.bucket_for(cap)
+                if not first and bucket > budget:
+                    return did
+                self._prefill_chunk(seq, bucket)
+                did = True
+                first = False
+                budget -= bucket
+                if budget <= 0 and seq.status == "prefilling":
+                    return did
+        return did
+
+    def _prefill_chunk(self, seq: Sequence, bucket: int) -> None:
+        """Run one chunk-prefill step: write KV for the next
+        `min(bucket, remaining)` context tokens; the final chunk
+        commits the prompt's full blocks to the prefix cache, samples
+        the first new token and flips the lane to running."""
+        rec = self._clock_prefill.begin(force_fence=True)
+        ctx = seq.prompt + seq.generated
+        L = seq.context_len
+        start = seq.prefill_pos
+        real = min(bucket, L - start)
+        MB = self.scheduler.max_blocks_per_seq
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :real] = ctx[start:start + real]
+        table = np.zeros(MB, np.int32)
+        table[:len(seq.block_table)] = seq.block_table
+        rec.lap("host_input")
+        t0 = now()
+        rec.cold = ("chunk", bucket) not in self._goodput_warm
+        kv, scl, nxt, _ = self._chunk_jit(
+            self.params, self.cache.kv, self._kv_scale,
+            jnp.asarray(tokens), jnp.int32(start), jnp.int32(real),
+            jnp.asarray(table),
+            jnp.full(1, seq.temperature, jnp.float32),
+            jnp.full(1, seq.top_k, jnp.int32), self._next_rng())
+        self._store_kv_state(kv, scl)
+        rec.lap(None)
+        nxt = int(nxt)            # token fetch = device fence
+        rec.lap("device_compute")
+        self._goodput_warm.add(("chunk", bucket))
+        self._h_prefill.record(now() - t0, real)
+        self._c_prefill_tokens.inc(real)
+        seq.prefill_pos = start + real
+        request_log.event(seq.request_id, "prefill", bucket=bucket,
+                          tokens=real, start=start,
+                          resumed=seq.n_preempted > 0)
+        if seq.prefill_pos >= L:
+            if self.prefix_cache is not None:
+                # the prompt's KV is now fully written: publish its
+                # full blocks for reuse (deduping against identical
+                # prefixes committed since this lane's lookup)
+                seq.block_table = self.prefix_cache.commit(
+                    seq.prompt, seq.block_table)
+            seq.status = "running"
+            self._emit(seq, nxt)
+        rec.end()
+
+    def _apply_cow(self) -> None:
+        """Materialize the scheduler's copy-on-write decisions: copy
+        each shared source block into the fresh exclusive block the
+        table now points at (the device-side half of
+        `SlotScheduler.resolve_write_conflicts`)."""
+        for _seq, _idx, src, dst in \
+                self.scheduler.resolve_write_conflicts():
+            kv, scl = self._copy_block_jit(
+                self.cache.kv, self._kv_scale, jnp.int32(src),
+                jnp.int32(dst))
+            self._store_kv_state(kv, scl)
+            if self._c_cow is not None:
+                self._c_cow.inc()
+
     def _decode_all(self) -> None:
         rec = self._clock_decode.begin(force_fence=True)
         S = self.max_slots
@@ -644,15 +874,23 @@ class GenerationEngine:
             self._finish(victim, f"error: evicted ({e})")
 
     def step(self) -> bool:
-        """One scheduling round: admit (prefill) → grow/preempt for
-        decode capacity → one decode step.  Returns whether any device
-        work ran."""
+        """One scheduling round: admit → prefill (whole prompts on the
+        legacy path; budget-bounded chunks with prefix reuse on the
+        chunk path) → grow/preempt for decode capacity (+ copy-on-
+        write un-sharing) → one decode step.  Returns whether any
+        device work ran."""
         with self._lock:
             did = False
-            for seq in self.scheduler.admit():
-                self._prefill_seq(seq)
-                did = True
+            admitted = self.scheduler.admit()
+            if self._use_chunks:
+                did = self._prefill_round() or did
+            else:
+                for seq in admitted:
+                    self._prefill_seq(seq)
+                    did = True
             self.scheduler.ensure_decode_capacity()
+            if self.prefix_cache is not None:
+                self._apply_cow()
             if self.scheduler.running():
                 try:
                     self._decode_all()
@@ -743,14 +981,14 @@ class GenerationEngine:
                                 "cannot be scheduled)")
             except Exception as e:   # fail loudly but keep serving
                 affected = [s.request_id
-                            for s in self.scheduler.running()]
+                            for s in self.scheduler.slotted()]
                 log_event("generation_step_error",
                           error=f"{type(e).__name__}: {e}",
                           request_ids=affected)
                 flight_recorder.dump("generation_step_error", exc=e,
                                      extra={"request_ids": affected})
                 with self._lock:
-                    for seq in list(self.scheduler.running()):
+                    for seq in list(self.scheduler.slotted()):
                         self._finish(seq, f"error: {e}")
 
     def stop(self) -> None:
@@ -763,7 +1001,7 @@ class GenerationEngine:
             self._thread = None
         # unblock consumers of requests that will never run
         with self._lock:
-            for seq in list(self.scheduler.running()):
+            for seq in list(self.scheduler.slotted()):
                 self._finish(seq, "error: engine stopped")
             while self.scheduler.waiting:
                 self._finish(self.scheduler.waiting.popleft(),

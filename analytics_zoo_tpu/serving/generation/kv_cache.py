@@ -38,7 +38,7 @@ XLA fallback) — a dequantized pool never exists in HBM.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -68,9 +68,14 @@ def dequantize_kv_tokens(q, scale):
 
 class BlockAllocator:
     """Free-list allocator over `num_blocks` KV blocks (block 0
-    reserved as the null block).  LIFO reuse keeps recently-freed
-    blocks hot.  Not thread-safe — the engine loop is the only
-    caller."""
+    reserved as the null block), with per-block REFERENCE COUNTS so the
+    prefix cache (serving/generation/prefix_cache.py) can share one
+    committed block between many sequences (and the radix tree itself).
+    `alloc` hands out blocks at refcount 1; `share` pins an extra
+    reference; `free` drops one reference per listed id and only
+    returns a block to the free list when its count reaches zero.
+    LIFO reuse keeps recently-freed blocks hot.  Not thread-safe — the
+    engine loop is the only caller."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
@@ -79,7 +84,8 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         # pop() takes from the tail: ascending init → low ids first
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._held = 0
+        #: block id -> live reference count (allocated blocks only)
+        self._refs: Dict[int, int] = {}
 
     @property
     def capacity(self) -> int:
@@ -92,7 +98,16 @@ class BlockAllocator:
     def occupancy(self) -> float:
         """Fraction of allocatable blocks currently held — the
         cache-pressure gauge."""
-        return self._held / self.capacity
+        return len(self._refs) / self.capacity
+
+    def ref_count(self, block: int) -> int:
+        """Live references on `block` (0 = free / never allocated)."""
+        return self._refs.get(block, 0)
+
+    def n_shared(self) -> int:
+        """Blocks held by more than one reference — the shared half of
+        the pool's shared/exclusive residency split."""
+        return sum(1 for c in self._refs.values() if c > 1)
 
     def alloc(self, n: int = 1) -> Optional[List[int]]:
         """n blocks, or None when the pool can't cover the request
@@ -101,19 +116,42 @@ class BlockAllocator:
         if n > len(self._free):
             return None
         blocks = [self._free.pop() for _ in range(n)]
-        self._held += n
+        for blk in blocks:
+            self._refs[blk] = 1
         return blocks
 
+    def share(self, blocks: List[int]) -> None:
+        """Pin one extra reference on each (already-allocated) block —
+        the prefix cache's hit path and the radix tree's own hold."""
+        for blk in blocks:
+            if blk not in self._refs:
+                raise ValueError(
+                    f"cannot share unallocated block {blk}")
+        for blk in blocks:
+            self._refs[blk] += 1
+
     def free(self, blocks: List[int]) -> None:
+        """Drop one reference per listed id.  The guard validates the
+        WHOLE request before mutating anything: freeing an id that is
+        already on the free list, out of range, the null block — or
+        listed more times than it has references (a duplicate id inside
+        one call is a double free too) — raises instead of silently
+        corrupting the pool."""
+        need: Dict[int, int] = {}
         for blk in blocks:
             if blk == NULL_BLOCK:
                 raise ValueError("cannot free the null block")
             if not 0 < blk < self.num_blocks:
                 raise ValueError(f"block id {blk} out of range")
-            if blk in self._free:
+            need[blk] = need.get(blk, 0) + 1
+        for blk, n in need.items():
+            if n > self._refs.get(blk, 0):
                 raise ValueError(f"double free of block {blk}")
-        self._free.extend(blocks)
-        self._held -= len(blocks)
+        for blk in blocks:
+            self._refs[blk] -= 1
+            if self._refs[blk] == 0:
+                del self._refs[blk]
+                self._free.append(blk)
 
 
 class PagedKVCache:

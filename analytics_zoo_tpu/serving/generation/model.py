@@ -42,9 +42,13 @@ class CausalLM(nn.Module):
     `kv_scale` [n_block, 2, num_blocks, block_size] when the pool is
     int8 — each new token attends over [its block table ; itself]
     through `ops.attention.paged_decode_attention`.
-    Concat decode (parity oracle): pass `ctx_k`/`ctx_v` [n_block,
-    batch, ctx, heads, head_dim] (gathered from the pool) and
-    `ctx_len` [batch].
+    Concat decode (parity oracle) AND chunked/prefix-cached prefill:
+    pass `ctx_k`/`ctx_v` [n_block, batch, ctx, heads, head_dim]
+    (gathered from the pool) and `ctx_len` [batch].  The ctx read path
+    is causal over [cached context ; new tokens], so it serves both
+    t == 1 decode and t > 1 prefill chunks whose prefix KV is already
+    in the pool (the engine's chunk step — engine.py)
+    with identical semantics.
 
     `paged_attention_impl` pins the paged dispatch ("pallas"/"xla";
     None = auto: Pallas on TPU) — tests use "pallas" to drive the real

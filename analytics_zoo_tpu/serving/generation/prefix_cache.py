@@ -1,0 +1,232 @@
+"""Prefix cache: radix-tree prompt reuse over the paged KV block pool.
+
+Millions-of-users traffic is dominated by REPEATED prompt prefixes —
+system prompts, few-shot templates, multi-turn histories — and without
+reuse every request recomputes the full prompt and owns its KV blocks
+exclusively.  This module is the SGLang-RadixAttention / vLLM-prefix-
+caching idea on the PR 2 substrate: the `PagedKVCache` pool already
+stores KV in fixed-size, indexed blocks, so a prompt prefix that is a
+whole number of blocks can be SHARED between requests by pointing
+their block tables at the same committed blocks.
+
+Structure: a radix tree whose edges are `block_size`-token chunks of
+prompt token ids.  Each node owns one committed pool block (the KV of
+exactly that chunk, at the absolute positions the path from the root
+spells) and holds its own reference on it via the allocator's refcount
+(`BlockAllocator.share`).  On admission the scheduler walks the tree
+for the longest cached prefix (`lookup`, pinning one reference per
+matched block for the sequence), prefills only the uncovered tail, and
+after a sequence's prompt is fully prefilled `commit` inserts its full
+prompt blocks — deduplicating against concurrently-prefilled identical
+prefixes by adopting the cached block and dropping the duplicate.
+
+Sharing is safe without copies because committed blocks are NEVER
+written again: only blocks fully covered by prompt tokens are
+committed, matches are whole-block (and capped one token short of the
+query, so at least one tail token always prefills), and decode writes
+land strictly past the prompt — the scheduler still runs a
+copy-on-write guard (`SlotScheduler`/engine) that un-shares a block
+before any write that would hit refcount > 1, so a future fork/beam
+path cannot corrupt a shared block either.
+
+Eviction: unreferenced cached blocks (refcount 1 — the tree is the
+only holder) are evicted leaves-first in LRU order when the allocator
+runs dry, BEFORE the scheduler resorts to preempting a running lane —
+cold cache entries are cheaper to lose than live work.  Cached blocks
+count toward the existing `generation_cache_occupancy` gauge; the
+`prefix_cache_*` counters/gauges below and the request-log
+`prefix_hit` event make reuse observable (docs/observability.md
+metric index, docs/generation.md).
+
+`lookup` is also a fault-injection site (`generation.prefix_lookup`,
+resilience/faults.py): a "raise" there must surface as a failed
+admission, never a corrupted tree.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from analytics_zoo_tpu.resilience.faults import fault_point
+from analytics_zoo_tpu.serving.generation.kv_cache import PagedKVCache
+
+
+class _Node:
+    """One cached chunk: `chunk` (the block_size token ids of its
+    edge), the pool block holding their KV, and an LRU stamp."""
+
+    __slots__ = ("chunk", "block", "children", "parent", "last_use")
+
+    def __init__(self, chunk: Tuple[int, ...], block: int,
+                 parent: Optional["_Node"]):
+        self.chunk = chunk
+        self.block = block
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.parent = parent
+        self.last_use = 0
+
+
+class PrefixCache:
+    """Radix tree over token-id block chunks mapping prompt prefixes
+    to committed KV pool blocks.  Host-side only, engine-lock
+    serialized like the scheduler (no locking here)."""
+
+    def __init__(self, cache: PagedKVCache, registry=None):
+        self.cache = cache
+        self.allocator = cache.allocator
+        self.block_size = cache.block_size
+        self._root = _Node((), -1, None)
+        self._n_blocks = 0
+        #: monotonic use counter — LRU recency without wall time
+        self._clock = 0
+        if registry is None:
+            from analytics_zoo_tpu.observability import get_registry
+            registry = get_registry()
+        self._c_hits = registry.counter(
+            "prefix_cache_hits_total",
+            help="admissions that reused >=1 cached prefix block")
+        self._c_misses = registry.counter(
+            "prefix_cache_misses_total",
+            help="admissions that found no cached prefix")
+        self._c_hit_tokens = registry.counter(
+            "prefix_cache_hit_tokens_total",
+            help="prompt tokens whose prefill was skipped via the "
+                 "prefix cache")
+        self._c_evictions = registry.counter(
+            "prefix_cache_evictions_total",
+            help="cached blocks evicted (LRU, unreferenced only)")
+        registry.gauge(
+            "prefix_cache_blocks", fn=lambda: self._n_blocks,
+            help="KV pool blocks held by the prefix-cache radix tree")
+        registry.gauge(
+            "prefix_cache_shared_blocks", fn=self.allocator.n_shared,
+            help="pool blocks with more than one live reference "
+                 "(tree + sequences)")
+        registry.gauge(
+            "prefix_cache_hit_rate", fn=self.hit_rate,
+            help="hits / (hits + misses) over this process's "
+                 "lifetime (nan before the first lookup)")
+
+    # ------------------------------------------------------------------
+
+    @property
+    def n_blocks(self) -> int:
+        """Blocks currently held by the tree."""
+        return self._n_blocks
+
+    def hit_rate(self) -> float:
+        looked = self._c_hits.value + self._c_misses.value
+        return (self._c_hits.value / looked) if looked else float("nan")
+
+    def lookup(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of `tokens` in whole blocks, capped
+        one token short of the query so the caller always has at least
+        one tail token to prefill (the final position's logits must be
+        computed to sample).  Pins one reference per matched block for
+        the caller (released with the rest of its block table via
+        `BlockAllocator.free`).  Returns (matched block ids, matched
+        token count)."""
+        fault_point("generation.prefix_lookup", n_tokens=len(tokens))
+        bs = self.block_size
+        usable = (len(tokens) - 1) // bs
+        self._clock += 1
+        node = self._root
+        blocks: List[int] = []
+        for j in range(usable):
+            child = node.children.get(
+                tuple(int(t) for t in tokens[j * bs:(j + 1) * bs]))
+            if child is None:
+                break
+            child.last_use = self._clock
+            blocks.append(child.block)
+            node = child
+        if blocks:
+            self.allocator.share(blocks)
+            self._c_hits.inc()
+            self._c_hit_tokens.inc(len(blocks) * bs)
+        else:
+            self._c_misses.inc()
+        return blocks, len(blocks) * bs
+
+    def commit(self, tokens: Sequence[int],
+               block_table: Sequence[int]) -> List[int]:
+        """Insert the blocks fully covered by `tokens` (a prompt whose
+        KV is completely written into `block_table`'s blocks) into the
+        tree, taking one tree-owned reference on each newly-inserted
+        block.  When a chunk is already cached under a DIFFERENT block
+        (two identical prompts prefilled concurrently), the cached
+        block is adopted: the caller's duplicate is freed and the
+        returned table points at the shared block.  Idempotent for
+        already-committed prefixes (resume re-commits are no-ops).
+        Returns the (possibly deduplicated) block table."""
+        bs = self.block_size
+        full = len(tokens) // bs
+        table = list(block_table)
+        self._clock += 1
+        node = self._root
+        for j in range(full):
+            chunk = tuple(int(t) for t in tokens[j * bs:(j + 1) * bs])
+            child = node.children.get(chunk)
+            if child is None:
+                child = _Node(chunk, int(table[j]), node)
+                node.children[chunk] = child
+                self.allocator.share([child.block])
+                self._n_blocks += 1
+            elif child.block != table[j]:
+                # duplicate prefill of an already-cached chunk: adopt
+                # the cached block (contents are the KV of the same
+                # token prefix) and drop ours — one reference swap
+                self.allocator.share([child.block])
+                self.allocator.free([int(table[j])])
+                table[j] = child.block
+            child.last_use = self._clock
+            node = child
+        return table
+
+    # ------------------------------------------------------------------
+
+    def _evictable(self) -> List[_Node]:
+        """Leaf nodes whose block the tree is the only holder of
+        (refcount 1) — the only thing eviction may free.  Interior
+        nodes become leaves as their subtrees are peeled."""
+        out: List[_Node] = []
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if (n is not self._root and not n.children
+                    and self.allocator.ref_count(n.block) == 1):
+                out.append(n)
+        return out
+
+    def evict(self, n_blocks: int) -> int:
+        """Free up to `n_blocks` unreferenced cached blocks, least-
+        recently-used leaves first.  Returns how many were freed (0
+        when everything cached is still pinned by running lanes)."""
+        freed = 0
+        while freed < n_blocks:
+            leaves = self._evictable()
+            if not leaves:
+                break
+            victim = min(leaves, key=lambda n: n.last_use)
+            del victim.parent.children[victim.chunk]
+            self.allocator.free([victim.block])
+            self._n_blocks -= 1
+            self._c_evictions.inc()
+            freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Drop every tree reference (blocks still pinned by live
+        sequences stay allocated until those lanes release them).
+        Returns the number of tree references dropped."""
+        dropped = 0
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self.allocator.free([n.block])
+            dropped += 1
+        self._root.children.clear()
+        self._n_blocks = 0
+        return dropped

@@ -6,23 +6,46 @@ the active-slot mask — the device never sees a shape change, admission
 is pure host bookkeeping.  FCFS admission with a prefill token budget
 per scheduling round (one long prompt cannot monopolize a round, and
 at least one admission always proceeds so nothing starves); when the
-block pool runs dry mid-decode the newest-admitted running sequence is
-preempted — its blocks return to the pool and it re-queues at the FRONT
+block pool runs dry mid-decode the scheduler first LRU-evicts
+unreferenced prefix-cache blocks (cold cache entries are cheaper to
+lose than live work), then preempts the newest-admitted slotted
+sequence — its blocks return to the pool and it re-queues at the FRONT
 of the waiting line with its generated tokens intact, to be re-prefilled
 (recompute-on-resume, the vLLM recovery strategy) when pressure clears.
+
+Prefix caching (scheduler side — serving/generation/prefix_cache.py):
+when a `PrefixCache` is attached, admission looks up the longest
+cached whole-block prefix of the sequence's known context, pins those
+blocks (refcounted sharing via `BlockAllocator`), allocates fresh
+blocks only for the tail, and starts the sequence at
+`prefill_pos = matched tokens` in the "prefilling" state — the engine
+prefills the tail (in chunks when chunked prefill is on) and flips the
+sequence to "running" when the first token is sampled.  Releasing or
+preempting a lane frees its whole table through the refcounts, so
+blocks still referenced by other lanes or the radix tree survive.
+
+Copy-on-write guard: `resolve_write_conflicts` un-shares any block the
+next decode write would land in while it has more than one reference —
+a fresh block is allocated and returned to the engine, which copies
+the block's KV device-side before swapping the table entry.  With
+whole-block prompt-only sharing this never fires organically (decode
+writes land strictly past committed prompt blocks); it is the safety
+net that keeps a future fork/beam path from corrupting shared state,
+and it is unit-tested via explicitly shared blocks.
 
 Invariant the engine relies on: a RUNNING sequence has KV written for
 exactly `context_len - 1` tokens — the newest sampled token is pending,
 and the next decode step feeds it, writes its KV, and samples its
 successor.  A resume-prefill re-writes KV for all `context_len` known
-tokens and samples the next, restoring the same invariant.
+tokens (minus any re-matched cached prefix) and samples the next,
+restoring the same invariant.
 """
 
 from __future__ import annotations
 
 import itertools
 from collections import deque
-from typing import Deque, List, Optional
+from typing import Deque, List, Optional, Tuple
 
 from analytics_zoo_tpu.observability import flight_recorder, request_log
 from analytics_zoo_tpu.serving.generation.kv_cache import PagedKVCache
@@ -36,7 +59,8 @@ class Sequence:
     __slots__ = ("uid", "prompt", "generated", "max_new_tokens",
                  "temperature", "top_k", "eos_id", "stream",
                  "block_table", "slot", "status", "finish_reason",
-                 "n_preempted", "_admit_order", "request_id")
+                 "n_preempted", "_admit_order", "request_id",
+                 "prefill_pos", "prefix_tokens")
 
     def __init__(self, prompt, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
@@ -59,6 +83,11 @@ class Sequence:
         self.finish_reason: Optional[str] = None
         self.n_preempted = 0
         self._admit_order = -1
+        #: context tokens whose KV is already written (chunk-prefill
+        #: progress; starts at the prefix-cache match length)
+        self.prefill_pos = 0
+        #: tokens skipped via the prefix cache at the LAST admission
+        self.prefix_tokens = 0
 
     @property
     def context_len(self) -> int:
@@ -77,16 +106,26 @@ class SlotScheduler:
     """Admission, capacity and preemption over `max_slots` decode lanes
     backed by `cache`'s block allocator.  Host-side only; the engine
     loop is the single caller (no locking here — the engine serializes
-    access)."""
+    access).
+
+    `prefix_cache` (optional) enables radix-tree prefix reuse on
+    admission; `chunk_mode` makes admission claim lane + blocks only
+    (status "prefilling") and leaves the prefill work — chunked under
+    the token budget — to the engine's prefill round.  Both off keeps
+    the legacy admit-and-prefill-whole-prompt behavior bitwise
+    intact."""
 
     def __init__(self, cache: PagedKVCache, max_slots: int,
                  max_context: int, prefill_buckets,
-                 prefill_token_budget: int):
+                 prefill_token_budget: int, prefix_cache=None,
+                 chunk_mode: bool = False):
         self.cache = cache
         self.max_slots = max_slots
         self.max_context = max_context
         self.prefill_buckets = sorted(prefill_buckets)
         self.prefill_token_budget = prefill_token_budget
+        self.prefix_cache = prefix_cache
+        self.chunk_mode = chunk_mode
         self.max_blocks_per_seq = cache.blocks_for(max_context)
         self.slots: List[Optional[Sequence]] = [None] * max_slots
         self.waiting: Deque[Sequence] = deque()
@@ -107,8 +146,22 @@ class SlotScheduler:
         return bool(self.waiting) or any(
             s is not None for s in self.slots)
 
-    def running(self) -> List[Sequence]:
+    def slotted(self) -> List[Sequence]:
+        """Every sequence holding a lane (running or prefilling)."""
         return [s for s in self.slots if s is not None]
+
+    def running(self) -> List[Sequence]:
+        """Lanes participating in the decode step (prefill done,
+        pending token waiting to be fed)."""
+        return [s for s in self.slots
+                if s is not None and s.status == "running"]
+
+    def prefilling(self) -> List[Sequence]:
+        """Lanes whose (tail) prefill is still in progress, in admit
+        order — the engine's chunk-prefill work list."""
+        return sorted((s for s in self.slots
+                       if s is not None and s.status == "prefilling"),
+                      key=lambda s: s._admit_order)
 
     def bucket_for(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -119,10 +172,20 @@ class SlotScheduler:
 
     # ------------------------------------------------------------------
 
+    def _alloc_with_evict(self, n: int) -> Optional[List[int]]:
+        """Allocate `n` blocks, LRU-evicting unreferenced prefix-cache
+        blocks first when the free list can't cover the request —
+        cache entries are recomputable, running lanes' work is not."""
+        got = self.cache.allocator.alloc(n)
+        if got is None and self.prefix_cache is not None:
+            self.prefix_cache.evict(n - self.cache.allocator.available())
+            got = self.cache.allocator.alloc(n)
+        return got
+
     def _preempt_newest(self) -> Optional[Sequence]:
-        """Free the newest-admitted running sequence's blocks and
+        """Free the newest-admitted slotted sequence's blocks and
         re-queue it at the front of the waiting line."""
-        victims = self.running()
+        victims = self.slotted()
         if not victims:
             return None
         victim = max(victims, key=lambda s: s._admit_order)
@@ -140,6 +203,8 @@ class SlotScheduler:
         self.slots[victim.slot] = None
         victim.slot = None
         victim.status = "waiting"
+        victim.prefill_pos = 0
+        victim.prefix_tokens = 0
         victim.n_preempted += 1
         self.n_preemptions += 1
         self.waiting.appendleft(victim)
@@ -148,8 +213,8 @@ class SlotScheduler:
     def ensure_decode_capacity(self) -> None:
         """Before a decode step: every running sequence writes one KV
         entry at position context_len - 1; grow its block table (or
-        preempt, newest first, under cache pressure — possibly the
-        needy sequence itself)."""
+        evict cold cache blocks, then preempt, newest first, under
+        cache pressure — possibly the needy sequence itself)."""
         # oldest first: under pressure the newest yield to the oldest
         for seq in sorted(self.running(),
                           key=lambda s: s._admit_order):
@@ -157,7 +222,7 @@ class SlotScheduler:
                 continue
             need = seq.context_len - 1  # position being written
             while len(seq.block_table) <= need // self.cache.block_size:
-                got = self.cache.allocator.alloc(1)
+                got = self._alloc_with_evict(1)
                 if got is not None:
                     seq.block_table.extend(got)
                     continue
@@ -165,12 +230,60 @@ class SlotScheduler:
                 if victim is None or victim is seq:
                     break             # seq itself yielded its lane
 
+    def resolve_write_conflicts(self) \
+            -> List[Tuple[Sequence, int, int, int]]:
+        """Copy-on-write guard, run after `ensure_decode_capacity`:
+        for every running lane, the block its next decode write lands
+        in must be exclusively owned.  A shared target (refcount > 1)
+        gets a fresh block allocated here; the ENGINE copies the KV
+        device-side and this method has already swapped the table
+        entry and dropped the lane's reference on the shared source.
+        Returns [(seq, block_index, src_block, dst_block)] copy work.
+        Empty in normal operation — prompt-prefix sharing is whole-
+        block and decode writes land strictly past it (see
+        prefix_cache.py) — but a fork/beam path sharing suffix blocks
+        would be caught here instead of corrupting a neighbor."""
+        work: List[Tuple[Sequence, int, int, int]] = []
+        for seq in sorted(self.running(),
+                          key=lambda s: s._admit_order):
+            if seq.slot is None:
+                continue
+            idx = (seq.context_len - 1) // self.cache.block_size
+            if idx >= len(seq.block_table):
+                continue              # capacity growth failed; lane
+            src = seq.block_table[idx]  # will yield next round
+            if self.cache.allocator.ref_count(src) <= 1:
+                continue
+            got = self._alloc_with_evict(1)
+            if got is None:
+                victim = self._preempt_newest()
+                if victim is seq or victim is None:
+                    continue
+                got = self._alloc_with_evict(1)
+                if got is None:
+                    continue
+            dst = got[0]
+            seq.block_table[idx] = dst
+            self.cache.allocator.free([src])
+            flight_recorder.record("sched_cow", uid=seq.uid,
+                                   slot=seq.slot, src=src, dst=dst)
+            work.append((seq, idx, src, dst))
+        return work
+
     def admit(self) -> List[Sequence]:
         """FCFS admission into free slots.  Each admitted sequence gets
-        blocks for its full known context; bucketed prefill sizes are
+        blocks for its full known context — minus any cached prefix
+        blocks the prefix cache shares with it.
+
+        Legacy mode (`chunk_mode=False`): bucketed prefill sizes are
         capped by the per-round token budget (the first admission is
         always allowed through, so a long prompt larger than the budget
-        still schedules eventually)."""
+        still schedules eventually) and the sequence comes out
+        "running" — the engine prefills it whole this round.
+
+        Chunk mode: admission only claims the lane + blocks (status
+        "prefilling", `prefill_pos` = cached tokens); the engine's
+        prefill round spends the token budget on chunks."""
         admitted: List[Sequence] = []
         budget = self.prefill_token_budget
         while self.waiting:
@@ -179,35 +292,58 @@ class SlotScheduler:
             if not free_slots:
                 break
             seq = self.waiting[0]
-            bucket = self.bucket_for(seq.context_len)
-            if admitted and bucket > budget:
-                break
-            blocks = self.cache.allocator.alloc(
-                self.cache.blocks_for(seq.context_len))
+            cached_blocks: List[int] = []
+            n_cached = 0
+            if self.prefix_cache is not None:
+                ctx = seq.prompt + seq.generated
+                cached_blocks, n_cached = self.prefix_cache.lookup(ctx)
+            if not self.chunk_mode:
+                bucket = self.bucket_for(seq.context_len - n_cached)
+                if admitted and bucket > budget:
+                    if cached_blocks:
+                        self.cache.allocator.free(cached_blocks)
+                    break
+            blocks = self._alloc_with_evict(
+                self.cache.blocks_for(seq.context_len)
+                - len(cached_blocks))
             if blocks is None:
+                if cached_blocks:
+                    self.cache.allocator.free(cached_blocks)
                 break                 # pressure: wait for releases
             self.waiting.popleft()
-            seq.block_table = blocks
+            seq.block_table = cached_blocks + blocks
+            seq.prefill_pos = n_cached
+            seq.prefix_tokens = n_cached
             seq.slot = free_slots[0]
-            seq.status = "running"
+            seq.status = "prefilling" if self.chunk_mode else "running"
             seq._admit_order = self._admit_counter
             self._admit_counter += 1
             self.slots[seq.slot] = seq
-            budget -= bucket
+            if not self.chunk_mode:
+                budget -= bucket
             admitted.append(seq)
             flight_recorder.record("sched_admit", uid=seq.uid,
-                                   slot=seq.slot, bucket=bucket,
-                                   blocks=len(blocks),
+                                   slot=seq.slot,
+                                   blocks=len(seq.block_table),
+                                   prefix_tokens=n_cached,
                                    resumed=seq.n_preempted > 0)
             request_log.event(
                 seq.request_id,
                 "resume" if seq.n_preempted > 0 else "admit",
-                slot=seq.slot, bucket=bucket)
+                slot=seq.slot)
+            if n_cached:
+                # the reuse event an operator greps a slow request's
+                # timeline for: how much prefill was skipped
+                request_log.event(seq.request_id, "prefix_hit",
+                                  tokens=n_cached,
+                                  blocks=len(cached_blocks))
         return admitted
 
     def release(self, seq: Sequence, reason: str) -> None:
-        """Finish: blocks back to the pool, lane freed for the next
-        admission — the join/leave half of continuous batching."""
+        """Finish: blocks back to the pool (one reference each —
+        blocks shared with the radix tree or other lanes survive),
+        lane freed for the next admission — the join/leave half of
+        continuous batching."""
         if seq.block_table:
             self.cache.allocator.free(seq.block_table)
             seq.block_table = []

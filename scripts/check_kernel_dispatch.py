@@ -19,14 +19,17 @@ or `analytics_zoo_tpu/keras/layers/`:
     or `ops.pallas.flash_attention` (string mentions in docstrings
     count too: the signature IS the reimplementation).
 
-`analytics_zoo_tpu/serving/generation/` (the decode hot path) is held
-to the same einsum rule PLUS a stricter one: no direct Pallas imports
-(`ops.pallas.*`, `jax.experimental.pallas`, `pallas_call`).  Decode
-attention must go through `ops.attention.paged_decode_attention` /
+`analytics_zoo_tpu/serving/generation/` (the decode hot path —
+engine.py, model.py, scheduler.py, kv_cache.py, prefix_cache.py and
+anything that joins them) is held to the same einsum rule PLUS a
+stricter one: no direct Pallas imports (`ops.pallas.*`,
+`jax.experimental.pallas`, `pallas_call`).  Decode attention must go
+through `ops.attention.paged_decode_attention` /
 `dot_product_attention` — a raw concat-attend einsum or a privately
-wired kernel in the engine would silently bitrot the decode path off
-the tuned paged kernel (or pin it to one kernel version), invisible to
-every parity test that pins ops/.
+wired kernel in the engine (or an attention shortcut inside the
+prefix-cache/chunked-prefill machinery) would silently bitrot the
+decode path off the tuned paged kernel (or pin it to one kernel
+version), invisible to every parity test that pins ops/.
 
 Run directly (`python scripts/check_kernel_dispatch.py`) or via the
 tier-1 wrapper `tests/test_kernel_dispatch.py`.  Exit code 0 = clean.

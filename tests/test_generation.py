@@ -80,6 +80,14 @@ def test_block_allocator_invariants():
         a.free([got[0]])
     with pytest.raises(ValueError, match="null block"):
         a.free([0])
+    with pytest.raises(ValueError, match="out of range"):
+        a.free([99])
+    # a duplicate id WITHIN one call is a double free too — and the
+    # guard validates the whole request before mutating, so the pool
+    # is untouched by the rejected call
+    with pytest.raises(ValueError, match="double free"):
+        a.free([rest[0], rest[0]])
+    assert a.ref_count(rest[0]) == 1
     a.free(rest)
     assert a.available() == 7 and a.occupancy() == 0.0
 
