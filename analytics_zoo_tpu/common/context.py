@@ -70,6 +70,8 @@ class OrcaContextMeta(type):
     _prefix_caching = False
     _chunked_prefill = False
     _host_input_prefetch = 2
+    _decode_tensor_parallel = 0
+    _serving_replicas = 0
 
     # --- TPU runtime state ---
     _mesh = None
@@ -426,6 +428,50 @@ class OrcaContextMeta(type):
     @chunked_prefill.setter
     def chunked_prefill(cls, value):
         cls._chunked_prefill = bool(value)
+
+    @property
+    def decode_tensor_parallel(cls):
+        """Tensor-parallel degree for the generation decode path
+        (serving/distributed/tp.py; docs/distributed-serving.md).
+        0 (default) keeps the legacy single-device engine bitwise
+        untouched.  N > 1 shards the `CausalLM` param tree
+        column-wise and the `PagedKVCache` pool on the head dim over
+        the mesh's ``tp`` axis, which `init_orca_context(mesh_shape=
+        {"tp": N})` must provide.  Block tables and every other host
+        input stay replicated, so the one-static-shape jitted decode
+        contract still holds (`decode_compile_count == 1`) and greedy
+        output is token-identical to the single-device engine.  Read
+        at engine construction
+        (`GenerationEngine(tensor_parallel=...)` overrides)."""
+        return cls._decode_tensor_parallel
+
+    @decode_tensor_parallel.setter
+    def decode_tensor_parallel(cls, value):
+        value = int(value)
+        if value < 0:
+            raise ValueError(
+                "decode_tensor_parallel must be >= 0 (0 = off)")
+        cls._decode_tensor_parallel = value
+
+    @property
+    def serving_replicas(cls):
+        """Generation-engine replica count for the `ReplicaRouter`
+        (serving/distributed/router.py; docs/distributed-serving.md).
+        0 (default) = no router: `ServingServer` talks to one engine,
+        bitwise the pre-router behavior.  N >= 1:
+        `ReplicaRouter.build(model, params)` constructs N engines
+        (each with its own `MetricsRegistry`) and admits via
+        least-loaded scoring off their live queue-depth/KV-occupancy
+        gauges.  Independent of `decode_tensor_parallel` — replicas
+        may themselves be tensor-parallel."""
+        return cls._serving_replicas
+
+    @serving_replicas.setter
+    def serving_replicas(cls, value):
+        value = int(value)
+        if value < 0:
+            raise ValueError("serving_replicas must be >= 0 (0 = off)")
+        cls._serving_replicas = value
 
     @property
     def host_input_prefetch(cls):

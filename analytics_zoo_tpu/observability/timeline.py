@@ -30,6 +30,7 @@ Row layout (pids are stable so saved traces diff cleanly):
 | 3 `requests`  | one tid per request: queued/prefill/decode slices, preempt/resume instants |
 | 4 `events`    | flight-ring instants |
 | 5 `memory`    | ``memory_bytes`` + provider counter tracks |
+| 6 `replicas`  | one tid per router replica: dispatch instants (which replica served which request — serving/distributed/router.py) |
 
 Serving: `ServingServer` exposes the export as ``GET /timeline``
 (forcing a fresh memory sample first), and every flight-recorder
@@ -47,6 +48,7 @@ PID_GOODPUT = 2
 PID_REQUESTS = 3
 PID_EVENTS = 4
 PID_MEMORY = 5
+PID_REPLICAS = 6
 
 _PROCESS_NAMES = {
     PID_SPANS: "spans",
@@ -54,6 +56,7 @@ _PROCESS_NAMES = {
     PID_REQUESTS: "requests",
     PID_EVENTS: "events",
     PID_MEMORY: "memory",
+    PID_REPLICAS: "replicas",
 }
 
 #: total event cap per export — /timeline must stay a bounded payload
@@ -180,6 +183,32 @@ def _request_events(requests_n: Optional[int]
     return events, tid_names
 
 
+def _replica_events(requests_n: Optional[int]
+                    ) -> (List[Dict[str, Any]], Dict[int, str]):
+    """Router dispatch instants regrouped BY REPLICA (pid 6): one row
+    per replica shows its admission pattern over time — skewed
+    least-loaded routing is visible at a glance next to the
+    per-request rows."""
+    from analytics_zoo_tpu.observability.request_log import records
+
+    events: List[Dict[str, Any]] = []
+    tids: Dict[str, int] = {}
+    for rec in records(requests_n):
+        for e in rec["events"]:
+            if e.get("kind") != "replica_dispatch":
+                continue
+            replica = str(e.get("replica", "?"))
+            tid = tids.setdefault(replica, len(tids) + 1)
+            events.append({
+                "ph": "i", "name": "dispatch", "cat": "replica",
+                "pid": PID_REPLICAS, "tid": tid,
+                "ts": _us(e["ts"]), "s": "t",
+                "args": {"request_id": rec["request_id"],
+                         "replica": replica},
+            })
+    return events, {tid: name for name, tid in tids.items()}
+
+
 def _ring_events(ring_n: Optional[int]) -> List[Dict[str, Any]]:
     from analytics_zoo_tpu.observability.flight_recorder import (
         ring_contents,
@@ -248,6 +277,7 @@ def export_timeline(spans_n: int = 512,
     span_ev, span_tids = _section(_span_events, spans_n)
     good_ev, good_tids = _section(_goodput_events, steps_n)
     req_ev, req_tids = _section(_request_events, requests_n)
+    repl_ev, repl_tids = _section(_replica_events, requests_n)
     try:
         ring_ev = _ring_events(ring_n)
     except Exception:
@@ -258,7 +288,8 @@ def export_timeline(spans_n: int = 512,
         mem_ev = []
 
     used_pids = set()
-    for ev_list in (span_ev, good_ev, req_ev, ring_ev, mem_ev):
+    for ev_list in (span_ev, good_ev, req_ev, repl_ev, ring_ev,
+                    mem_ev):
         events.extend(ev_list)
         used_pids.update(e["pid"] for e in ev_list)
 
@@ -271,6 +302,8 @@ def export_timeline(spans_n: int = 512,
         metas.append(_meta(PID_GOODPUT, tid, "thread_name", name))
     for tid, name in sorted(req_tids.items()):
         metas.append(_meta(PID_REQUESTS, tid, "thread_name", name))
+    for tid, name in sorted(repl_tids.items()):
+        metas.append(_meta(PID_REPLICAS, tid, "thread_name", name))
     if any(e["pid"] == PID_EVENTS for e in ring_ev):
         metas.append(_meta(PID_EVENTS, 1, "thread_name",
                            "flight_ring"))

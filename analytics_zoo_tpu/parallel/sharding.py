@@ -85,6 +85,17 @@ def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def mesh_axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
+    """Size of a mesh axis, 1 when the axis is absent — the query the
+    serving tp layer (serving/distributed/tp.py) uses to validate that
+    `init_orca_context(mesh_shape={"tp": N})` actually provisioned the
+    requested degree."""
+    mesh = mesh or OrcaContext.mesh
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return int(mesh.shape[axis])
+
+
 def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
     """Turn a pytree of *process-local* numpy arrays into global sharded
     `jax.Array`s, batch dim split over the data axes.

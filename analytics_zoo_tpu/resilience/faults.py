@@ -36,6 +36,7 @@ site                        threaded into
 ``generation.decode``       engine decode round, before dispatch
 ``generation.prefix_lookup`` prefix-cache radix lookup on admission
 ``serving.admission``       GenerationEngine.submit admission check
+``router.dispatch``         ReplicaRouter.submit, before replica choice
 =========================== =============================================
 
 Actions: ``raise`` (SimulatedWorkerFailure), ``crash``

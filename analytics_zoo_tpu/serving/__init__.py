@@ -15,6 +15,8 @@ from analytics_zoo_tpu.serving.config import (
 )
 from analytics_zoo_tpu.serving.errors import (
     ERROR_HTTP_STATUS,
+    ReplicaDiedMidPredict,
+    ReplicaStopped,
     http_status_for,
 )
 from analytics_zoo_tpu.serving.inference_model import InferenceModel
@@ -32,11 +34,19 @@ _GENERATION = ("GenerationEngine", "GenerationStream", "CausalLM",
                "PagedKVCache", "BlockAllocator", "SlotScheduler",
                "sample_tokens", "QueueFull", "RequestTooLarge")
 
+#: distributed-serving symbols (serving/distributed/) — lazy for the
+#: same reason: the tensor-parallel placement imports jax at load
+_DISTRIBUTED = ("ReplicaRouter", "RouterStream",
+                "TensorParallelPlacement", "TP_PARAM_RULES")
+
 
 def __getattr__(name):
     if name in _GENERATION:
         from analytics_zoo_tpu.serving import generation
         return getattr(generation, name)
+    if name in _DISTRIBUTED:
+        from analytics_zoo_tpu.serving import distributed
+        return getattr(distributed, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -44,4 +54,5 @@ __all__ = ["ERROR_HTTP_STATUS", "InferenceModel", "ServingServer",
            "InputQueue", "OutputQueue", "GrpcInputQueue",
            "GrpcServingFrontend", "http_status_for", "quantize_params",
            "dequantize_params", "quantized_size_bytes", "ServingConfig",
-           "start_serving", "stop_serving", *_GENERATION]
+           "start_serving", "stop_serving", "ReplicaStopped",
+           "ReplicaDiedMidPredict", *_GENERATION, *_DISTRIBUTED]

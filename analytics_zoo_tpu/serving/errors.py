@@ -22,6 +22,11 @@ from __future__ import annotations
 ERROR_HTTP_STATUS = {
     # serving/generation admission + geometry
     "RequestTooLarge": 413,
+    # replica health — the shared vocabulary of the image-serving
+    # WorkerPool and the generation ReplicaRouter (both 503: the
+    # replica set is degraded, the request itself is fine to retry)
+    "ReplicaStopped": 503,
+    "ReplicaDiedMidPredict": 503,
     "QueueFull": 503,
     # resilience: injected faults (chaos is a server-side 5xx; a
     # poisoned request's eviction is shed-shaped, hence 503)
@@ -36,6 +41,21 @@ ERROR_HTTP_STATUS = {
 }
 
 
+class ReplicaStopped(RuntimeError):
+    """A predict/submit raced a deliberate shutdown: the pool or
+    router was stopping, so the failure is lifecycle, not fault.  Both
+    replica pools (`serving/worker_pool.py`, the generation
+    `ReplicaRouter`) raise this one name so callers and dashboards see
+    a single taxonomy (HTTP 503 — retry elsewhere or later)."""
+
+
+class ReplicaDiedMidPredict(RuntimeError):
+    """A replica died while holding a request.  The WorkerPool
+    respawns the worker and surfaces this to the caller whose request
+    was lost; the ReplicaRouter records it and re-queues the request
+    once on a healthy replica (HTTP 503 when it does escape)."""
+
+
 def http_status_for(exc: BaseException, default: int = 500) -> int:
     """Resolve an exception (walking its MRO, so subclasses inherit
     their base's mapping) to an HTTP status."""
@@ -46,4 +66,5 @@ def http_status_for(exc: BaseException, default: int = 500) -> int:
     return default
 
 
-__all__ = ["ERROR_HTTP_STATUS", "http_status_for"]
+__all__ = ["ERROR_HTTP_STATUS", "http_status_for", "ReplicaStopped",
+           "ReplicaDiedMidPredict"]

@@ -157,13 +157,30 @@ class GenerationEngine:
                  kv_quantization: str = "auto",
                  decode_attention: str = "paged",
                  slo_shed_min_queue: Optional[int] = None,
-                 prefix_caching="auto", chunked_prefill="auto"):
+                 prefix_caching="auto", chunked_prefill="auto",
+                 tensor_parallel="auto"):
         if model.max_position_len < max_context:
             raise ValueError(
                 f"model.max_position_len {model.max_position_len} < "
                 f"max_context {max_context}")
         self.model = model
-        self.params = jax.device_put(params)
+        #: tensor-parallel decode (serving/distributed/tp.py) — "auto"
+        #: reads OrcaContext.decode_tensor_parallel; 0 (the default)
+        #: keeps the legacy single-device placement bitwise untouched
+        if tensor_parallel == "auto":
+            from analytics_zoo_tpu.common.context import OrcaContext \
+                as _Ctx
+            tensor_parallel = _Ctx.decode_tensor_parallel
+        self.tensor_parallel = int(tensor_parallel or 0)
+        if self.tensor_parallel > 1:
+            from analytics_zoo_tpu.serving.distributed.tp import (
+                TensorParallelPlacement)
+            self._tp = TensorParallelPlacement.build(
+                self.tensor_parallel, model)
+            self.params = self._tp.put_params(params)
+        else:
+            self._tp = None
+            self.params = jax.device_put(params)
         self.max_slots = max_slots
         self.max_context = max_context
         if decode_attention not in ("paged", "concat"):
@@ -209,6 +226,14 @@ class GenerationEngine:
         #: off (the steps return it untouched)
         self._kv_scale = (self.cache.kv_scale if self._quantized
                           else jnp.zeros((1,), jnp.float32))
+        if self._tp is not None:
+            # head-shard the pool, replicate the per-token scales —
+            # every committed step input now lives on the mesh, so the
+            # compiled steps see one stable input layout
+            self.cache.kv = self._tp.put_kv(self.cache.kv)
+            self._kv_scale = self._tp.put_replicated(self._kv_scale)
+            if self._quantized:
+                self.cache.kv_scale = self._kv_scale
         if prefill_buckets is None:
             prefill_buckets = []
             b = min(16, max_context)
@@ -248,6 +273,21 @@ class GenerationEngine:
         self.slo_shed_min_queue = (max_slots if slo_shed_min_queue
                                    is None else int(slo_shed_min_queue))
         self._rng = jax.random.PRNGKey(seed)
+        if self._tp is not None:
+            # commit the key to the mesh once; splits stay on-mesh, so
+            # no step ever mixes single-device and mesh-committed args
+            self._rng = self._tp.put_replicated(self._rng)
+        else:
+            # same invariant off-mesh: when the params are committed
+            # to one chip of a multi-chip host (a pinned replica),
+            # commit the key there too — jax.random.split of an
+            # UNcommitted key executes on the default device, so the
+            # loop thread's key would drift off the replica's chip and
+            # fork a second pjit cache entry, breaking zero-recompile
+            leaf = jax.tree_util.tree_leaves(self.params)[0]
+            if getattr(leaf, "committed", False):
+                self._rng = jax.device_put(
+                    self._rng, next(iter(leaf.devices())))
         self._lock = threading.RLock()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -487,12 +527,25 @@ class GenerationEngine:
                     kv_scale, srows, dst * bs, axis=2)
             return kv, kv_scale
 
-        self._prefill_jit = jax.jit(prefill, donate_argnums=donate)
-        self._chunk_jit = jax.jit(chunk_prefill, donate_argnums=donate)
-        self._copy_block_jit = jax.jit(
-            copy_block,
-            donate_argnums=((0, 1) if donate else ()))
-        self._decode_jit = jax.jit(decode, donate_argnums=donate)
+        if self._tp is not None:
+            # identical step functions; only placement differs — the
+            # wrapper pins out_shardings (pool head-sharded, scales/
+            # tokens/logits replicated) so every step's outputs feed
+            # the next step in the same layout (zero-recompile holds)
+            self._prefill_jit = self._tp.jit_step(prefill, donate, 4)
+            self._chunk_jit = self._tp.jit_step(chunk_prefill,
+                                                donate, 4)
+            self._copy_block_jit = self._tp.jit_step(
+                copy_block, ((0, 1) if donate else ()), 2)
+            self._decode_jit = self._tp.jit_step(decode, donate, 4)
+        else:
+            self._prefill_jit = jax.jit(prefill, donate_argnums=donate)
+            self._chunk_jit = jax.jit(chunk_prefill,
+                                      donate_argnums=donate)
+            self._copy_block_jit = jax.jit(
+                copy_block,
+                donate_argnums=((0, 1) if donate else ()))
+            self._decode_jit = jax.jit(decode, donate_argnums=donate)
 
     def _store_kv_state(self, kv, kv_scale) -> None:
         self.cache.kv = kv
@@ -668,6 +721,18 @@ class GenerationEngine:
         return sub
 
     def _finish(self, seq: Sequence, reason: str) -> None:
+        if (self.prefix_cache is not None and seq.slot is not None
+                and reason in ("length", "eos")):
+            # commit the GENERATED suffix too (ROADMAP item 1
+            # remainder): decode wrote KV for every context token
+            # except the newest sampled one, so the fully-covered
+            # whole blocks of prompt+generated are publishable — a
+            # multi-turn conversation's next request hits on this
+            # turn's output, not just its prompt
+            tokens = (seq.prompt + seq.generated)[:seq.context_len - 1]
+            if len(tokens) >= self.cache.block_size:
+                seq.block_table = self.prefix_cache.commit(
+                    tokens, seq.block_table)
         self.scheduler.release(seq, reason)
         if seq.stream is not None:
             seq.stream._close()

@@ -36,6 +36,11 @@ Endpoints:
                    503 bodies/headers carry Retry-After (the engine's
                    queue-drain estimate) which the client's
                    RetryPolicy honors (docs/fault-tolerance.md).
+                   With `router=` (serving/distributed/) the same
+                   endpoint submits through the ReplicaRouter's
+                   least-loaded admission instead of a single engine;
+                   /stats grows per-replica rows
+                   (docs/distributed-serving.md).
   GET  /healthz  — liveness + records served
   GET  /metrics  — Prometheus text exposition: this server's per-op
                    latency summaries (serving_queue_wait_seconds,
@@ -123,16 +128,26 @@ class ServingServer:
                  port: int = 0, max_batch_size: int = 32,
                  batch_timeout_ms: float = 5.0,
                  result_ttl_s: float = 600.0, max_results: int = 10_000,
-                 worker_pool=None, generation_engine=None):
+                 worker_pool=None, generation_engine=None,
+                 router=None):
         if model is None and worker_pool is None and \
-                generation_engine is None:
-            raise ValueError("need a model, a worker_pool or a "
-                             "generation_engine")
+                generation_engine is None and router is None:
+            raise ValueError("need a model, a worker_pool, a "
+                             "generation_engine or a router")
+        if router is not None and generation_engine is not None:
+            raise ValueError("pass either generation_engine= or "
+                             "router=, not both — the router owns its "
+                             "own engine replicas")
         self.model = model
         #: continuous-batching autoregressive engine behind
         #: POST /generate (serving/generation/); its loop thread is
         #: started/stopped with the server
         self.generation_engine = generation_engine
+        #: multi-replica generation front door
+        #: (serving/distributed/router.py): /generate submits through
+        #: the ReplicaRouter's least-loaded admission instead of a
+        #: single engine; /stats grows per-replica rows
+        self.router = router
         #: multi-replica scale-out (serving/worker_pool.py — the Flink
         #: modelParallelism analog): batches dispatch to N replica
         #: processes concurrently instead of the in-process model
@@ -178,7 +193,9 @@ class ServingServer:
         self.registry.gauge(
             "serving_replicas",
             fn=lambda: (worker_pool.n_workers
-                        if worker_pool is not None else 1),
+                        if worker_pool is not None
+                        else len(router.replicas)
+                        if router is not None else 1),
             help="model replicas behind this server")
         if worker_pool is not None:
             self.registry.gauge(
@@ -237,7 +254,9 @@ class ServingServer:
                         "status": "ok",
                         "records_served": server.records_served,
                         "replicas": (server.worker_pool.n_workers
-                                     if server.worker_pool else 1),
+                                     if server.worker_pool
+                                     else len(server.router.replicas)
+                                     if server.router else 1),
                         "batches_run": server._batches_run})
                     return
                 if self.path.startswith("/metrics"):
@@ -322,7 +341,8 @@ class ServingServer:
                 flight-recorder bundles.  Error mapping: malformed
                 payload → 400, prompt that can never fit → 413,
                 admission queue full → 503."""
-                eng = server.generation_engine
+                eng = (server.router if server.router is not None
+                       else server.generation_engine)
                 if eng is None:
                     self._json(404, {"error": "no generation engine "
                                      "behind this server"})
@@ -353,6 +373,9 @@ class ServingServer:
                 except Exception as e:
                     reject(400, f"bad request: {e}")
                     return
+                from analytics_zoo_tpu.serving.errors import (
+                    ReplicaStopped,
+                )
                 from analytics_zoo_tpu.serving.generation.engine import (
                     QueueFull,
                     RequestTooLarge,
@@ -375,6 +398,11 @@ class ServingServer:
                     reject(503, str(e),
                            retry_after_s=getattr(e, "retry_after_s",
                                                  None))
+                    return
+                except ReplicaStopped as e:
+                    # taxonomy (serving/errors.py): the router/pool is
+                    # stopping — lifecycle, not the request's fault
+                    reject(503, str(e))
                     return
                 except ValueError as e:
                     reject(400, str(e))
@@ -664,7 +692,9 @@ class ServingServer:
             "batches_run": self._batches_run,
             "queue_depth": self._queue.qsize(),
             "replicas": (self.worker_pool.n_workers
-                         if self.worker_pool else 1),
+                         if self.worker_pool
+                         else len(self.router.replicas)
+                         if self.router else 1),
             "timers": self.timer.summary(),
             "goodput_ratio": round(process_goodput_ratio(), 4),
         }
@@ -676,6 +706,10 @@ class ServingServer:
                 "per_worker_served":
                     self.worker_pool.per_worker_served(),
             }
+        if self.router is not None:
+            # per-replica rows + router totals
+            # (serving/distributed/router.py)
+            out["router"] = self.router.stats()
         if self.generation_engine is not None:
             eng = self.generation_engine
             out["generation"] = {
@@ -686,6 +720,7 @@ class ServingServer:
                 "preemptions": eng.scheduler.n_preemptions,
                 "tokens_total": eng._c_tokens.value,
             }
+        if self.generation_engine is not None or self.router is not None:
             rl = request_log.get_request_log()
             slo = get_slo_tracker().snapshot()
             out["requests"] = {
@@ -711,6 +746,8 @@ class ServingServer:
         self._threads = [t1]
         if self.generation_engine is not None:
             self.generation_engine.ensure_started()
+        if self.router is not None:
+            self.router.ensure_started()
         self._http_started = http
         if http:
             if self._httpd is None:
@@ -731,6 +768,8 @@ class ServingServer:
         self._stop.set()
         if self.generation_engine is not None:
             self.generation_engine.stop()
+        if self.router is not None:
+            self.router.stop()
         # shutdown() blocks on the serve_forever loop — only valid when
         # that loop actually ran (http=False never builds the listener)
         if self._httpd is not None:

@@ -32,6 +32,10 @@ from analytics_zoo_tpu.observability import (
     log_event,
     trace,
 )
+from analytics_zoo_tpu.serving.errors import (
+    ReplicaDiedMidPredict,
+    ReplicaStopped,
+)
 
 _FRAME = struct.Struct(">I")
 
@@ -226,7 +230,7 @@ class WorkerPool:
                 # fresh orphan process.
                 w.stop()
                 if self._stopping:
-                    raise RuntimeError(
+                    raise ReplicaStopped(
                         f"serving replica stopped ({e})") from e
                 self._c_respawns.inc()
                 log_event("worker_respawn",
@@ -238,7 +242,7 @@ class WorkerPool:
                     self._free.put(repl)
                 except Exception:
                     self._workers.remove(w)
-                raise RuntimeError(
+                raise ReplicaDiedMidPredict(
                     f"serving replica died mid-predict ({e}); "
                     "replaced") from e
             except Exception:

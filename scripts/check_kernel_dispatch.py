@@ -76,6 +76,8 @@ SCANNED = (
     (os.path.join(PACKAGE, "keras", "layers"), PATTERNS),
     (os.path.join(PACKAGE, "serving", "generation"),
      GENERATION_PATTERNS),
+    (os.path.join(PACKAGE, "serving", "distributed"),
+     GENERATION_PATTERNS),
 )
 
 #: back-compat alias (tests iterate SCANNED_DIRS)

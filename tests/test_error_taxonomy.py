@@ -33,9 +33,11 @@ def test_mapping_matches_live_classes():
     """The name-keyed table resolves the REAL classes (keys are not
     just strings that happen to lint clean), and MRO resolution gives
     subclasses their base's status."""
-    from analytics_zoo_tpu import resilience
+    from analytics_zoo_tpu import resilience, serving
     from analytics_zoo_tpu.serving.errors import (
         ERROR_HTTP_STATUS,
+        ReplicaDiedMidPredict,
+        ReplicaStopped,
         http_status_for,
     )
     from analytics_zoo_tpu.serving.generation import (
@@ -44,6 +46,8 @@ def test_mapping_matches_live_classes():
     )
     assert http_status_for(RequestTooLarge("x")) == 413
     assert http_status_for(QueueFull("x")) == 503
+    assert http_status_for(ReplicaStopped("x")) == 503
+    assert http_status_for(ReplicaDiedMidPredict("x")) == 503
     assert http_status_for(
         resilience.PoisonedRequestError("x", request_id="r")) == 503
     assert http_status_for(resilience.SimulatedCrash("x")) == 500
@@ -53,8 +57,8 @@ def test_mapping_matches_live_classes():
 
     assert http_status_for(Unmapped(), default=500) == 500
     for name in ERROR_HTTP_STATUS:
-        assert hasattr(resilience, name) or name in (
-            "RequestTooLarge", "QueueFull"), name
+        assert (hasattr(resilience, name) or hasattr(serving, name)
+                or name in ("RequestTooLarge", "QueueFull")), name
 
 
 def test_lint_detects_violations():

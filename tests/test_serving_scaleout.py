@@ -65,6 +65,12 @@ def test_worker_pool_fan_out_fan_in(tmp_path):
         pool.stop()
 
 
+@pytest.mark.slow   # ~27s warm (PR 10 budget trim): tier-1 keeps a
+                    # replica e2e server test (test_distributed_serving
+                    # router /generate + /stats), single-replica server
+                    # e2e (test_serving) and the image codec roundtrip
+                    # below; multi-replica worker-pool mechanics ride
+                    # the @slow fan_out_fan_in sibling above
 def test_server_with_replicas_and_image_payload(tmp_path):
     """End-to-end: config replicas=2 -> worker pool behind the batcher,
     client sends a base64-JPEG image payload, prediction comes back."""
