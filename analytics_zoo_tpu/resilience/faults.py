@@ -37,6 +37,10 @@ site                        threaded into
 ``generation.prefix_lookup`` prefix-cache radix lookup on admission
 ``serving.admission``       GenerationEngine.submit admission check
 ``router.dispatch``         ReplicaRouter.submit, before replica choice
+``stream.append``           stream-log frame write (torn-write capable)
+``stream.fsync``            stream-log fsync batch (torn-write capable)
+``stream.lease``            DurableStream.dequeue, before claiming
+``stream.ack``              DurableStream.ack, before any state change
 =========================== =============================================
 
 Actions: ``raise`` (SimulatedWorkerFailure), ``crash``
@@ -65,6 +69,23 @@ from typing import Any, Dict, List, Optional
 #: which knows how to poison a batch or shed a request
 ACTIONS = ("raise", "crash", "torn_write", "stall", "poison_request",
            "nan", "refuse")
+
+#: the site registry — every `fault_point(...)` site string in the
+#: package.  scripts/check_fault_sites.py (tier-1 via
+#: tests/test_fault_sites.py) pins this tuple against BOTH the code
+#: (every literal call-site string must be registered here) and
+#: docs/fault-tolerance.md's site table (every registered site is
+#: documented; every documented site exists) — the same two-direction
+#: contract check_metric_names enforces for metrics.
+KNOWN_SITES = (
+    "train.step", "eval.step", "train.epoch", "eval.epoch",
+    "checkpoint.before_write", "checkpoint.mid_write",
+    "checkpoint.before_rename", "checkpoint.before_commit",
+    "checkpoint.after_commit", "checkpoint.load",
+    "generation.decode", "generation.prefix_lookup",
+    "serving.admission", "router.dispatch",
+    "stream.append", "stream.fsync", "stream.lease", "stream.ack",
+)
 
 
 class FaultInjected(RuntimeError):

@@ -6,12 +6,18 @@ The reference scatters retry loops across the JVM training driver
 client and the launcher scripts; this repo had grown the same ad-hoc
 spread (estimator fit loop, dryrun child respawns, client polling).
 `RetryPolicy` replaces them with a value object: max attempts,
-DETERMINISTIC exponential backoff (no jitter — test runs and replayed
-incidents see identical schedules), and an optional wall-clock
-deadline.  Adopters: `Estimator.fit`'s restore-and-resume loop, the
-checkpoint save/restore I/O (transient OSError), the serving client's
-503/Retry-After handling, and `__graft_entry__`'s multichip dryrun
-children.
+DETERMINISTIC exponential backoff, and an optional wall-clock
+deadline.  Backoff is unjittered by default; ``jitter="full"`` applies
+AWS-style full jitter (uniform over [0, backoff]) drawn from a PRNG
+seeded by ``(seed, attempt)`` — so a fleet of clients shed at the same
+instant (a mass 429/503) de-synchronizes instead of thundering back
+as one herd, while any ONE policy's schedule is still a pure function
+of its fields: test runs and replayed incidents see identical delays
+(pinned by tests/test_resilience.py).  Adopters: `Estimator.fit`'s
+restore-and-resume loop, the checkpoint save/restore I/O (transient
+OSError), the serving client's 429/503/Retry-After handling
+(`spread()` jitters the server's hint), and `__graft_entry__`'s
+multichip dryrun children.
 
 Every retry is counted (`resilience_retries_total`) and logged
 (`log_event("retry", ...)`) so a quietly-flapping dependency shows up
@@ -21,6 +27,7 @@ in /metrics instead of only as latency.
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
 from typing import Callable, Optional, Tuple, Type
 
@@ -31,6 +38,9 @@ class RetryPolicy:
 
     `backoff(attempt)` (attempt is 1-based) returns
     ``backoff_s * multiplier**(attempt-1)`` capped at `max_backoff_s`;
+    with ``jitter="full"`` that value is scaled by a uniform draw from
+    a PRNG seeded by ``(seed, attempt)`` — deterministic per policy,
+    de-correlated across seeds (give each client its own `seed`).
     `run(fn)` applies the policy, re-raising the last retryable error
     once `max_attempts` or `deadline_s` is exhausted.  Non-retryable
     exceptions propagate immediately."""
@@ -41,6 +51,8 @@ class RetryPolicy:
     max_backoff_s: float = 30.0
     deadline_s: Optional[float] = None
     name: str = ""
+    jitter: str = "none"
+    seed: int = 0
 
     def __post_init__(self):
         if self.max_attempts < 1:
@@ -48,11 +60,37 @@ class RetryPolicy:
         if self.backoff_s < 0 or self.multiplier < 1:
             raise ValueError(
                 "backoff_s must be >= 0 and multiplier >= 1")
+        if self.jitter not in ("none", "full"):
+            raise ValueError("jitter must be 'none' or 'full'")
+
+    def _draw(self, attempt: int, salt: int) -> float:
+        # plain integer arithmetic for the seed: stable across
+        # processes and PYTHONHASHSEED values
+        return random.Random(
+            self.seed * 1_000_003 + salt * 8191 + attempt).random()
 
     def backoff(self, attempt: int) -> float:
-        """Delay before retry number `attempt` (1-based)."""
-        return min(self.backoff_s * self.multiplier ** (attempt - 1),
+        """Delay before retry number `attempt` (1-based).  Full
+        jitter: uniform over [0, exponential backoff] — same expected
+        herd-thinning as AWS full jitter, but seeded: the schedule is
+        a pure function of (policy fields, attempt)."""
+        base = min(self.backoff_s * self.multiplier ** (attempt - 1),
                    self.max_backoff_s)
+        if self.jitter == "full":
+            return base * self._draw(attempt, 1)
+        return base
+
+    def spread(self, delay_s: float, attempt: int) -> float:
+        """Jitter a server-supplied hint (Retry-After): with jitter
+        off, the hint bounded by `max_backoff_s`; with full jitter,
+        uniform over [0.5x, 1.5x] of the hint — clients all told
+        "come back in 2s" by a mass shed return spread over a second,
+        not as a synchronized wave."""
+        delay = min(float(delay_s), self.max_backoff_s)
+        if self.jitter == "full":
+            delay = min(delay * (0.5 + self._draw(attempt, 2)),
+                        self.max_backoff_s)
+        return delay
 
     def delays(self) -> Tuple[float, ...]:
         """The full deterministic backoff schedule (one entry per

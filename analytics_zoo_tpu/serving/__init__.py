@@ -39,6 +39,13 @@ _GENERATION = ("GenerationEngine", "GenerationStream", "CausalLM",
 _DISTRIBUTED = ("ReplicaRouter", "RouterStream",
                 "TensorParallelPlacement", "TP_PARAM_RULES")
 
+#: streaming data plane (serving/streaming/) — lazy so client-only
+#: processes don't pay the log/consumer machinery at import
+_STREAMING = ("DurableStream", "StreamHub", "StreamLog",
+              "StreamRecord", "StreamBacklogFull", "StreamConsumer",
+              "predict_consumer", "generation_consumer",
+              "poisson_trace", "bursty_trace", "run_open_loop")
+
 
 def __getattr__(name):
     if name in _GENERATION:
@@ -47,6 +54,9 @@ def __getattr__(name):
     if name in _DISTRIBUTED:
         from analytics_zoo_tpu.serving import distributed
         return getattr(distributed, name)
+    if name in _STREAMING:
+        from analytics_zoo_tpu.serving import streaming
+        return getattr(streaming, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -55,4 +65,5 @@ __all__ = ["ERROR_HTTP_STATUS", "InferenceModel", "ServingServer",
            "GrpcServingFrontend", "http_status_for", "quantize_params",
            "dequantize_params", "quantized_size_bytes", "ServingConfig",
            "start_serving", "stop_serving", "ReplicaStopped",
-           "ReplicaDiedMidPredict", *_GENERATION, *_DISTRIBUTED]
+           "ReplicaDiedMidPredict", *_GENERATION, *_DISTRIBUTED,
+           *_STREAMING]

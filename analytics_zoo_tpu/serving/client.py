@@ -176,9 +176,11 @@ class InputQueue:
                 if retry_after:
                     try:
                         # honor the server's estimate, bounded by the
-                        # policy so a bad hint cannot park the client
-                        delay = min(float(retry_after),
-                                    retry.max_backoff_s)
+                        # policy so a bad hint cannot park the client;
+                        # spread() jitters it (when the policy says so)
+                        # so a mass shed doesn't come back as one wave
+                        delay = retry.spread(float(retry_after),
+                                             attempt)
                     except ValueError:
                         pass
                 retry.record_retry(e)
@@ -209,16 +211,61 @@ class InputQueue:
         """Blocking convenience: drain `generate` into a list."""
         return list(self.generate(tokens, **kw))
 
-    def enqueue(self, uri: str, **inputs) -> str:
+    def enqueue(self, uri: str, stream: Optional[str] = None,
+                retry=None, timeout: float = 60.0, **inputs) -> str:
         """Async enqueue of one record (reference InputQueue.enqueue);
-        fetch via OutputQueue.dequeue(uri)."""
+        fetch via OutputQueue.dequeue(uri).
+
+        Durable mode: ``stream="name"`` appends the record to the
+        server's crash-safe stream log (POST /streams/<name>/enqueue)
+        instead of the in-memory async path — the 200 means the frame
+        is in the log, so a server or consumer crash after that point
+        replays the record instead of losing it (docs/streaming.md).
+        The appended record id lands in `self.last_record_id`.  When
+        the consumer groups can't keep up the server sheds with 429 +
+        Retry-After; pass `retry` (a `resilience.RetryPolicy`) to back
+        off by the server's drain-rate hint (jittered via
+        `retry.spread` when the policy enables it) and re-send."""
         arrays = [np.asarray(a)[None] for a in inputs.values()]
-        resp = _post(f"{self.base}/enqueue",
-                     {"uri": uri,
-                      "inputs": [encode_ndarray(a) for a in arrays]})
-        if resp.get("status") != "queued":
-            raise RuntimeError(f"enqueue failed: {resp}")
-        return resp["uri"]
+        payload = {"uri": uri,
+                   "inputs": [encode_ndarray(a) for a in arrays]}
+        if stream is None:
+            resp = _post(f"{self.base}/enqueue", payload)
+            if resp.get("status") != "queued":
+                raise RuntimeError(f"enqueue failed: {resp}")
+            return resp["uri"]
+        self.last_record_id = None
+        max_attempts = retry.max_attempts if retry is not None else 1
+        for attempt in range(1, max_attempts + 1):
+            req = urllib.request.Request(
+                f"{self.base}/streams/{stream}/enqueue",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    resp = json.loads(r.read())
+                self.last_record_id = resp.get("record_id")
+                return uri
+            except urllib.error.HTTPError as e:
+                retry_after = e.headers.get("Retry-After")
+                try:
+                    err = json.loads(e.read()).get("error", str(e))
+                except Exception:
+                    err = str(e)
+                if retry is None or e.code not in (429, 503) or \
+                        attempt >= max_attempts:
+                    raise RuntimeError(
+                        f"enqueue failed: {err}") from None
+                delay = retry.backoff(attempt)
+                if retry_after:
+                    try:
+                        delay = retry.spread(float(retry_after),
+                                             attempt)
+                    except ValueError:
+                        pass
+                retry.record_retry(e)
+                time.sleep(delay)
+        raise RuntimeError("enqueue failed: retries exhausted")
 
 
 class OutputQueue:
@@ -239,3 +286,53 @@ class OutputQueue:
                 raise RuntimeError(f"serving error: {resp['error']}")
             time.sleep(poll_interval)
         raise TimeoutError(f"no result for {uri} within {timeout}s")
+
+    def ack(self, stream: str, group: str, record_ids) -> int:
+        """Explicitly ack leased records (POST /streams/<s>/ack) —
+        `consume` does this automatically; this is for callers driving
+        the dequeue endpoint directly."""
+        resp = _post(f"{self.base}/streams/{stream}/ack",
+                     {"group": group,
+                      "record_ids": [int(r) for r in record_ids]})
+        if "error" in resp:
+            raise RuntimeError(f"serving error: {resp['error']}")
+        return int(resp.get("acked", 0))
+
+    def consume(self, stream: str, group: str = "default",
+                consumer: str = "consumer-0",
+                n: Optional[int] = None, block_s: float = 1.0,
+                decode: bool = True, timeout: float = 30.0):
+        """Consumer-group generator over a durable stream: long-poll
+        dequeue (POST /streams/<s>/dequeue) as `group`/`consumer`,
+        yielding ``(record_id, doc)`` pairs with
+        **auto-ack-on-iterate**: a record is acked only when the
+        caller comes back for the NEXT one — so a loop body that
+        raises (or a consumer that dies mid-record) leaves its current
+        record unacked, and the stream replays it to a survivor after
+        the lease expires, under the same record id.
+
+        `n` bounds the records consumed (the n-th is acked before the
+        generator finishes); ``n=None`` drains until a `block_s`
+        long-poll comes back empty.  ``decode=True`` runs each doc
+        through `codec.decode_record` (base64 ndarrays → arrays)."""
+        from analytics_zoo_tpu.serving.codec import decode_record
+
+        yielded = 0
+        while n is None or yielded < n:
+            resp = _post(f"{self.base}/streams/{stream}/dequeue",
+                         {"group": group, "consumer": consumer,
+                          "max_records": 1, "block_s": block_s},
+                         timeout=timeout + block_s)
+            if "error" in resp:
+                raise RuntimeError(f"serving error: {resp['error']}")
+            recs = resp.get("records", [])
+            if not recs:
+                if n is None:
+                    return           # drained
+                continue             # bounded consume keeps waiting
+            for r in recs:
+                doc = decode_record(r["doc"]) if decode else r["doc"]
+                yield r["record_id"], doc
+                # the caller advanced past the record — it's processed
+                yielded += 1
+                self.ack(stream, group, [r["record_id"]])

@@ -62,6 +62,47 @@ def decode_image(enc: Dict[str, Any]) -> np.ndarray:
     return np.asarray(img, np.float32)[None]
 
 
+def encode_record(doc: Dict[str, Any]) -> bytes:
+    """One durable-stream record payload: a JSON document whose
+    ndarray values (at any nesting depth) become base64 ndarray
+    encodings — the body format of the stream log's frames
+    (docs/streaming.md "Log format")."""
+    import json
+
+    def enc(v):
+        if isinstance(v, np.ndarray):
+            return encode_ndarray(v)
+        if isinstance(v, dict):
+            return {k: enc(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [enc(x) for x in v]
+        if isinstance(v, (np.integer, np.floating)):
+            return v.item()
+        return v
+
+    return json.dumps(enc(doc), separators=(",", ":")).encode()
+
+
+def decode_record(blob: Any) -> Dict[str, Any]:
+    """Inverse of `encode_record`; also accepts an already-parsed
+    document (the HTTP dequeue path hands the handler parsed JSON)."""
+    import json
+
+    if isinstance(blob, (bytes, bytearray)):
+        blob = json.loads(blob)
+
+    def dec(v):
+        if isinstance(v, dict):
+            if "b64" in v and "dtype" in v or "image_b64" in v:
+                return decode_ndarray(v)
+            return {k: dec(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [dec(x) for x in v]
+        return v
+
+    return dec(blob)
+
+
 def encode_arrow_tensors(arrays: Sequence[np.ndarray]) -> bytes:
     """Tensors -> one Arrow IPC stream: a RecordBatch with (dtype,
     shape, raw-bytes) per tensor.  ~25% smaller on the wire than

@@ -425,6 +425,20 @@ class ReplicaRouter:
         for r in self.replicas:
             r.engine.run_until_idle()
 
+    def consume_stream(self, stream, out_stream=None, **kw):
+        """Attach the ROUTER to a durable stream as a consumer-group
+        member: leased prompts go through `submit`'s least-loaded
+        admission (and its died-mid-decode requeue), so a replica
+        death mid-record composes with the stream's lease replay —
+        the record either finishes on a survivor via the router's own
+        requeue, or the consumer dies with it and the lease expiry
+        replays the same record id (docs/streaming.md)."""
+        from analytics_zoo_tpu.serving.streaming.consumer import (
+            generation_consumer,
+        )
+        return generation_consumer(stream, self,
+                                   out_stream=out_stream, **kw)
+
     def stop(self) -> None:
         self._stopped = True
         for r in self.replicas:

@@ -28,6 +28,11 @@ ERROR_HTTP_STATUS = {
     "ReplicaStopped": 503,
     "ReplicaDiedMidPredict": 503,
     "QueueFull": 503,
+    # streaming data plane: bounded-buffer backpressure at enqueue —
+    # 429 (the stream exists and is healthy, the CALLER is outrunning
+    # the consumer groups' drain rate; responses carry Retry-After
+    # derived from that rate — docs/streaming.md)
+    "StreamBacklogFull": 429,
     # resilience: injected faults (chaos is a server-side 5xx; a
     # poisoned request's eviction is shed-shaped, hence 503)
     "FaultInjected": 500,

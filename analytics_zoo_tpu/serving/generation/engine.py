@@ -1056,6 +1056,20 @@ class GenerationEngine:
                     for seq in list(self.scheduler.slotted()):
                         self._finish(seq, f"error: {e}")
 
+    def consume_stream(self, stream, out_stream=None, **kw):
+        """Attach this engine to a durable stream as a consumer-group
+        member: each leased record's prompt is submitted under the
+        stable id ``strm-<stream>-<record_id>``, the finished tokens
+        land in `out_stream`, and only then is the record acked — a
+        replica dying mid-record leaves the lease to expire and the
+        record replays elsewhere under the same id
+        (docs/streaming.md).  Returns the started `StreamConsumer`."""
+        from analytics_zoo_tpu.serving.streaming.consumer import (
+            generation_consumer,
+        )
+        return generation_consumer(stream, self,
+                                   out_stream=out_stream, **kw)
+
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()

@@ -18,6 +18,23 @@ Endpoints:
                    or a pre-batched array.
   POST /enqueue  — async: {"uri": id, "inputs": [...]}; result fetched via
   GET  /result/<uri> — {"status": "pending"|"ok", "outputs": [...]}
+  POST /streams/<name>/enqueue — durable async ingest (needs a
+                   `stream_hub`): the JSON body is appended verbatim as
+                   one CRC-framed record in the stream's crash-safe log
+                   (serving/streaming/) BEFORE the 200 — a consumer or
+                   server crash after that replays the record instead of
+                   losing it.  Backpressure: when the backlog hits the
+                   stream's bound the enqueue is shed with 429
+                   StreamBacklogFull + Retry-After derived from the
+                   consumer groups' drain rate (docs/streaming.md).
+  POST /streams/<name>/dequeue — consumer-group long-poll lease:
+                   {"group", "consumer", "max_records", "block_s"} ->
+                   {"records": [{"record_id", "attempts", "doc"}]}; a
+                   leased record not acked within the stream's
+                   visibility timeout is replayed to another consumer.
+  POST /streams/<name>/ack — {"group", "record_ids": [...]} advances
+                   the group's durable cursor (idempotent; late acks
+                   after an expiry+replay are absorbed).
   POST /generate — autoregressive generation with STREAMED tokens
                    (needs a `generation_engine`): {"tokens": [ids...],
                    "max_new_tokens", "temperature", "top_k", "eos_id"}
@@ -68,6 +85,7 @@ Endpoints:
 
 from __future__ import annotations
 
+import base64
 import json
 import queue
 import threading
@@ -129,11 +147,15 @@ class ServingServer:
                  batch_timeout_ms: float = 5.0,
                  result_ttl_s: float = 600.0, max_results: int = 10_000,
                  worker_pool=None, generation_engine=None,
-                 router=None):
+                 router=None, stream_hub=None,
+                 adaptive_batching: bool = True,
+                 adaptive_k: float = 2.0):
         if model is None and worker_pool is None and \
-                generation_engine is None and router is None:
+                generation_engine is None and router is None and \
+                stream_hub is None:
             raise ValueError("need a model, a worker_pool, a "
-                             "generation_engine or a router")
+                             "generation_engine, a router or a "
+                             "stream_hub")
         if router is not None and generation_engine is not None:
             raise ValueError("pass either generation_engine= or "
                              "router=, not both — the router owns its "
@@ -152,11 +174,25 @@ class ServingServer:
         #: modelParallelism analog): batches dispatch to N replica
         #: processes concurrently instead of the in-process model
         self.worker_pool = worker_pool
+        #: durable-stream data plane (serving/streaming/StreamHub)
+        #: behind POST /streams/<name>/...; the hub's lifecycle is the
+        #: creator's — stop() does not close it, so consumers and tests
+        #: can keep reading the logs after the HTTP ingress is down
+        self.stream_hub = stream_hub
         self._predict = (worker_pool.predict if worker_pool is not None
                          else model.predict if model is not None
                          else None)   # generation-only server
         self.max_batch_size = max_batch_size
         self.batch_timeout_s = batch_timeout_ms / 1e3
+        #: adaptive batching deadline (docs/serving-guide.md): the
+        #: batcher waits min(batch_timeout, adaptive_k x EMA of
+        #: observed inter-arrival) for stragglers — under sparse
+        #: traffic the full window is mostly dead air added to every
+        #: request's queue wait; under a burst the queue is drained
+        #: regardless (flush-on-full), so coalescing is unaffected
+        self.adaptive_batching = bool(adaptive_batching)
+        self.adaptive_k = float(adaptive_k)
+        self._ema_gap_s = self.batch_timeout_s
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         # async results are evicted after result_ttl_s or when the store
         # exceeds max_results (oldest first) — abandoned uris must not
@@ -202,6 +238,14 @@ class ServingServer:
                 "serving_worker_utilization",
                 fn=worker_pool.utilization,
                 help="fraction of worker-pool replicas busy")
+        if stream_hub is not None:
+            # per-SERVER registry on purpose: a second server with its
+            # own hub must not silently inherit this hub's fn (the
+            # process-global registry keeps the first registration)
+            self.registry.gauge(
+                "stream_backlog_depth", fn=stream_hub.total_backlog,
+                help="unconsumed records across this server's durable "
+                     "streams (slowest consumer group per stream)")
 
         server = self
 
@@ -448,11 +492,114 @@ class ServingServer:
                             return
                     self.wfile.write(b"0\r\n\r\n")
 
+            def _streams(self, body: bytes):
+                """Durable-stream data plane: POST
+                /streams/<name>/{enqueue,dequeue,ack}.  Enqueue stores
+                the raw JSON body as the record payload; dequeue
+                leases under a consumer group; ack advances the
+                group's durable cursor.  Each record's lifecycle is
+                logged under the id ``strm-<stream>-<record_id>`` —
+                the same id the in-process generation consumer uses,
+                so /timeline shows one trail per record across
+                enqueue → lease → ack regardless of which side
+                consumed it."""
+                from analytics_zoo_tpu.serving.errors import (
+                    http_status_for,
+                )
+                from analytics_zoo_tpu.serving.streaming import (
+                    StreamBacklogFull,
+                )
+                if server.stream_hub is None:
+                    self._json(404, {"error": "no stream hub behind "
+                                     "this server"})
+                    return
+                parts = self.path.strip("/").split("/")
+                if len(parts) != 3 or parts[2] not in (
+                        "enqueue", "dequeue", "ack"):
+                    self._json(404, {"error": "use /streams/<name>/"
+                                     "{enqueue,dequeue,ack}"})
+                    return
+                _, name, verb = parts
+                try:
+                    req = json.loads(body) if body else {}
+                except Exception as e:
+                    self._json(400, {"error": f"bad json: {e}"})
+                    return
+                try:
+                    stream = server.stream_hub.get(name)
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                group = str(req.get("group", "default"))
+                try:
+                    if verb == "enqueue":
+                        record_id = stream.enqueue(body)
+                        rid = f"strm-{name}-{record_id}"
+                        request_log.event(rid, "stream_enqueue",
+                                          stream=name,
+                                          record_id=record_id)
+                        self._json(200, {"status": "queued",
+                                         "uri": req.get("uri"),
+                                         "stream": name,
+                                         "record_id": record_id},
+                                   request_id=rid)
+                        return
+                    if verb == "dequeue":
+                        recs = stream.dequeue(
+                            group, str(req.get("consumer",
+                                               "consumer-0")),
+                            max_records=int(req.get("max_records", 1)),
+                            block_s=min(float(req.get("block_s", 0.0)),
+                                        30.0))
+                        out = []
+                        for r in recs:
+                            try:
+                                doc = json.loads(r.payload)
+                            except Exception:
+                                # non-JSON payload (enqueued through
+                                # the in-process API): ship it opaque
+                                doc = {"payload_b64": base64.b64encode(
+                                    r.payload).decode("ascii")}
+                            request_log.event(
+                                f"strm-{name}-{r.record_id}",
+                                "stream_lease", stream=name,
+                                group=group, attempts=r.attempts)
+                            out.append({"record_id": r.record_id,
+                                        "attempts": r.attempts,
+                                        "doc": doc})
+                        self._json(200, {"records": out,
+                                         "group": group})
+                        return
+                    # verb == "ack"
+                    ids = [int(r) for r in req.get("record_ids", [])]
+                    n = stream.ack(group, ids)
+                    for r in ids:
+                        request_log.event(f"strm-{name}-{r}",
+                                          "stream_ack", stream=name,
+                                          group=group)
+                    self._json(200, {"acked": n, "group": group})
+                except StreamBacklogFull as e:
+                    ra = getattr(e, "retry_after_s", 1.0)
+                    self._json(http_status_for(e),
+                               {"error": str(e),
+                                "retry_after_s": round(ra, 3)},
+                               headers={"Retry-After": f"{ra:.3f}"})
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                except Exception as e:
+                    # injected faults (stream.* sites) and I/O errors:
+                    # taxonomy-mapped status, never a torn connection
+                    self._json(http_status_for(e),
+                               {"error": f"{type(e).__name__}: {e}"})
+
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 if self.path == "/generate":
                     self._generate(body)
+                    return
+                if self.path.startswith("/streams/"):
+                    self._streams(body)
                     return
                 if server._predict is None:
                     self._json(400, {"error": "this server has no "
@@ -589,6 +736,19 @@ class ServingServer:
             from concurrent.futures import ThreadPoolExecutor
             executor = ThreadPoolExecutor(max_workers=n_conc)
             gate = threading.Semaphore(2 * n_conc)
+        # adaptive deadline state: EMA of the gaps between request
+        # ENQUEUE times (handler-side timestamps — the batcher's own
+        # pop cadence would just measure itself).  Seeded at the full
+        # window so the first batches behave like the fixed policy.
+        last_enq = None
+
+        def observe(p: _Pending):
+            nonlocal last_enq
+            if last_enq is not None:
+                gap = max(p.t_enqueue - last_enq, 0.0)
+                self._ema_gap_s += 0.2 * (gap - self._ema_gap_s)
+            last_enq = p.t_enqueue
+
         try:
             while not self._stop.is_set():
                 try:
@@ -596,15 +756,35 @@ class ServingServer:
                 except queue.Empty:
                     continue
                 batch = [first]
-                deadline = time.monotonic() + self.batch_timeout_s
+                observe(first)
+                # flush-on-full path first: records ALREADY waiting
+                # never pay any straggler window, adaptive or not
+                while len(batch) < self.max_batch_size:
+                    try:
+                        p = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    batch.append(p)
+                    observe(p)
+                window = self.batch_timeout_s
+                if self.adaptive_batching:
+                    # wait for stragglers only about as long as the
+                    # traffic says the next arrival takes: sparse
+                    # traffic stops paying the full window as pure
+                    # queue-wait, dense traffic fills by count anyway
+                    window = min(window,
+                                 self.adaptive_k * self._ema_gap_s)
+                deadline = time.monotonic() + window
                 while len(batch) < self.max_batch_size:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
                     try:
-                        batch.append(self._queue.get(timeout=remaining))
+                        p = self._queue.get(timeout=remaining)
                     except queue.Empty:
                         break
+                    batch.append(p)
+                    observe(p)
                 if executor is not None:
                     # blocks the batcher (and, transitively, enqueuers
                     # once self._queue fills) instead of queueing
@@ -697,6 +877,11 @@ class ServingServer:
                          if self.router else 1),
             "timers": self.timer.summary(),
             "goodput_ratio": round(process_goodput_ratio(), 4),
+            "batcher": {
+                "adaptive": self.adaptive_batching,
+                "window_s": self.batch_timeout_s,
+                "ema_interarrival_s": round(self._ema_gap_s, 6),
+            },
         }
         if self.worker_pool is not None:
             out["worker_pool"] = {
@@ -720,6 +905,10 @@ class ServingServer:
                 "preemptions": eng.scheduler.n_preemptions,
                 "tokens_total": eng._c_tokens.value,
             }
+        if self.stream_hub is not None:
+            # per-stream backlog + per-group lag rows
+            # (serving/streaming/stream.py stats)
+            out["streams"] = self.stream_hub.stats()
         if self.generation_engine is not None or self.router is not None:
             rl = request_log.get_request_log()
             slo = get_slo_tracker().snapshot()

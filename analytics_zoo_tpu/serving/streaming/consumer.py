@@ -1,0 +1,199 @@
+"""Stream consumers — both serving backends draining a DurableStream
+as a consumer group (docs/streaming.md).
+
+The Cluster Serving shape: Flink consumers pull from the Redis stream,
+run inference, and write results back (SURVEY §3.5).  Here a consumer
+is a daemon thread in a group: it leases records, runs its backend,
+appends the result to an OUT stream, and only then acks — so a replica
+dying mid-record (crash, SIGKILL, `kill()` in tests) simply lets the
+lease expire and a survivor replays the record UNDER THE SAME RECORD
+ID.  For generation that composes with PR 10's router requeue: the
+request id derived from the record id (``strm-<stream>-<id>``) is
+stable across replays, so the whole journey — enqueue → lease →
+generate (possibly re-queued across replicas) → ack — shares one
+request-lifecycle trail (``stream_lease`` / ``stream_ack`` events in
+the request log, visible on the /timeline lane).
+
+At-least-once is the contract: a consumer killed AFTER its result
+append but BEFORE its ack replays the record, so result consumers
+dedupe by `uri`/record id (the overload harness and the tests do)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from analytics_zoo_tpu.observability import log_event, request_log
+from analytics_zoo_tpu.serving.codec import decode_record, encode_record
+from analytics_zoo_tpu.serving.streaming.stream import DurableStream
+
+
+class StreamConsumer:
+    """One group member: a daemon loop leasing records from `stream`,
+    calling ``handler(record_doc, record)`` and acking on success.
+    A raising handler leaves the record leased (it replays after the
+    visibility deadline); `release_on_error=True` releases it
+    immediately instead.  `kill()` models a replica death: the loop
+    stops WITHOUT acking or releasing in-flight work."""
+
+    def __init__(self, stream: DurableStream, group: str,
+                 consumer: str,
+                 handler: Callable[[Dict[str, Any], Any],
+                                   Optional[Dict[str, Any]]],
+                 out_stream: Optional[DurableStream] = None,
+                 max_records: int = 1,
+                 visibility_s: Optional[float] = None,
+                 poll_s: float = 0.05,
+                 release_on_error: bool = False):
+        self.stream = stream
+        self.group = group
+        self.consumer = consumer
+        self.handler = handler
+        self.out_stream = out_stream
+        self.max_records = max_records
+        self.visibility_s = visibility_s
+        self.poll_s = poll_s
+        self.release_on_error = release_on_error
+        self.records_handled = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._killed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "StreamConsumer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"stream-consumer-{self.group}-{self.consumer}")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                recs = self.stream.dequeue(
+                    self.group, self.consumer,
+                    max_records=self.max_records,
+                    visibility_s=self.visibility_s,
+                    block_s=self.poll_s)
+            except Exception as e:
+                log_event("stream_consumer_error",
+                          group=self.group, consumer=self.consumer,
+                          error=f"{type(e).__name__}: {e}")
+                time.sleep(self.poll_s)
+                continue
+            for rec in recs:
+                if self._stop.is_set():
+                    return            # killed mid-batch: no ack
+                self._handle(rec)
+
+    def _handle(self, rec) -> None:
+        try:
+            doc = decode_record(rec.payload)
+            result = self.handler(doc, rec)
+        except Exception as e:
+            self.errors += 1
+            log_event("stream_handler_error", group=self.group,
+                      consumer=self.consumer, record_id=rec.record_id,
+                      attempts=rec.attempts,
+                      error=f"{type(e).__name__}: {e}")
+            if self.release_on_error:
+                self.stream.release(self.group, rec.record_id)
+            return
+        if self._killed:
+            return                    # death between work and ack
+        if self.out_stream is not None and result is not None:
+            self.out_stream.enqueue(encode_record(result))
+        self.stream.ack(self.group, rec.record_id)
+        self.records_handled += 1
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Graceful stop: finish (and ack) the in-flight record."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def kill(self) -> None:
+        """Abrupt replica death for tests/the overload harness: the
+        in-flight record is NEVER acked — its lease expires and the
+        record replays to a surviving group member."""
+        self._killed = True
+        self._stop.set()
+
+
+def predict_consumer(stream: DurableStream, predict_fn: Callable,
+                     out_stream: Optional[DurableStream] = None,
+                     group: str = "predict",
+                     consumer: str = "predict-0",
+                     batch_size: int = 8,
+                     **kw) -> StreamConsumer:
+    """Batch-prediction group member over `predict_fn` (an
+    `InferenceModel.predict` or `WorkerPool.predict`).  Record docs
+    are the client enqueue payload: ``{"uri": ..., "inputs": [enc,
+    ...]}``; the result doc is ``{"uri", "record_id", "outputs"}``.
+    A replica death mid-predict (ReplicaDiedMidPredict et al) leaves
+    the record unacked — the pool respawns, the lease expires, the
+    record replays."""
+    import numpy as np
+
+    from analytics_zoo_tpu.serving.codec import (
+        decode_ndarray,
+        encode_ndarray,
+    )
+
+    def handle(doc: Dict[str, Any], rec) -> Dict[str, Any]:
+        inputs = tuple(np.asarray(decode_ndarray(x))
+                       for x in doc.get("inputs", []))
+        if not inputs:
+            raise ValueError(f"record {rec.record_id}: no inputs")
+        outs = predict_fn(*inputs)
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        return {"uri": doc.get("uri"), "record_id": rec.record_id,
+                "outputs": [encode_ndarray(np.asarray(o))
+                            for o in outs]}
+
+    return StreamConsumer(stream, group, consumer, handle,
+                          out_stream=out_stream,
+                          max_records=batch_size, **kw).start()
+
+
+def generation_consumer(stream: DurableStream, engine,
+                        out_stream: Optional[DurableStream] = None,
+                        group: str = "generate",
+                        consumer: str = "generate-0",
+                        **kw) -> StreamConsumer:
+    """Token-generation group member over `engine` (a
+    GenerationEngine OR a ReplicaRouter — both expose ``submit``).
+    Record docs: ``{"uri", "tokens", "max_new_tokens", "temperature",
+    "top_k", "eos_id"}``.  The request id is derived from the RECORD
+    id, so a replayed record re-enters the engine under the same
+    lifecycle trail — composing with the router's own mid-stream
+    death requeue (docs/distributed-serving.md)."""
+
+    def handle(doc: Dict[str, Any], rec) -> Dict[str, Any]:
+        rid = f"strm-{stream.name}-{rec.record_id}"
+        gen = engine.submit(
+            [int(t) for t in doc["tokens"]],
+            max_new_tokens=int(doc.get("max_new_tokens", 32)),
+            temperature=float(doc.get("temperature", 0.0)),
+            top_k=int(doc.get("top_k", 0)),
+            eos_id=(int(doc["eos_id"])
+                    if doc.get("eos_id") is not None else None),
+            request_id=rid)
+        rid = getattr(gen, "request_id", None) or rid
+        request_log.event(rid, "stream_lease",
+                          stream=stream.name,
+                          record_id=rec.record_id,
+                          attempts=rec.attempts)
+        toks = gen.tokens() if hasattr(gen, "tokens") else list(gen)
+        request_log.event(rid, "stream_ack", stream=stream.name,
+                          record_id=rec.record_id)
+        return {"uri": doc.get("uri"), "record_id": rec.record_id,
+                "request_id": rid, "tokens": [int(t) for t in toks],
+                "finish_reason": getattr(gen, "finish_reason", None)}
+
+    return StreamConsumer(stream, group, consumer, handle,
+                          out_stream=out_stream, max_records=1,
+                          **kw).start()

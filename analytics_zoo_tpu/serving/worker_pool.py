@@ -260,6 +260,20 @@ class WorkerPool:
         """Records served by each replica (dispatch distribution)."""
         return [w.served for w in self._workers]
 
+    def consume_stream(self, stream, out_stream=None, **kw):
+        """Attach this pool to a durable stream as a consumer-group
+        member: each leased record's inputs run through `predict`, the
+        result is appended to `out_stream`, and only then is the
+        record acked — a pool (or its host) dying mid-record leaves
+        the lease to expire and the record replays to a surviving
+        consumer under the same record id (docs/streaming.md).
+        Returns the started `StreamConsumer` (stop() to detach)."""
+        from analytics_zoo_tpu.serving.streaming.consumer import (
+            predict_consumer,
+        )
+        return predict_consumer(stream, self.predict,
+                                out_stream=out_stream, **kw)
+
     def stop(self):
         self._stopping = True
         for w in list(self._workers):

@@ -874,6 +874,212 @@ def serving_metrics(clients: int = 64, duration_s: float = 6.0,
             out[key] = round(q50 * 1e3, 3)
     if errors[0]:
         out["serving_client_errors"] = errors[0]
+    # adaptive-batcher gate (docs/serving-guide.md): at 64 concurrent
+    # clients the flush-on-full + adaptive-deadline batcher must keep
+    # per-record queue wait p50 under 40 ms — the regression bar for
+    # the batching window, enforced here where it is measured
+    if "serving_queue_wait_p50_ms" in out:
+        out["serving_queue_wait_gate_40ms_pass"] = bool(
+            out["serving_queue_wait_p50_ms"] <= 40.0)
+    return out
+
+
+def overload_metrics(duration_s: float = 2.5, slo_s: float = 0.25,
+                     max_backlog: int = 256):
+    """Open-loop overload window (docs/streaming.md "Overload
+    harness"): seeded Poisson/Gamma-bursty arrival traces replayed at
+    1x/2x/5x of measured capacity against the DURABLE-STREAM ingress
+    (bounded backlog, 429 + Retry-After sheds) and, for contrast, the
+    direct in-memory /predict path (unbounded queue — it degrades by
+    queueing instead of shedding).  A closed-loop bench cannot produce
+    these numbers: offered load self-throttles to capacity.
+
+    Gates (published as overload_gate_*): at 2x capacity the stream
+    ingress keeps SLO attainment of ADMITTED requests >= 0.9 and sheds
+    promptly with a Retry-After hint; a consumer killed mid-overload
+    loses ZERO accepted records (lease replay drains the backlog)."""
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from analytics_zoo_tpu.serving.client import InputQueue
+    from analytics_zoo_tpu.serving.codec import (decode_record,
+                                                 encode_ndarray)
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving.server import ServingServer
+    from analytics_zoo_tpu.serving.streaming import (StreamHub,
+                                                     bursty_trace,
+                                                     poisson_trace,
+                                                     predict_consumer,
+                                                     run_open_loop)
+
+    model = _ncf_model()
+    u, i, _ = _ncf_data(256)
+    params = model.init(jax.random.PRNGKey(0), u[:1], i[:1])["params"]
+    im = InferenceModel(supported_concurrent_num=4, max_batch_size=64)
+    im.load_flax(model, params)
+    for b in (1, 2, 4, 8, 16, 32, 64):     # no compiles inside windows
+        np.asarray(im.predict(u[:b], i[:b]))
+
+    tmp = tempfile.mkdtemp(prefix="bench-overload-")
+    hub = StreamHub(os.path.join(tmp, "hub"), max_backlog=max_backlog,
+                    visibility_timeout_s=2.0)
+    srv = ServingServer(im, max_batch_size=64, batch_timeout_ms=2.0,
+                        stream_hub=hub).start()
+    base = f"http://{srv.host}:{srv.port}"
+    out = {}
+    try:
+        # -- capacity: short closed-loop burst on the direct path ----
+        iq = InputQueue(host=srv.host, port=srv.port)
+        done = [0]
+        t_end = time.monotonic() + 1.5
+
+        def cap_client(seed):
+            rng = np.random.default_rng(seed)
+            while time.monotonic() < t_end:
+                j = int(rng.integers(0, len(u)))
+                iq.predict(u[j], i[j])
+                done[0] += 1
+
+        cthreads = [threading.Thread(target=cap_client, args=(s,),
+                                     daemon=True) for s in range(8)]
+        for t in cthreads:
+            t.start()
+        for t in cthreads:
+            t.join()
+        capacity = max(done[0] / 1.5, 20.0)
+        out["overload_capacity_rps"] = round(capacity, 1)
+        # trace base rate: capacity, clamped so the harness itself
+        # stays well-scheduled — past ~400 arrivals/s the open-loop
+        # worker threads and the handler threads fight for the GIL in
+        # THIS process and the measured tail is the harness's, not the
+        # server's (start_lag_p99_s guards the same failure mode); the
+        # multipliers below still put the ingress 2x/5x past its
+        # bounded backlog's drain rate
+        rate0 = min(capacity, 400.0)
+        out["overload_base_rate_rps"] = round(rate0, 1)
+        # bound the heaviest (5x) window to ~3000 arrivals so a fast
+        # host pays wall-clock proportional to the backlog, not to its
+        # own speed
+        duration = min(duration_s, 3000.0 / (5 * rate0))
+
+        # -- submit closures -----------------------------------------
+        body = json.dumps({
+            "uri": "bench", "inputs": [
+                encode_ndarray(u[:1]), encode_ndarray(i[:1])],
+        }).encode()
+
+        def classify(fn):
+            try:
+                fn()
+                return {"status": "ok"}
+            except urllib.error.HTTPError as e:
+                if e.code in (429, 503):
+                    return {"status": "shed", "retry_after":
+                            e.headers.get("Retry-After") is not None}
+                return {"status": "error", "error": f"http {e.code}"}
+
+        def submit_stream(_i, stream="jobs", _ids=None):
+            def post():
+                req = urllib.request.Request(
+                    f"{base}/streams/{stream}/enqueue", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    rid = json.loads(r.read())["record_id"]
+                if _ids is not None:
+                    _ids.append(rid)
+            return classify(post)
+
+        def submit_direct(_i):
+            return classify(lambda: iq.predict(u[0], i[0]))
+
+        def consumers(n, stream="jobs", group="bench"):
+            return [predict_consumer(
+                hub.get(stream), im.predict, group=group,
+                consumer=f"c{k}", batch_size=8, poll_s=0.01)
+                for k in range(n)]
+
+        def drain(stream="jobs", group="bench", deadline_s=30.0):
+            s = hub.get(stream)
+            t0 = time.monotonic()
+            while s.lag(group) > 0 and \
+                    time.monotonic() - t0 < deadline_s:
+                time.sleep(0.05)
+            return s.lag(group)
+
+        # -- sweep: poisson 1x/2x/5x + bursty 2x on the stream path --
+        report_keys = ("admitted", "shed", "shed_rate",
+                       "shed_with_retry_after", "attainment_admitted",
+                       "goodput_rps", "p99_s", "time_to_shed_p50_s")
+        for label, trace in (
+                ("poisson_1x", poisson_trace(rate0, duration,
+                                             seed=0)),
+                ("poisson_2x", poisson_trace(2 * rate0, duration,
+                                             seed=1)),
+                ("poisson_5x", poisson_trace(5 * rate0, duration,
+                                             seed=2)),
+                ("bursty_2x", bursty_trace(2 * rate0, duration,
+                                           seed=3))):
+            cons = consumers(2)
+            rep = run_open_loop(lambda k: submit_stream(k), trace,
+                                slo_s=slo_s, max_workers=96)
+            for c in cons:
+                c.stop()
+            drain()
+            out[f"overload_stream_{label}"] = {
+                k: (round(rep[k], 4) if isinstance(rep[k], float)
+                    else rep[k]) for k in report_keys}
+            if label == "poisson_2x":
+                two_x = rep
+
+        # direct-path contrast at 2x: no admission control on /predict
+        # — nothing sheds, latency queues out instead
+        rep_d = run_open_loop(submit_direct,
+                              poisson_trace(2 * rate0, duration,
+                                            seed=1), slo_s=slo_s,
+                              max_workers=96)
+        out["overload_direct_poisson_2x"] = {
+            k: (round(rep_d[k], 4) if isinstance(rep_d[k], float)
+                else rep_d[k]) for k in report_keys}
+
+        # -- gates ---------------------------------------------------
+        out["overload_gate_2x_attainment_pass"] = bool(
+            two_x["attainment_admitted"] >= 0.9)
+        out["overload_gate_sheds_carry_retry_after_pass"] = bool(
+            two_x["shed"] == 0 or
+            two_x["shed_with_retry_after"] == two_x["shed"])
+
+        # -- consumer kill mid-overload: zero accepted-record loss ---
+        # fresh stream so the audit is exact: every 200-acknowledged
+        # enqueue of THIS window must end up acked by the group even
+        # though one of its two consumers dies a third of the way in
+        # (lease expiry replays the victim's in-flight leases)
+        accepted = []
+        cons = consumers(2, stream="killjobs", group="kill")
+        victim = cons[0]
+        killer = threading.Timer(duration / 3, victim.kill)
+        killer.start()
+        run_open_loop(
+            lambda k: submit_stream(k, stream="killjobs",
+                                    _ids=accepted),
+            poisson_trace(2 * rate0, duration, seed=4),
+            slo_s=slo_s, max_workers=96)
+        killer.join()
+        lag_left = drain(stream="killjobs", group="kill")
+        for c in cons:
+            c.stop()
+        cur = hub.get("killjobs").stats()["groups"]["kill"]["cursor"]
+        lost = [r for r in accepted if r > cur]
+        out["overload_kill_accepted"] = len(accepted)
+        out["overload_kill_lost"] = len(lost)
+        out["overload_gate_zero_acked_loss_pass"] = bool(
+            lag_left == 0 and not lost)
+    finally:
+        srv.stop()
+        hub.close()
     return out
 
 
@@ -1427,6 +1633,21 @@ def main():
     except Exception as e:
         serving = {"serving_error": f"{type(e).__name__}: {e}"[:120]}
 
+    overload = {}
+    try:
+        # open-loop overload window (PR 11): seeded arrival traces at
+        # 1x/2x/5x capacity against the durable-stream ingress + the
+        # consumer-kill durability audit.  ~25s on a host-attached
+        # device; ~150s through the tunnel (per-record consumer
+        # predicts ride the ~110ms RTT), so gate on the measured
+        # worst case rather than the optimistic one
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining < 160:
+            raise TimeoutError(f"only {remaining:.0f}s left")
+        overload = overload_metrics()
+    except Exception as e:
+        overload = {"overload_error": f"{type(e).__name__}: {e}"[:120]}
+
     generation = {}
     try:
         # continuous-vs-static generation plus the PR 6 decode-path
@@ -1484,6 +1705,7 @@ def main():
             **prefetch,
             **longctx,
             **serving,
+            **overload,
             **generation,
             **routerw,
             **bert_extra,

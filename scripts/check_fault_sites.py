@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Lint: the fault-injection site registry, the `fault_point(...)`
+call sites and docs/fault-tolerance.md's site table agree — in BOTH
+directions (the same contract scripts/check_metric_names.py enforces
+for metrics).
+
+A fault site that exists in code but not in `KNOWN_SITES` /
+the docs is chaos nobody can aim at (a typo'd plan site silently never
+fires — `fault_point` has no registry check at runtime, by design: the
+unarmed fast path is one attribute read).  A documented site with no
+counterpart in code is worse: an operator writes a fault plan against
+it and concludes the covered path is resilient when nothing was ever
+injected.  Three checks close the loop statically:
+
+1. every site-shaped string literal passed to ``fault_point(`` in
+   `analytics_zoo_tpu/` appears in `resilience/faults.py::KNOWN_SITES`
+   (f-string call sites — none today — would be caught by their
+   literal branches when written as conditionals);
+2. every `KNOWN_SITES` entry is documented in the site table of
+   docs/fault-tolerance.md;
+3. every site documented there is registered AND appears at some call
+   site (no dead doc rows, no registered-but-never-threaded sites).
+
+Run directly (`python scripts/check_fault_sites.py`) or via the tier-1
+wrapper `tests/test_fault_sites.py`.  Exit code 0 = clean.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "analytics_zoo_tpu")
+FAULTS = os.path.join(PACKAGE, "resilience", "faults.py")
+DOCS = os.path.join(REPO, "docs", "fault-tolerance.md")
+
+#: a fault site: dotted lowercase path like ``checkpoint.mid_write``
+SITE = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z][a-z0-9_]*)+$")
+
+#: a ``fault_point(`` call; every site-shaped literal in the next
+#: `CALL_WINDOW` chars counts as a site of that call — which covers
+#: the conditional idiom ``fault_point("train.step" if train else
+#: "eval.step", ...)`` (both branches are literals)
+CALL = re.compile(r"fault_point\(")
+CALL_WINDOW = 80
+LITERAL = re.compile(r"[\"']([a-z0-9_.]+)[\"']")
+
+#: the KNOWN_SITES tuple body in faults.py
+REGISTRY = re.compile(r"KNOWN_SITES\s*=\s*\((.*?)\)", re.DOTALL)
+
+
+def _source_files():
+    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def registered_sites(faults_text=None):
+    """KNOWN_SITES, parsed from source (not imported: the lint must
+    run without the package's import-time dependencies)."""
+    if faults_text is None:
+        with open(FAULTS, encoding="utf-8") as f:
+            faults_text = f.read()
+    m = REGISTRY.search(faults_text)
+    if not m:
+        raise AssertionError(
+            "KNOWN_SITES tuple not found in resilience/faults.py")
+    return sorted(re.findall(r"[\"']([a-z0-9_.]+)[\"']", m.group(1)))
+
+
+def code_sites():
+    """Every site literal passed to fault_point() in the package,
+    as (site, relpath, lineno)."""
+    out = []
+    for path in _source_files():
+        if os.path.basename(path) == "faults.py":
+            continue                 # the definition, not a call site
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for m in CALL.finditer(text):
+            lineno = text.count("\n", 0, m.start()) + 1
+            window = text[m.end():m.end() + CALL_WINDOW]
+            for lit in LITERAL.findall(window):
+                if SITE.match(lit):
+                    out.append((lit, os.path.relpath(path, REPO),
+                                lineno))
+    return out
+
+
+def documented_sites(docs_text=None):
+    """Backticked site tokens from the first cell of the injection-
+    site table rows (the `| site | threaded into |` table inside the
+    '## Fault injection' section)."""
+    if docs_text is None:
+        with open(DOCS, encoding="utf-8") as f:
+            docs_text = f.read()
+    in_section = False
+    sites = []
+    for line in docs_text.splitlines():
+        if line.startswith("## "):
+            in_section = line.startswith("## Fault injection")
+            continue
+        if not (in_section and line.lstrip().startswith("|")):
+            continue
+        cells = line.split("|")
+        if len(cells) < 2:
+            continue
+        for tok in re.findall(r"`([^`]+)`", cells[1]):
+            if SITE.match(tok):
+                sites.append(tok)
+    return sorted(set(sites))
+
+
+def find_violations():
+    registered = set(registered_sites())
+    in_code = code_sites()
+    documented = set(documented_sites())
+    violations = []
+    for site, rel, lineno in in_code:
+        if site not in registered:
+            violations.append(
+                f"{rel}:{lineno}: fault_point site {site!r} missing "
+                f"from resilience/faults.py KNOWN_SITES")
+    code_set = {s for s, _rel, _ln in in_code}
+    for site in sorted(registered - documented):
+        violations.append(
+            f"KNOWN_SITES entry {site!r} missing from "
+            f"docs/fault-tolerance.md's site table")
+    for site in sorted(registered - code_set):
+        violations.append(
+            f"KNOWN_SITES entry {site!r} has no fault_point() call "
+            f"site in analytics_zoo_tpu/")
+    for site in sorted(documented - registered):
+        violations.append(
+            f"docs/fault-tolerance.md documents site {site!r} that is "
+            f"not in KNOWN_SITES")
+    return violations
+
+
+def main() -> int:
+    violations = find_violations()
+    if not violations:
+        print("check_fault_sites: clean "
+              f"({len(registered_sites())} sites)")
+        return 0
+    print("check_fault_sites: site registry / code / docs disagree:",
+          file=sys.stderr)
+    for v in violations:
+        print(f"  {v}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -311,10 +311,12 @@ def test_replica_death_mid_stream_requeues_once(lm, router, server):
             == retries + 1)
 
 
-def test_router_zero_recompile_fully_armed(lm):
+def test_router_zero_recompile_fully_armed(lm, tmp_path):
     """decode_compiles == 1 PER REPLICA with router + tp=2 + prefix
     cache + chunked prefill + int8 KV + SLO targets + shedder +
-    watchdog all armed (the fully-loaded acceptance gate)."""
+    watchdog all armed — and it STAYS 1 when the durable-stream
+    consumer path feeds the same router (the fully-loaded acceptance
+    gate, streaming included)."""
     model, params = lm
     prev_slo = OrcaContext.slo_targets
     prev_shed = OrcaContext.slo_shed_attainment
@@ -345,6 +347,42 @@ def test_router_zero_recompile_fully_armed(lm):
                 "decode recompiled with the full stack armed"
         assert {s.replica_name for s in streams} == \
             {"replica-0", "replica-1"}
+        # same router, durable-stream ingress: records consumed as a
+        # group must ride the SAME compiled decode step
+        import time
+
+        from analytics_zoo_tpu.serving.codec import (decode_record,
+                                                     encode_record)
+        from analytics_zoo_tpu.serving.streaming import DurableStream
+        jobs = DurableStream(tmp_path / "jobs", max_backlog=16)
+        outs = DurableStream(tmp_path / "outs", max_backlog=16)
+        for j in range(3):
+            jobs.enqueue(encode_record(
+                {"uri": f"s{j}",
+                 "tokens": [int(t)
+                            for t in rng.integers(0, VOCAB, 8 + j)],
+                 "max_new_tokens": 4}))
+        r.ensure_started()
+        cons = r.consume_stream(jobs, out_stream=outs,
+                                group="generate", consumer="g0",
+                                poll_s=0.02)
+        try:
+            deadline = time.monotonic() + 60
+            while len(outs.log) < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            cons.stop()
+        assert cons.records_handled == 3 and cons.errors == 0
+        assert jobs.stats()["groups"]["generate"]["lag"] == 0
+        for rec in outs.dequeue("check", "c0", max_records=3):
+            doc = decode_record(rec.payload)
+            assert len(doc["tokens"]) == 4
+            assert doc["request_id"].startswith("strm-jobs-")
+        for e in engines:
+            assert e.decode_compile_count == 1, \
+                "stream consumption recompiled the decode step"
+        jobs.close()
+        outs.close()
         r.stop()
     finally:
         OrcaContext.slo_targets = prev_slo

@@ -1,0 +1,75 @@
+"""Tier-1 wiring for scripts/check_fault_sites.py: the build goes red
+when a `fault_point(...)` site is missing from the
+`resilience/faults.py::KNOWN_SITES` registry, a registered site is
+undocumented in docs/fault-tolerance.md's site table (or never
+threaded into code), or the docs list a site that no longer exists —
+the two-direction contract check_metric_names enforces for metrics,
+applied to chaos."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_fault_sites.py")
+
+
+def _load():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("azt_fault_lint",
+                                                  SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fault_sites_registered_and_documented():
+    proc = subprocess.run([sys.executable, SCRIPT],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        "fault-site registry / code / docs drifted:\n" + proc.stderr)
+
+
+def test_lint_parses_the_live_tree():
+    """The registry parses from source, matches the runtime tuple,
+    and every direction of the live tree is clean."""
+    mod = _load()
+    assert mod.find_violations() == []
+    from analytics_zoo_tpu.resilience.faults import KNOWN_SITES
+
+    assert mod.registered_sites() == sorted(KNOWN_SITES)
+    # the stream sites of this PR are threaded, registered, documented
+    for site in ("stream.append", "stream.fsync", "stream.lease",
+                 "stream.ack"):
+        assert site in KNOWN_SITES
+        assert site in mod.documented_sites()
+        assert site in {s for s, _r, _l in mod.code_sites()}
+
+
+def test_lint_detects_each_direction():
+    """Synthetic drift in every direction is caught: the call-window
+    scanner sees both branches of the conditional idiom, an
+    unregistered code site / undocumented registry entry / dead doc
+    row each produce a violation."""
+    mod = _load()
+    # the conditional idiom yields both branch literals
+    text = ('fault_point("train.step" if train else "eval.step",\n'
+            '            step=step)\n')
+    found = [lit for m in mod.CALL.finditer(text)
+             for lit in mod.LITERAL.findall(
+                 text[m.end():m.end() + mod.CALL_WINDOW])
+             if mod.SITE.match(lit)]
+    assert found == ["train.step", "eval.step"]
+    # registry parsing is source-level (no import of the package)
+    sites = mod.registered_sites(
+        'KNOWN_SITES = (\n    "a.b", "c.d",\n)\n')
+    assert sites == ["a.b", "c.d"]
+    # doc parsing only reads the Fault injection section's site table
+    docs = ("## Fault injection (`OrcaContext.fault_plan`)\n"
+            "| site | threaded into |\n"
+            "|---|---|\n"
+            "| `a.b` / `c.d` | somewhere (`not.a.site` in cell 2) |\n"
+            "## Next section\n"
+            "| `x.y` | ignored |\n")
+    assert mod.documented_sites(docs) == ["a.b", "c.d"]

@@ -126,6 +126,51 @@ def test_retry_policy_schedule_and_run():
         p.run(always, retryable=(OSError,), sleep=lambda _s: None)
 
 
+def test_retry_policy_full_jitter_pinned_schedule():
+    """The jittered schedule is a pure function of the policy fields:
+    a seeded policy replays the EXACT delays on every instance and
+    every process (the draw is plain-int arithmetic, immune to
+    PYTHONHASHSEED) — a retry storm is re-runnable like a fault plan."""
+    p = RetryPolicy(jitter="full", seed=3, max_attempts=5,
+                    backoff_s=1.0)
+    pinned = (0.0762795603807902, 1.883017927289433,
+              2.0356325913992515, 1.8489500810071036)
+    assert p.delays() == pytest.approx(pinned, abs=0.0)
+    # fresh instance, same fields -> same schedule; new seed -> new one
+    q = RetryPolicy(jitter="full", seed=3, max_attempts=5,
+                    backoff_s=1.0)
+    assert q.delays() == p.delays()
+    assert RetryPolicy(jitter="full", seed=4, max_attempts=5,
+                       backoff_s=1.0).delays() != p.delays()
+    # every delay stays inside the full-jitter envelope [0, base]
+    base = RetryPolicy(max_attempts=5, backoff_s=1.0).delays()
+    assert all(0.0 <= d <= b for d, b in zip(p.delays(), base))
+
+
+def test_retry_policy_spread_bounds_and_determinism():
+    """`spread` jitters a server Retry-After hint over [0.5x, 1.5x]
+    (capped at max_backoff_s); with jitter off it only applies the
+    cap — and both shapes are deterministic."""
+    p = RetryPolicy(jitter="full", seed=11, max_attempts=6,
+                    backoff_s=0.1, max_backoff_s=10.0)
+    for attempt in range(1, 6):
+        d = p.spread(2.0, attempt)
+        assert 1.0 <= d <= 3.0
+        assert d == p.spread(2.0, attempt)   # same attempt, same draw
+    assert len({p.spread(2.0, a) for a in range(1, 6)}) > 1
+    # the cap applies both before and after the jitter draw
+    tight = RetryPolicy(jitter="full", seed=11, max_backoff_s=0.5)
+    assert tight.spread(100.0, 1) <= 0.5
+    plain = RetryPolicy(max_backoff_s=0.5)
+    assert plain.spread(100.0, 1) == 0.5
+    assert plain.spread(0.2, 1) == 0.2       # jitter off: hint as-is
+
+
+def test_retry_policy_jitter_validation():
+    with pytest.raises(ValueError, match="jitter"):
+        RetryPolicy(jitter="equal")
+
+
 def test_retry_policy_deadline_stops_early():
     p = RetryPolicy(max_attempts=10, backoff_s=100.0,
                     deadline_s=0.01)
