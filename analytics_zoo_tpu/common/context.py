@@ -72,6 +72,8 @@ class OrcaContextMeta(type):
     _host_input_prefetch = 2
     _decode_tensor_parallel = 0
     _serving_replicas = 0
+    _telemetry_spool_interval_s = 1.0
+    _telemetry_spool_max_bytes = 1024 * 1024
 
     # --- TPU runtime state ---
     _mesh = None
@@ -213,6 +215,37 @@ class OrcaContextMeta(type):
     @observability_dir.setter
     def observability_dir(cls, value):
         cls._observability_dir = None if value is None else str(value)
+
+    @property
+    def telemetry_spool_interval_s(cls):
+        """Minimum seconds between telemetry spool snapshots
+        (observability/telemetry_spool.py).  Each participating process
+        (replica loops, stream consumers, elastic members) rewrites
+        `<observability_dir>/telemetry/<proc>/snapshot.json` at most this
+        often so its last metrics/spans survive a SIGKILL.  Spooling is
+        armed only when `observability_dir` is set."""
+        return cls._telemetry_spool_interval_s
+
+    @telemetry_spool_interval_s.setter
+    def telemetry_spool_interval_s(cls, value):
+        if float(value) < 0:
+            raise ValueError("telemetry_spool_interval_s must be >= 0")
+        cls._telemetry_spool_interval_s = float(value)
+
+    @property
+    def telemetry_spool_max_bytes(cls):
+        """Byte cap per spooled snapshot file.  The span and request-log
+        tails are halved until the encoded snapshot fits; the metric
+        exposition text is always kept whole.  Retention is one file per
+        process (tmp -> fsync -> rename replaces in place), so this also
+        bounds the per-process on-disk footprint."""
+        return cls._telemetry_spool_max_bytes
+
+    @telemetry_spool_max_bytes.setter
+    def telemetry_spool_max_bytes(cls, value):
+        if int(value) < 4096:
+            raise ValueError("telemetry_spool_max_bytes must be >= 4096")
+        cls._telemetry_spool_max_bytes = int(value)
 
     @property
     def goodput_sample_every(cls):

@@ -61,7 +61,22 @@ from analytics_zoo_tpu.observability import (  # noqa: F401
     flight_recorder,
     memory,
     request_log,
+    telemetry_spool,
     timeline,
+    trace_context,
+)
+from analytics_zoo_tpu.observability.fleet import (  # noqa: F401
+    FleetAggregator,
+    labeled_prometheus_text,
+)
+from analytics_zoo_tpu.observability.telemetry_spool import (  # noqa: F401
+    TelemetrySpool,
+    maybe_spool,
+)
+from analytics_zoo_tpu.observability.trace_context import (  # noqa: F401
+    TraceContext,
+    current_trace_context,
+    parse_traceparent,
 )
 from analytics_zoo_tpu.observability.request_log import (  # noqa: F401
     RequestLog,
@@ -85,15 +100,18 @@ from analytics_zoo_tpu.observability.watchdog import (  # noqa: F401
 )
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry", "RequestLog",
-    "SLOTracker", "Span", "StepClock", "Watchdog", "annotate",
-    "clear_spans", "close_sink", "current_span", "export_timeline",
-    "flight_recorder", "get_registry", "get_request_log",
-    "get_slo_tracker", "goodput_tables", "localize_nonfinite",
-    "log_event", "maybe_watchdog", "memory", "merged_prometheus_text",
-    "nearest_rank", "new_request_id", "nonfinite_leaves", "now",
-    "parse_prometheus_text", "process_goodput_ratio", "recent_spans",
+    "Counter", "FleetAggregator", "Gauge", "Histogram",
+    "MetricsRegistry", "RequestLog", "SLOTracker", "Span", "StepClock",
+    "TelemetrySpool", "TraceContext", "Watchdog", "annotate",
+    "clear_spans", "close_sink", "current_span",
+    "current_trace_context", "export_timeline", "flight_recorder",
+    "get_registry", "get_request_log", "get_slo_tracker",
+    "goodput_tables", "labeled_prometheus_text", "localize_nonfinite",
+    "log_event", "maybe_spool", "maybe_watchdog", "memory",
+    "merged_prometheus_text", "nearest_rank", "new_request_id",
+    "nonfinite_leaves", "now", "parse_prometheus_text",
+    "parse_traceparent", "process_goodput_ratio", "recent_spans",
     "request_log", "reset_registry", "reset_request_log",
     "reset_slo_tracker", "sanitize_metric_name", "step_clock",
-    "timeline", "trace",
+    "telemetry_spool", "timeline", "trace", "trace_context",
 ]

@@ -107,12 +107,22 @@ def trace(name: str, parent: Any = _MISSING, record_metric: bool = True,
 
     parent: defaults to `current_span()` (contextvar propagation);
         pass an explicit Span (or None for a fresh root) when crossing
-        a thread/queue boundary.
+        a thread/queue boundary.  Anything exposing `.span_id` and
+        `.trace_id` works — notably a remote
+        `trace_context.TraceContext` received from another process.
+        With no local span open, the ambient remote parent bound via
+        `trace_context.bind` (or the TRACEPARENT env var) is used, so
+        the first span after a cross-process hop joins the caller's
+        trace automatically.
     record_metric: also record the duration into the global registry
         histogram `span_<name>_seconds` (default on).
     Other kwargs become span attributes.
     """
     p = current_span() if parent is _MISSING else parent
+    if p is None and parent is _MISSING:
+        # call-time import: trace_context imports this module lazily too
+        from analytics_zoo_tpu.observability import trace_context
+        p = trace_context.remote_parent()
     span = Span(name, parent=p, attrs=attrs)
     token = _CURRENT.set(span)
     try:

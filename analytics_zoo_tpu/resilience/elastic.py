@@ -240,14 +240,21 @@ class ElasticTrainingDriver:
             return None
 
     def _launch(self, attempt: int, resume: Optional[str]):
+        from analytics_zoo_tpu.observability import trace_context
         members = []
         if self._spawn is not None:
-            for wid in range(self.n_workers):
-                hb = (os.path.join(self.heartbeat_dir,
-                                   f"heartbeat-{wid}")
-                      if self.heartbeat_dir else None)
-                members.append(_ProcessMember(
-                    self._spawn(wid, resume, attempt), hb))
+            # export the driver's trace context to os.environ for the
+            # duration of the spawns: user spawn factories build child
+            # envs from os.environ, so gang members inherit
+            # TRACEPARENT and their spans join the driver's trace
+            # (observability/trace_context.py install_from_env)
+            with trace_context.env_bound():
+                for wid in range(self.n_workers):
+                    hb = (os.path.join(self.heartbeat_dir,
+                                       f"heartbeat-{wid}")
+                          if self.heartbeat_dir else None)
+                    members.append(_ProcessMember(
+                        self._spawn(wid, resume, attempt), hb))
         else:
             for wid, fn in enumerate(self._worker_fns):
                 ctx = WorkerContext(wid, self.n_workers, attempt,
@@ -258,7 +265,12 @@ class ElasticTrainingDriver:
     def _monitor(self, members) -> Dict[str, Any]:
         """Poll liveness + heartbeat staleness until the gang finishes
         or a member dies/stalls.  Returns the attempt verdict."""
+        from analytics_zoo_tpu.observability import maybe_spool
         while True:
+            # the driver (and its in-process thread members) spool
+            # telemetry each poll tick — a driver SIGKILL leaves its
+            # last restart ledger/metrics behind for the fleet view
+            maybe_spool("elastic-driver")
             dead, stalled, running = [], [], 0
             now = time.monotonic()
             for i, m in enumerate(members):

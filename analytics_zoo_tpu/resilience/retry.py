@@ -105,12 +105,21 @@ class RetryPolicy:
         """Call `fn()` under the policy.  `on_retry(attempt, exc,
         delay)` observes each retry decision; `sleep` is injectable for
         tests.  The deadline covers sleeps AND the next attempt's start
-        (elapsed + pending delay past `deadline_s` stops retrying)."""
+        (elapsed + pending delay past `deadline_s` stops retrying).
+
+        Each attempt runs in a ``retry.attempt`` span tagged with the
+        attempt number and linked (`prev_span_id`) to the attempt it
+        retries — all attempts share one trace, so a flapping
+        dependency reads as one story in the fleet timeline, not N
+        disconnected roots."""
         start = time.monotonic()
+        prev_span_id: Optional[str] = None
         for attempt in range(1, self.max_attempts + 1):
             try:
-                return fn()
+                with self._attempt_span(attempt, prev_span_id) as sp:
+                    return fn()
             except retryable as e:
+                prev_span_id = getattr(sp, "span_id", None)
                 if attempt >= self.max_attempts:
                     raise
                 delay = self.backoff(attempt)
@@ -122,6 +131,22 @@ class RetryPolicy:
                     on_retry(attempt, e, delay)
                 if delay > 0:
                     sleep(delay)
+
+    def _attempt_span(self, attempt: int,
+                      prev_span_id: Optional[str]):
+        """A trace span for one attempt (no-op context manager when the
+        observability stack is unavailable — same best-effort contract
+        as `record_retry`)."""
+        try:
+            from analytics_zoo_tpu.observability import trace
+        except Exception:
+            import contextlib
+            return contextlib.nullcontext()
+        attrs = {"policy": self.name or "anonymous",
+                 "attempt": attempt}
+        if prev_span_id is not None:
+            attrs["prev_span_id"] = prev_span_id
+        return trace("retry.attempt", **attrs)
 
     def record_retry(self, exc: BaseException) -> None:
         """Count + log one retry decision (also used by adopters that

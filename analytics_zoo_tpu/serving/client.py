@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.observability import trace_context
 from analytics_zoo_tpu.serving.codec import decode_ndarray, encode_ndarray
 
 
@@ -150,7 +151,13 @@ class InputQueue:
         headers = {"Content-Type": "application/json"}
         if request_id is not None:
             headers["X-Request-Id"] = str(request_id)
+        # trace propagation: a client calling from inside a span (or
+        # under trace_context.bind) stamps its context on the request;
+        # the server's serving.generate span joins the same trace.
+        # Stable across retry attempts, like X-Request-Id.
+        trace_context.inject_headers(headers)
         self.last_request_id = None
+        self.last_traceparent = None
         self.last_retries = 0
         max_attempts = retry.max_attempts if retry is not None else 1
         resp = None
@@ -194,6 +201,8 @@ class InputQueue:
                 self.last_retries += 1
                 time.sleep(retry.backoff(attempt))
         self.last_request_id = resp.headers.get("X-Request-Id")
+        self.last_traceparent = resp.headers.get(
+            trace_context.TRACEPARENT_HEADER)
         with resp:
             for raw in resp:           # http.client de-chunks for us
                 msg = json.loads(raw)
@@ -235,12 +244,18 @@ class InputQueue:
                 raise RuntimeError(f"enqueue failed: {resp}")
             return resp["uri"]
         self.last_record_id = None
+        # durable-mode propagation: the context rides BOTH the header
+        # and the record document itself — the doc copy is what a
+        # consumer process sees after a lease (or a crash replay)
+        stream_headers = trace_context.inject_headers(
+            {"Content-Type": "application/json"})
+        trace_context.inject_record(payload)
         max_attempts = retry.max_attempts if retry is not None else 1
         for attempt in range(1, max_attempts + 1):
             req = urllib.request.Request(
                 f"{self.base}/streams/{stream}/enqueue",
                 data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"})
+                headers=stream_headers)
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as r:
                     resp = json.loads(r.read())

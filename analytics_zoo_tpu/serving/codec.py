@@ -66,7 +66,14 @@ def encode_record(doc: Dict[str, Any]) -> bytes:
     """One durable-stream record payload: a JSON document whose
     ndarray values (at any nesting depth) become base64 ndarray
     encodings — the body format of the stream log's frames
-    (docs/streaming.md "Log format")."""
+    (docs/streaming.md "Log format").
+
+    Trace propagation: when the encoding side runs inside a trace (an
+    open span, or a context bound via
+    `observability.trace_context.bind`), a `"traceparent"` envelope
+    field is stamped onto the top-level document — the record carries
+    its trace across the process boundary to whoever leases it.  An
+    existing field is never overwritten."""
     import json
 
     def enc(v):
@@ -80,7 +87,11 @@ def encode_record(doc: Dict[str, Any]) -> bytes:
             return v.item()
         return v
 
-    return json.dumps(enc(doc), separators=(",", ":")).encode()
+    out = enc(doc)
+    if isinstance(out, dict):
+        from analytics_zoo_tpu.observability import trace_context
+        trace_context.inject_record(out)
+    return json.dumps(out, separators=(",", ":")).encode()
 
 
 def decode_record(blob: Any) -> Dict[str, Any]:

@@ -38,6 +38,7 @@ from analytics_zoo_tpu.observability import (
     get_registry,
     log_event,
     request_log,
+    trace,
 )
 from analytics_zoo_tpu.observability.registry import MetricsRegistry
 from analytics_zoo_tpu.resilience.faults import fault_point
@@ -64,6 +65,9 @@ class _Replica:
         self.engine = engine
         self.state = "active"
         self.served = 0
+        # each replica loop spools under its own name, so the fleet
+        # aggregator can tell replica-0's last snapshot from replica-1's
+        engine.spool_name = name
 
     def load_score(self, occupancy_weight: float) -> float:
         """Least-loaded admission score off the engine's live gauges:
@@ -100,6 +104,10 @@ class RouterStream:
         self._budget = int(kwargs.get("max_new_tokens", 32))
         self._got: List[int] = []
         self._requeues_left = router.max_requeues
+        #: span ids of every dispatch attempt (submit + requeues) —
+        #: each requeue span links to the dead attempt's span, so the
+        #: retry chain is walkable inside ONE trace
+        self._dispatch_spans: List[str] = []
         self._finish_reason: Optional[str] = None
         #: sticky id — survives the re-queue (the lifecycle log keeps
         #: one trail: the failed leg's record is finished before the
@@ -352,16 +360,25 @@ class ReplicaRouter:
         sheds: List[QueueFull] = []
         for r in candidates:
             try:
-                stream = r.engine.submit(prompt,
-                                         request_id=request_id,
-                                         **kwargs)
+                # the dispatch span nests under whatever is open on
+                # this thread (serving.generate, stream.consume) — or
+                # under an ambient remote trace context — so the
+                # placement decision is part of the request's trace
+                with trace("router.dispatch", replica=r.name,
+                           request_id=request_id, attempt=1) as dsp:
+                    stream = r.engine.submit(prompt,
+                                             request_id=request_id,
+                                             **kwargs)
+                    dsp.attrs["request_id"] = stream.request_id
             except QueueFull as e:
                 sheds.append(e)
                 continue
             with self._lock:
                 self._dispatched(r, stream.request_id)
             self._c_requests.inc()
-            return RouterStream(self, r, stream, prompt, kwargs)
+            rs = RouterStream(self, r, stream, prompt, kwargs)
+            rs._dispatch_spans.append(dsp.span_id)
+            return rs
         self._c_sheds.inc()
         hints = [e.retry_after_s for e in sheds
                  if e.retry_after_s is not None]
@@ -393,12 +410,25 @@ class ReplicaRouter:
             target = self._ordered(candidates)[0]
         kwargs = dict(rs._kwargs)
         kwargs["max_new_tokens"] = rs._budget - len(rs._got)
+        # the requeue is a NEW span in the SAME trace (it runs on the
+        # thread consuming the stream, under the request's open span /
+        # remote context), linked to the dead attempt's dispatch span
+        # and numbered — so "one request, two replicas, one trace" is
+        # literal in the fleet timeline
+        attempt_n = len(rs._dispatch_spans) + 1
         try:
-            stream = target.engine.submit(rs._prompt + rs._got,
-                                          request_id=rs.request_id,
-                                          **kwargs)
+            with trace("router.requeue", replica=target.name,
+                       failed_replica=failed.name,
+                       request_id=rs.request_id, attempt=attempt_n,
+                       link_span_id=(rs._dispatch_spans[-1]
+                                     if rs._dispatch_spans
+                                     else None)) as qsp:
+                stream = target.engine.submit(rs._prompt + rs._got,
+                                              request_id=rs.request_id,
+                                              **kwargs)
         except Exception:
             return None
+        rs._dispatch_spans.append(qsp.span_id)
         self._c_requeues.inc()
         # the shared retry ledger (resilience/retry.py registers it;
         # the router is one more adopter — docs/observability.md)

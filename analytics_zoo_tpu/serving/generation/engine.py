@@ -54,6 +54,7 @@ from analytics_zoo_tpu.observability import (
     flight_recorder,
     get_registry,
     log_event,
+    maybe_spool,
     maybe_watchdog,
     memory,
     now,
@@ -292,6 +293,10 @@ class GenerationEngine:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: telemetry-spool identity for this engine's serving loop;
+        #: the replica router renames it to the replica name so each
+        #: replica's snapshot lands in its own fleet-harvestable slot
+        self.spool_name = "engine"
 
         self._c_tokens = reg.counter(
             "generation_tokens_total",
@@ -1008,6 +1013,11 @@ class GenerationEngine:
     def _loop(self) -> None:
         stuck_rounds = 0
         while not self._stop.is_set():
+            # durable telemetry: snapshot this loop's registry so a
+            # replica SIGKILL'd mid-decode still leaves its counters
+            # for the fleet harvest (no-op while observability_dir is
+            # unset; time-gated otherwise)
+            maybe_spool(self.spool_name, (self.registry,))
             if not self.scheduler.has_work():
                 if self.watchdog is not None:
                     # idle is not a stall: disarm until work arrives

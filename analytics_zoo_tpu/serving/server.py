@@ -96,6 +96,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from analytics_zoo_tpu.observability import (
+    FleetAggregator,
     MetricsRegistry,
     current_span,
     export_timeline,
@@ -103,6 +104,7 @@ from analytics_zoo_tpu.observability import (
     get_registry,
     get_slo_tracker,
     goodput_tables,
+    labeled_prometheus_text,
     log_event,
     memory,
     merged_prometheus_text,
@@ -111,6 +113,7 @@ from analytics_zoo_tpu.observability import (
     recent_spans,
     request_log,
     trace,
+    trace_context,
 )
 from analytics_zoo_tpu.serving.codec import (
     ARROW_CONTENT_TYPE,
@@ -306,9 +309,26 @@ class ServingServer:
                 if self.path.startswith("/metrics"):
                     # Prometheus text exposition (pull model): this
                     # server's op summaries/counters/gauges + the
-                    # process-global registry (training, FL, spans)
-                    text = merged_prometheus_text(server.registry,
-                                                  get_registry())
+                    # process-global registry (training, FL, spans).
+                    # Routed servers fold each replica's private
+                    # registry in under a replica="<name>" label by
+                    # default (?fleet=0 opts out); ?fleet=1 serves the
+                    # full FleetAggregator view — counters summed
+                    # across every live source AND every spooled
+                    # snapshot of a dead worker, gauges/summaries
+                    # labeled per source (observability/fleet.py).
+                    query = self.path.partition("?")[2]
+                    if "fleet=1" in query:
+                        text = server.fleet().fleet_prometheus_text()
+                    else:
+                        text = merged_prometheus_text(server.registry,
+                                                      get_registry())
+                        if (server.router is not None
+                                and "fleet=0" not in query):
+                            for r in server.router.replicas:
+                                text += labeled_prometheus_text(
+                                    r.engine.registry.prometheus_text(),
+                                    {"replica": r.name})
                     self._body(200, text.encode(),
                                "text/plain; version=0.0.4")
                     return
@@ -333,10 +353,18 @@ class ServingServer:
                     # memory counter tracks on one clock — save the
                     # body and open it in Perfetto.  A fresh memory
                     # sample is forced so the export always carries a
-                    # current memory point.
+                    # current memory point.  ?fleet=1 serves the
+                    # fleet-merged trace instead: one pid per source
+                    # (this process, each replica registry source,
+                    # each spooled dead worker), all on the wall
+                    # clock, with flow events stitching spans that
+                    # share a trace_id across pids.
                     memory.maybe_sample(force=True)
-                    self._body(200,
-                               json.dumps(export_timeline()).encode(),
+                    if "fleet=1" in self.path:
+                        doc = server.fleet().fleet_timeline()
+                    else:
+                        doc = export_timeline()
+                    self._body(200, json.dumps(doc).encode(),
                                "application/json")
                     return
                 if self.path.startswith("/spans"):
@@ -394,12 +422,20 @@ class ServingServer:
                 rid = request_log.sanitize_request_id(
                     self.headers.get("X-Request-Id")
                     or request_log.new_request_id())
+                # cross-process trace context: a client-sent
+                # traceparent header makes this handler's span (and
+                # everything under it — router dispatch, requeues) a
+                # child of the caller's trace instead of a fresh root
+                tparent = trace_context.extract_headers(self.headers)
 
                 def reject(code: int, msg: str,
                            retry_after_s: Optional[float] = None):
                     request_log.reject(rid, code, msg)
                     payload = {"error": msg, "request_id": rid}
-                    headers = None
+                    headers = (
+                        {trace_context.TRACEPARENT_HEADER:
+                         tparent.traceparent()}
+                        if tparent is not None else None)
                     if code == 503:
                         # every shed carries a comeback hint so a
                         # well-behaved client (InputQueue with a
@@ -407,7 +443,8 @@ class ServingServer:
                         # estimate instead of hammering the door
                         ra = retry_after_s if retry_after_s else 1.0
                         payload["retry_after_s"] = round(ra, 3)
-                        headers = {"Retry-After": f"{ra:.3f}"}
+                        headers = dict(headers or {},
+                                       **{"Retry-After": f"{ra:.3f}"})
                     self._json(code, payload, request_id=rid,
                                headers=headers)
 
@@ -424,43 +461,57 @@ class ServingServer:
                     QueueFull,
                     RequestTooLarge,
                 )
-                try:
-                    stream = eng.submit(
-                        tokens,
-                        max_new_tokens=int(req.get("max_new_tokens",
-                                                   32)),
-                        temperature=float(req.get("temperature", 0.0)),
-                        top_k=int(req.get("top_k", 0)),
-                        eos_id=(int(req["eos_id"])
-                                if req.get("eos_id") is not None
-                                else None),
-                        request_id=rid)
-                except RequestTooLarge as e:
-                    reject(413, str(e))
-                    return
-                except QueueFull as e:
-                    reject(503, str(e),
-                           retry_after_s=getattr(e, "retry_after_s",
-                                                 None))
-                    return
-                except ReplicaStopped as e:
-                    # taxonomy (serving/errors.py): the router/pool is
-                    # stopping — lifecycle, not the request's fault
-                    reject(503, str(e))
-                    return
-                except ValueError as e:
-                    reject(400, str(e))
-                    return
-                rid = stream.request_id or rid   # uniquified id wins
-                server._c_requests.inc()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/x-ndjson")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.send_header("X-Request-Id", rid)
-                self.end_headers()
-                n = 0
+                # one span covers admission AND streaming so the
+                # router's dispatch/requeue spans nest under it; its
+                # context is echoed back as a traceparent header
+                span_kw = ({"parent": tparent}
+                           if tparent is not None else {})
                 with trace("serving.generate", prompt=len(tokens),
-                           request_id=rid):
+                           request_id=rid, **span_kw) as span:
+                    try:
+                        stream = eng.submit(
+                            tokens,
+                            max_new_tokens=int(req.get("max_new_tokens",
+                                                       32)),
+                            temperature=float(req.get("temperature",
+                                                      0.0)),
+                            top_k=int(req.get("top_k", 0)),
+                            eos_id=(int(req["eos_id"])
+                                    if req.get("eos_id") is not None
+                                    else None),
+                            request_id=rid)
+                    except RequestTooLarge as e:
+                        reject(413, str(e))
+                        return
+                    except QueueFull as e:
+                        reject(503, str(e),
+                               retry_after_s=getattr(e, "retry_after_s",
+                                                     None))
+                        return
+                    except ReplicaStopped as e:
+                        # taxonomy (serving/errors.py): the router/pool
+                        # is stopping — lifecycle, not the request's
+                        # fault
+                        reject(503, str(e))
+                        return
+                    except ValueError as e:
+                        reject(400, str(e))
+                        return
+                    rid = stream.request_id or rid  # uniquified id wins
+                    span.attrs["request_id"] = rid
+                    server._c_requests.inc()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.send_header("X-Request-Id", rid)
+                    self.send_header(
+                        trace_context.TRACEPARENT_HEADER,
+                        trace_context.TraceContext(
+                            span.trace_id,
+                            span.span_id).traceparent())
+                    self.end_headers()
+                    n = 0
                     try:
                         for tok in stream:
                             self._chunk(json.dumps({"token": tok})
@@ -533,16 +584,37 @@ class ServingServer:
                 group = str(req.get("group", "default"))
                 try:
                     if verb == "enqueue":
+                        # trace propagation into the durable plane: a
+                        # traceparent header (or ambient context) is
+                        # stamped onto the record document itself, so
+                        # whichever process leases it — now or after a
+                        # crash replay — continues the same trace
+                        tparent = trace_context.extract_headers(
+                            self.headers)
+                        if (body and isinstance(req, dict)
+                                and trace_context.RECORD_FIELD
+                                not in req):
+                            trace_context.inject_record(req, tparent)
+                            if trace_context.RECORD_FIELD in req:
+                                body = json.dumps(req).encode()
                         record_id = stream.enqueue(body)
                         rid = f"strm-{name}-{record_id}"
+                        efields = dict(stream=name,
+                                       record_id=record_id)
+                        if tparent is not None:
+                            efields["traceparent"] = (
+                                tparent.traceparent())
                         request_log.event(rid, "stream_enqueue",
-                                          stream=name,
-                                          record_id=record_id)
+                                          **efields)
                         self._json(200, {"status": "queued",
                                          "uri": req.get("uri"),
                                          "stream": name,
                                          "record_id": record_id},
-                                   request_id=rid)
+                                   request_id=rid,
+                                   headers=(
+                                       {trace_context.TRACEPARENT_HEADER:
+                                        tparent.traceparent()}
+                                       if tparent is not None else None))
                         return
                     if verb == "dequeue":
                         recs = stream.dequeue(
@@ -918,7 +990,24 @@ class ServingServer:
                 "slo_attainment": slo["attainment"],
                 "slo_targets": slo["targets"],
             }
+        from analytics_zoo_tpu.common.context import OrcaContext
+        if (self.router is not None
+                or OrcaContext.observability_dir is not None):
+            # fleet SLO rollup (observability/fleet.py): per-source
+            # attainment (live + spooled dead workers), per-replica
+            # attainment re-derived from the request log, and a
+            # judged-weighted fleet number
+            out["fleet"] = self.fleet().fleet_slo()
         return out
+
+    def fleet(self) -> FleetAggregator:
+        """The server's FleetAggregator (lazy; one per server so the
+        fleet_* counters tell one story)."""
+        agg = getattr(self, "_fleet_agg", None)
+        if agg is None:
+            agg = FleetAggregator.from_server(self)
+            self._fleet_agg = agg
+        return agg
 
     # ------------------------------------------------------------------
 

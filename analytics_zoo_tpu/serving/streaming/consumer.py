@@ -24,7 +24,13 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from analytics_zoo_tpu.observability import log_event, request_log
+from analytics_zoo_tpu.observability import (
+    log_event,
+    maybe_spool,
+    request_log,
+    trace,
+    trace_context,
+)
 from analytics_zoo_tpu.serving.codec import decode_record, encode_record
 from analytics_zoo_tpu.serving.streaming.stream import DurableStream
 
@@ -87,11 +93,27 @@ class StreamConsumer:
                 if self._stop.is_set():
                     return            # killed mid-batch: no ack
                 self._handle(rec)
+            # durable telemetry: this loop's last metrics/spans
+            # survive a SIGKILL (no-op while observability_dir is
+            # unset; time-gated otherwise)
+            maybe_spool(f"consumer-{self.group}-{self.consumer}")
 
     def _handle(self, rec) -> None:
         try:
             doc = decode_record(rec.payload)
-            result = self.handler(doc, rec)
+            # the record document carries its trace across the
+            # process boundary: bind it so the handler's spans (and
+            # any router dispatch under them) join the enqueuer's
+            # trace — including a replay leased after a crash
+            tparent = trace_context.extract_record(doc)
+            with trace_context.bind(tparent):
+                with trace("stream.consume",
+                           stream=self.stream.name, group=self.group,
+                           record_id=rec.record_id,
+                           attempts=rec.attempts):
+                    result = self.handler(doc, rec)
+                if isinstance(result, dict):
+                    trace_context.inject_record(result, tparent)
         except Exception as e:
             self.errors += 1
             log_event("stream_handler_error", group=self.group,

@@ -1077,6 +1077,37 @@ def overload_metrics(duration_s: float = 2.5, slo_s: float = 0.25,
         out["overload_kill_lost"] = len(lost)
         out["overload_gate_zero_acked_loss_pass"] = bool(
             lag_left == 0 and not lost)
+
+        # -- fleet-aggregated scrape of the whole window -------------
+        # GET /metrics?fleet=1 merges the server process with every
+        # spooled worker snapshot (observability/fleet.py): the summed
+        # stream_* counters here are the single pane an operator's
+        # dashboard would chart for this overload, shed-audit included
+        import re
+
+        from analytics_zoo_tpu.observability import (
+            parse_prometheus_text,
+        )
+        try:
+            ftext = urllib.request.urlopen(
+                f"{base}/metrics?fleet=1", timeout=10).read().decode()
+            fparsed = parse_prometheus_text(ftext)
+            m = re.search(r"# fleet: (\d+) sources \((\d+) spooled\)",
+                          ftext)
+            out["overload_fleet"] = {
+                "sources": int(m.group(1)) if m else None,
+                "spooled_sources": int(m.group(2)) if m else None,
+            }
+            for name in ("stream_appends_total", "stream_acked_total",
+                         "stream_redeliveries_total",
+                         "stream_backpressure_total",
+                         "serving_requests_total"):
+                v = fparsed.get(name, {}).get("value")
+                if v is not None:
+                    out["overload_fleet"][name] = int(v)
+        except Exception as e:
+            out["overload_fleet"] = {
+                "error": f"{type(e).__name__}: {e}"}
     finally:
         srv.stop()
         hub.close()
@@ -1470,6 +1501,34 @@ def router_metrics(n_requests: int = 16, slots: int = 4,
             break
         router2.stop()  # host jitter: re-measure both sides warm
 
+    # fleet aggregation over the live 2-replica router: the summed
+    # counter must equal the per-source scrapes EXACTLY (the
+    # fleet-view contract docs/observability.md pins — checked here
+    # on real bench traffic, spooled snapshots excluded so the
+    # equation has exactly three known sources)
+    from analytics_zoo_tpu.observability.fleet import FleetAggregator
+    from analytics_zoo_tpu.observability.registry import (
+        get_registry,
+        parse_prometheus_text,
+    )
+    agg = FleetAggregator(router=router2, include_spooled=False)
+    fleet = parse_prometheus_text(agg.fleet_prometheus_text())
+    fleet_tokens = fleet.get("generation_tokens_total", {}).get(
+        "value", 0.0)
+    expected = (
+        get_registry().counter("generation_tokens_total").value
+        + sum(r.engine.registry.counter("generation_tokens_total").value
+              for r in router2.replicas))
+    fleet_block = {
+        "sources": 1 + len(router2.replicas),
+        "generation_tokens_total": int(fleet_tokens),
+        "sum_matches_sources_pass": bool(fleet_tokens == expected),
+    }
+    if fleet_tokens != expected:
+        raise RuntimeError(
+            f"fleet-aggregated generation_tokens_total {fleet_tokens} "
+            f"!= per-source sum {expected} — counter merge lost data")
+
     # drain probe on the live 2-replica router: all-draining must shed
     # with the comeback hint, never hang or admit
     router2.drain()
@@ -1503,6 +1562,7 @@ def router_metrics(n_requests: int = 16, slots: int = 4,
         "router_requests": n_requests,
         "router_shed_retry_after_s": round(shed.retry_after_s, 3),
         "router_devices": len(devices),
+        "router_fleet": fleet_block,
     }
     if not scale_armed:
         out["router_scale_gate"] = (
@@ -1609,18 +1669,24 @@ def main():
                     f"{type(e).__name__}: {e}"[:160]}
 
     longctx = {}
-    try:  # quick (~10s warm): never risks the primary metric
-        longctx = {"flash_attention_seq16k_fwdbwd_ms":
-                   round(longctx_flash_ms(), 1)}
-        # 32k point (r4): ~2.3x the 16k wall for 4x the attention
-        # FLOPs — only measured when budget remains (cold compile
-        # ~1min) WITHOUT eating the serving stage's 60s reservation
-        if budget - (time.monotonic() - t_start) > 120 + 60:
-            longctx["flash_attention_seq32k_fwdbwd_ms"] = round(
-                longctx_flash_ms(32768), 1)
-    except Exception as e:
-        longctx.setdefault("longctx_error",
-                           f"{type(e).__name__}: {e}"[:120])
+    if os.environ.get("BENCH_LONGCTX", "1") == "0":
+        # interpret-mode flash on a pure-CPU host runs the 16k point at
+        # ~6 min/iter, starving every window behind it; same opt-out
+        # contract as BENCH_BERT=0 — an explicit marker, never a hole
+        longctx = {"longctx_error": "disabled via BENCH_LONGCTX=0"}
+    else:
+        try:  # quick (~10s warm): never risks the primary metric
+            longctx = {"flash_attention_seq16k_fwdbwd_ms":
+                       round(longctx_flash_ms(), 1)}
+            # 32k point (r4): ~2.3x the 16k wall for 4x the attention
+            # FLOPs — only measured when budget remains (cold compile
+            # ~1min) WITHOUT eating the serving stage's 60s reservation
+            if budget - (time.monotonic() - t_start) > 120 + 60:
+                longctx["flash_attention_seq32k_fwdbwd_ms"] = round(
+                    longctx_flash_ms(32768), 1)
+        except Exception as e:
+            longctx.setdefault("longctx_error",
+                               f"{type(e).__name__}: {e}"[:120])
 
     serving = {}
     try:

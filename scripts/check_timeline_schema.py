@@ -17,13 +17,23 @@ shows an empty trace), so the schema is pinned here:
   exporter sorts — a regression here breaks sequential consumers);
 * pid/tid mapping: every pid used has a ``process_name`` metadata
   event and every (pid, tid) a ``thread_name`` one — the rows Perfetto
-  labels.
+  labels;
+* flow events (``ph`` s/t/f, the fleet exporter's cross-process trace
+  links) carry an ``id``, and each flow is well-sequenced over the
+  ts-sorted stream: opened by ``s`` before any ``t``/``f``, closed by
+  ``f`` exactly once;
+* fleet-merged traces (``otherData.fleet`` true,
+  observability/fleet.py) additionally pin one DISTINCT process_name
+  per pid (a source = a pid), per-pid monotone ``ts``, and an
+  ``otherData.sources`` map consistent with the named pids.
 
 Usage: ``python scripts/check_timeline_schema.py [trace.json ...]``.
-With file arguments, each is validated.  With none, a synthetic
-scenario is run through the REAL exporter (a span, a fenced goodput
-step, a full request lifecycle incl. preemption, a memory sample) and
-the result validated — the self-contained tier-1 lint mode
+With file arguments, each is validated.  With none, two synthetic
+scenarios run through the REAL exporters: the single-process one (a
+span, a fenced goodput step, a full request lifecycle incl.
+preemption, a memory sample) and a THREE-process fleet merge (the
+local process plus two spooled snapshots sharing a trace_id, driven
+through `FleetAggregator`) — the self-contained tier-1 lint mode
 (tests/test_timeline_schema.py).  Exit code 0 = clean.
 """
 
@@ -39,9 +49,15 @@ from typing import Any, Dict, List
 #: `python scripts/check_timeline_schema.py`
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: phases the exporter may emit (superset-safe: B/E/b/e accepted for
-#: hand-written traces fed through the validator)
-VALID_PH = {"X", "B", "E", "b", "e", "n", "i", "I", "C", "M"}
+#: phases the exporters may emit (superset-safe: B/E/b/e accepted for
+#: hand-written traces fed through the validator; s/t/f are the fleet
+#: exporter's flow events)
+VALID_PH = {"X", "B", "E", "b", "e", "n", "i", "I", "C", "M",
+            "s", "t", "f"}
+
+#: flow-event phases (start / step / finish) — require an ``id`` and
+#: s-before-t-before-f sequencing over the sorted stream
+FLOW_PH = {"s", "t", "f"}
 
 #: instant-event scopes (g=global, p=process, t=thread)
 VALID_SCOPE = {"g", "p", "t"}
@@ -65,11 +81,18 @@ def validate_timeline(doc: Any) -> List[str]:
     if not events:
         return ["'traceEvents' is empty"]
 
+    fleet = isinstance(doc.get("otherData"), dict) and \
+        bool(doc["otherData"].get("fleet"))
     last_ts = None
+    last_ts_by_pid: Dict[int, float] = {}
     used_pids = set()
     used_tids = set()
     named_pids = set()
     named_tids = set()
+    #: pid -> process_name (fleet: one distinct name per pid)
+    pid_names: Dict[int, str] = {}
+    #: flow id -> "open" | "closed"
+    flow_state: Dict[Any, str] = {}
 
     for i, e in enumerate(events):
         where = f"traceEvents[{i}]"
@@ -96,6 +119,13 @@ def validate_timeline(doc: Any) -> List[str]:
                     errors.append(f"{where}: metadata needs int pid")
                 elif name == "process_name":
                     named_pids.add(e["pid"])
+                    pname = e.get("args", {}).get("name")
+                    if isinstance(pname, str):
+                        prev = pid_names.setdefault(e["pid"], pname)
+                        if fleet and prev != pname:
+                            errors.append(
+                                f"{where}: fleet pid {e['pid']} named "
+                                f"twice ({prev!r} then {pname!r})")
                 elif isinstance(e.get("tid"), int):
                     named_tids.add((e["pid"], e["tid"]))
                 else:
@@ -120,7 +150,39 @@ def validate_timeline(doc: Any) -> List[str]:
                 f"{where}: ts {ts} < previous {last_ts} — stream not "
                 "monotone")
         last_ts = ts
-        if ph == "X":
+        if fleet:
+            # one source = one pid: its event stream must read in order
+            # on its own, not just interleaved into a sorted whole
+            prev_pid_ts = last_ts_by_pid.get(e["pid"])
+            if prev_pid_ts is not None and ts < prev_pid_ts:
+                errors.append(
+                    f"{where}: ts {ts} < previous {prev_pid_ts} for "
+                    f"pid {e['pid']} — source stream not monotone")
+            last_ts_by_pid[e["pid"]] = ts
+        if ph in FLOW_PH:
+            fid = e.get("id")
+            if not isinstance(fid, (int, str)) or isinstance(fid, bool):
+                errors.append(
+                    f"{where}: flow event ({ph}) needs an int/str id")
+                continue
+            state = flow_state.get(fid)
+            if ph == "s":
+                if state == "open":
+                    errors.append(
+                        f"{where}: flow {fid!r} re-opened while open")
+                flow_state[fid] = "open"
+            elif state != "open":
+                errors.append(
+                    f"{where}: flow {fid!r} {ph!r} event "
+                    f"{'after finish' if state == 'closed' else 'before its s'}")
+            if ph == "f":
+                if "bp" in e and e["bp"] != "e":
+                    errors.append(
+                        f"{where}: flow finish bp must be 'e', got "
+                        f"{e['bp']!r}")
+                if state == "open":
+                    flow_state[fid] = "closed"
+        elif ph == "X":
             if not _is_num(e.get("dur")) or e["dur"] < 0:
                 errors.append(
                     f"{where}: X slice needs numeric dur >= 0")
@@ -141,6 +203,34 @@ def validate_timeline(doc: Any) -> List[str]:
     for pid, tid in sorted(used_tids - named_tids):
         errors.append(
             f"(pid {pid}, tid {tid}) has no thread_name metadata")
+    for fid, state in sorted(flow_state.items(), key=str):
+        if state == "open":
+            errors.append(f"flow {fid!r} never finished (no f event)")
+    if fleet:
+        by_name: Dict[str, List[int]] = {}
+        for pid, pname in pid_names.items():
+            by_name.setdefault(pname, []).append(pid)
+        for pname, pids in sorted(by_name.items()):
+            if len(pids) > 1:
+                errors.append(
+                    f"fleet process_name {pname!r} shared by pids "
+                    f"{sorted(pids)} — sources must be distinct")
+        sources = doc["otherData"].get("sources")
+        if not isinstance(sources, dict) or not sources:
+            errors.append(
+                "fleet trace needs a non-empty otherData.sources map")
+        else:
+            for key in sorted(sources):
+                try:
+                    pid = int(key)
+                except (TypeError, ValueError):
+                    errors.append(
+                        f"otherData.sources key {key!r} is not a pid")
+                    continue
+                if pid not in named_pids:
+                    errors.append(
+                        f"otherData.sources pid {pid} has no "
+                        "process_name metadata")
     return errors
 
 
@@ -181,6 +271,68 @@ def _synthetic_timeline() -> Dict[str, Any]:
     return timeline.export_timeline()
 
 
+def _synthetic_fleet_timeline() -> Dict[str, Any]:
+    """A three-process fleet merge through the REAL aggregator: the
+    local process opens a span under a pinned trace context, two fake
+    remote processes 'die' leaving spooled snapshots that carry spans
+    of the SAME trace — so the merged doc must show >= 3 pids and a
+    stitched s/t/f flow."""
+    import json as _json
+    import shutil
+    import tempfile
+    import time
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from analytics_zoo_tpu.common.context import OrcaContext
+    from analytics_zoo_tpu.observability import (
+        trace,
+        trace_context,
+        tracing,
+    )
+    from analytics_zoo_tpu.observability.fleet import FleetAggregator
+    from analytics_zoo_tpu.observability.telemetry_spool import (
+        reset_spools,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="azt_fleet_lint_")
+    prev = OrcaContext.observability_dir
+    OrcaContext.observability_dir = tmp
+    reset_spools()
+    try:
+        ctx = trace_context.TraceContext("deadbeefcafef00d",
+                                         "0102030405060708")
+        with trace_context.bind(ctx):
+            with trace("fleet.lint.client", check="fleet_schema"):
+                pass
+        local = next(sp for sp in tracing.recent_spans(16)
+                     if sp.get("trace_id") == ctx.trace_id)
+        for k, proc in enumerate(("lint-consumer", "lint-replica"),
+                                 start=1):
+            remote_span = dict(local,
+                               name=f"fleet.lint.{proc}",
+                               span_id=f"{k:016x}",
+                               parent_id=local["span_id"],
+                               start_ts=local["start_ts"] + 0.001 * k)
+            pdir = os.path.join(tmp, "telemetry", proc)
+            os.makedirs(pdir, exist_ok=True)
+            doc = {"proc": proc, "pid": os.getpid() + k, "seq": 1,
+                   "wall_ts": time.time(),
+                   "exposition": "# TYPE lint_fleet_total counter\n"
+                                 "lint_fleet_total 2\n",
+                   "spans": [remote_span], "requests": [], "slo": None}
+            with open(os.path.join(pdir, "snapshot.json"), "w",
+                      encoding="utf-8") as f:
+                _json.dump(doc, f)
+        agg = FleetAggregator(observability_dir=tmp,
+                              local_name="lint-local")
+        return agg.fleet_timeline()
+    finally:
+        OrcaContext.observability_dir = prev
+        reset_spools()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv: List[str]) -> int:
     if argv:
         rc = 0
@@ -212,8 +364,29 @@ def main(argv: List[str]) -> int:
             print(f"  {err}", file=sys.stderr)
         return 1
     n = len(doc["traceEvents"])
-    print(f"check_timeline_schema: clean ({n} events, synthetic "
-          "scenario)")
+
+    fdoc = _synthetic_fleet_timeline()
+    ferrors = validate_timeline(fdoc)
+    fevents = fdoc.get("traceEvents", [])
+    pids = {e["pid"] for e in fevents
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    if len(pids) < 3:
+        ferrors.append(
+            f"fleet merge shows {len(pids)} pids, expected >= 3 (one "
+            "per source)")
+    phases = {e.get("ph") for e in fevents}
+    if not ({"s", "f"} <= phases):
+        ferrors.append(
+            "fleet merge has no stitched flow (expected s and f "
+            "events for the shared trace_id)")
+    if ferrors:
+        print("check_timeline_schema: the fleet exporter emits schema "
+              "violations:", file=sys.stderr)
+        for err in ferrors:
+            print(f"  {err}", file=sys.stderr)
+        return 1
+    print(f"check_timeline_schema: clean ({n} events single-process, "
+          f"{len(fevents)} events fleet merge over {len(pids)} pids)")
     return 0
 
 
