@@ -74,6 +74,7 @@ class OrcaContextMeta(type):
     _serving_replicas = 0
     _telemetry_spool_interval_s = 1.0
     _telemetry_spool_max_bytes = 1024 * 1024
+    _tenant_quotas = None
 
     # --- TPU runtime state ---
     _mesh = None
@@ -248,6 +249,41 @@ class OrcaContextMeta(type):
         cls._telemetry_spool_max_bytes = int(value)
 
     @property
+    def tenant_quotas(cls):
+        """Per-tenant admission quotas for the unified AdmissionCore
+        (serving/control_plane/admission.py; docs/control-plane.md).
+        A dict mapping tenant name -> sustained requests/sec (float)
+        or ``{"rate": r, "burst": b}`` (token bucket: ``rate`` refill
+        per second, ``burst`` bucket depth, default ``max(rate, 1)``).
+        An over-quota request is shed with 429 `TenantQuotaExceeded`
+        carrying a Retry-After hint; tenants absent from the dict are
+        unlimited.  None (default) disables quota enforcement.  Read
+        at admission time — live updates apply to the next request."""
+        return cls._tenant_quotas
+
+    @tenant_quotas.setter
+    def tenant_quotas(cls, value):
+        if value is None:
+            cls._tenant_quotas = None
+            return
+        quotas = {}
+        for tenant, q in dict(value).items():
+            if not str(tenant):
+                raise ValueError("tenant_quotas key must be non-empty")
+            if isinstance(q, dict):
+                rate = float(q.get("rate", 0.0))
+                burst = float(q.get("burst", max(rate, 1.0)))
+            else:
+                rate = float(q)
+                burst = max(rate, 1.0)
+            if rate <= 0 or burst <= 0:
+                raise ValueError(
+                    f"tenant_quotas[{tenant!r}]: rate and burst must "
+                    "be > 0")
+            quotas[str(tenant)] = {"rate": rate, "burst": burst}
+        cls._tenant_quotas = quotas
+
+    @property
     def goodput_sample_every(cls):
         """Fence cadence of the goodput `StepClock`s
         (observability/goodput.py): every Nth step is closed with a
@@ -312,8 +348,13 @@ class OrcaContextMeta(type):
         violations count in ``slo_violation_total`` (and the per-
         dimension ``slo_violation_<dim>_total`` family), and the
         rolling-window attainment rides the ``slo_attainment_ratio``
-        gauge and GET /slo.  None (default) disables SLO judging —
-        request latency histograms are recorded regardless."""
+        gauge and GET /slo.  Keyed overlays refine the base targets per
+        model or tenant (docs/control-plane.md): a ``"model:<name>"`` /
+        ``"tenant:<name>"`` key maps to its own sub-dict over the same
+        dimensions, merged over the base when that request's model/
+        tenant matches (tenant overlay wins over model).  None
+        (default) disables SLO judging — request latency histograms
+        are recorded regardless."""
         return cls._slo_targets
 
     @slo_targets.setter
@@ -322,15 +363,29 @@ class OrcaContextMeta(type):
             cls._slo_targets = None
             return
         from analytics_zoo_tpu.observability.slo import SLO_DIMENSIONS
+
+        def _dims(d, who):
+            out = {}
+            for k, v in dict(d).items():
+                if k not in SLO_DIMENSIONS:
+                    raise ValueError(
+                        f"unknown SLO dimension {k!r}{who}; valid: "
+                        f"{SLO_DIMENSIONS}")
+                if float(v) <= 0:
+                    raise ValueError(f"SLO target {k} must be > 0")
+                out[k] = float(v)
+            return out
+
         targets = {}
         for k, v in dict(value).items():
-            if k not in SLO_DIMENSIONS:
-                raise ValueError(
-                    f"unknown SLO dimension {k!r}; valid: "
-                    f"{SLO_DIMENSIONS}")
-            if float(v) <= 0:
-                raise ValueError(f"SLO target {k} must be > 0")
-            targets[k] = float(v)
+            if isinstance(k, str) and (k.startswith("model:")
+                                       or k.startswith("tenant:")):
+                if not k.split(":", 1)[1]:
+                    raise ValueError(
+                        f"keyed SLO target {k!r} names no model/tenant")
+                targets[k] = _dims(v, f" under {k!r}")
+            else:
+                targets.update(_dims({k: v}, ""))
         cls._slo_targets = targets
 
     @property

@@ -86,6 +86,7 @@ from analytics_zoo_tpu.observability.request_log import (  # noqa: F401
 )
 from analytics_zoo_tpu.observability.slo import (  # noqa: F401
     SLOTracker,
+    get_shadow_slo_tracker,
     get_slo_tracker,
     reset_slo_tracker,
 )
@@ -105,7 +106,8 @@ __all__ = [
     "TelemetrySpool", "TraceContext", "Watchdog", "annotate",
     "clear_spans", "close_sink", "current_span",
     "current_trace_context", "export_timeline", "flight_recorder",
-    "get_registry", "get_request_log", "get_slo_tracker",
+    "get_registry", "get_request_log", "get_shadow_slo_tracker",
+    "get_slo_tracker",
     "goodput_tables", "labeled_prometheus_text", "localize_nonfinite",
     "log_event", "maybe_spool", "maybe_watchdog", "memory",
     "merged_prometheus_text", "nearest_rank", "new_request_id",

@@ -83,14 +83,23 @@ class RequestRecord:
     __slots__ = ("request_id", "prompt_len", "max_new_tokens", "status",
                  "finish_reason", "wall_enqueue", "t_enqueue", "t_admit",
                  "t_first_token", "t_last_token", "t_finish", "n_tokens",
-                 "n_rounds", "n_preempts", "events", "n_events_dropped")
+                 "n_rounds", "n_preempts", "events", "n_events_dropped",
+                 "model", "tenant", "request_class")
 
     def __init__(self, request_id: str, prompt_len: int,
-                 max_new_tokens: int):
+                 max_new_tokens: int, model: Optional[str] = None,
+                 tenant: Optional[str] = None,
+                 request_class: str = "interactive"):
         t = now()
         self.request_id = request_id
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
+        #: control-plane attribution (docs/control-plane.md): the
+        #: serving "model@version" label, the quota tenant, and the
+        #: request class — the dimensions the SLO judge keys on
+        self.model = model
+        self.tenant = tenant
+        self.request_class = request_class
         self.status = "queued"
         self.finish_reason: Optional[str] = None
         self.wall_enqueue = time.time()   # the one wall anchor
@@ -159,6 +168,9 @@ class RequestRecord:
             "finish_reason": self.finish_reason,
             "prompt_len": self.prompt_len,
             "max_new_tokens": self.max_new_tokens,
+            "model": self.model,
+            "tenant": self.tenant,
+            "request_class": self.request_class,
             "wall_enqueue": round(self.wall_enqueue, 6),
             "t_enqueue": self.t_enqueue,
             "t_admit": self.t_admit,
@@ -220,16 +232,23 @@ class RequestLog:
     # ------------------------------------------------------------------
 
     def start(self, request_id: Optional[str] = None,
-              prompt_len: int = 0, max_new_tokens: int = 0) -> str:
+              prompt_len: int = 0, max_new_tokens: int = 0,
+              model: Optional[str] = None,
+              tenant: Optional[str] = None,
+              request_class: str = "interactive") -> str:
         """Create the record at enqueue time; returns the (possibly
-        uniquified) request id the engine should carry."""
+        uniquified) request id the engine should carry.  `model` /
+        `tenant` / `request_class` attribute the record to the control
+        plane's dimensions (SLO judging keys on them at finish)."""
         rid = (sanitize_request_id(request_id)
                if request_id is not None else new_request_id())
         with self._lock:
             if rid in self._active:   # client-supplied duplicate
                 rid = f"{rid}-{new_request_id()[:4]}"
             self._active[rid] = RequestRecord(
-                rid, int(prompt_len), int(max_new_tokens))
+                rid, int(prompt_len), int(max_new_tokens),
+                model=model, tenant=tenant,
+                request_class=str(request_class))
         return rid
 
     def event(self, request_id: Optional[str], kind: str,
@@ -317,8 +336,21 @@ class RequestLog:
                     "queue_wait_s": rec.queue_wait_s,
                     "e2e_s": rec.e2e_s,
                 }
+                model, tenant = rec.model, rec.tenant
+                is_shadow = rec.request_class == "shadow"
             # metric/SLO work outside the lock: nothing below touches
-            # the record again
+            # the record again.  Shadow duplicates keep their latency
+            # OUT of the primary histograms and SLO window — the
+            # shadow tracker judges them under the shadow_ metric
+            # prefix (non-interference, docs/control-plane.md)
+            from analytics_zoo_tpu.observability.slo import (
+                get_shadow_slo_tracker,
+                get_slo_tracker,
+            )
+            if is_shadow:
+                get_shadow_slo_tracker().observe(
+                    measures, model=model, tenant=tenant)
+                return
             if measures["ttft_s"] is not None:
                 self._h_ttft.record(measures["ttft_s"])
             if measures["tpot_s"] is not None:
@@ -327,10 +359,8 @@ class RequestLog:
                 self._h_queue.record(measures["queue_wait_s"])
             if measures["e2e_s"] is not None:
                 self._h_e2e.record(measures["e2e_s"])
-            from analytics_zoo_tpu.observability.slo import (
-                get_slo_tracker,
-            )
-            get_slo_tracker().observe(measures)
+            get_slo_tracker().observe(measures, model=model,
+                                      tenant=tenant)
         except Exception:
             pass
 
@@ -419,9 +449,13 @@ def reset_request_log() -> RequestLog:
 # module-level conveniences mirroring flight_recorder's style ----------
 
 def start(request_id: Optional[str] = None, prompt_len: int = 0,
-          max_new_tokens: int = 0) -> str:
+          max_new_tokens: int = 0, model: Optional[str] = None,
+          tenant: Optional[str] = None,
+          request_class: str = "interactive") -> str:
     return get_request_log().start(request_id, prompt_len,
-                                   max_new_tokens)
+                                   max_new_tokens, model=model,
+                                   tenant=tenant,
+                                   request_class=request_class)
 
 
 def event(request_id: Optional[str], kind: str, **fields) -> None:

@@ -35,7 +35,9 @@ site                        threaded into
                             retry budget, not escape it)
 ``generation.decode``       engine decode round, before dispatch
 ``generation.prefix_lookup`` prefix-cache radix lookup on admission
-``serving.admission``       GenerationEngine.submit admission check
+``serving.admission``       AdmissionCore queue/SLO check (every door)
+``admission.quota``         AdmissionCore per-tenant quota charge
+``registry.swap``           ModelRegistry.hot_swap, before repointing
 ``router.dispatch``         ReplicaRouter.submit, before replica choice
 ``stream.append``           stream-log frame write (torn-write capable)
 ``stream.fsync``            stream-log fsync batch (torn-write capable)
@@ -83,7 +85,8 @@ KNOWN_SITES = (
     "checkpoint.before_rename", "checkpoint.before_commit",
     "checkpoint.after_commit", "checkpoint.load",
     "generation.decode", "generation.prefix_lookup",
-    "serving.admission", "router.dispatch",
+    "serving.admission", "admission.quota", "registry.swap",
+    "router.dispatch",
     "stream.append", "stream.fsync", "stream.lease", "stream.ack",
 )
 

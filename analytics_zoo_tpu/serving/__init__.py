@@ -15,8 +15,11 @@ from analytics_zoo_tpu.serving.config import (
 )
 from analytics_zoo_tpu.serving.errors import (
     ERROR_HTTP_STATUS,
+    ModelNotFound,
     ReplicaDiedMidPredict,
     ReplicaStopped,
+    TenantQuotaExceeded,
+    UncommittedCheckpointError,
     http_status_for,
 )
 from analytics_zoo_tpu.serving.inference_model import InferenceModel
@@ -46,6 +49,14 @@ _STREAMING = ("DurableStream", "StreamHub", "StreamLog",
               "predict_consumer", "generation_consumer",
               "poisson_trace", "bursty_trace", "run_open_loop")
 
+#: control plane (serving/control_plane/) — lazy because the model
+#: registry reaches into the generation/distributed layers
+_CONTROL_PLANE = ("AdmissionCore", "TokenBucket", "TenantLedger",
+                  "get_tenant_ledger", "reset_tenant_ledger",
+                  "REQUEST_CLASSES", "CLASS_PRIORITY", "ModelRegistry",
+                  "ModelVersion", "MODEL_STATES", "WeightedAB",
+                  "ShadowSampler", "run_shadow")
+
 
 def __getattr__(name):
     if name in _GENERATION:
@@ -57,6 +68,9 @@ def __getattr__(name):
     if name in _STREAMING:
         from analytics_zoo_tpu.serving import streaming
         return getattr(streaming, name)
+    if name in _CONTROL_PLANE:
+        from analytics_zoo_tpu.serving import control_plane
+        return getattr(control_plane, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -65,5 +79,6 @@ __all__ = ["ERROR_HTTP_STATUS", "InferenceModel", "ServingServer",
            "GrpcServingFrontend", "http_status_for", "quantize_params",
            "dequantize_params", "quantized_size_bytes", "ServingConfig",
            "start_serving", "stop_serving", "ReplicaStopped",
-           "ReplicaDiedMidPredict", *_GENERATION, *_DISTRIBUTED,
-           *_STREAMING]
+           "ReplicaDiedMidPredict", "TenantQuotaExceeded",
+           "UncommittedCheckpointError", "ModelNotFound",
+           *_GENERATION, *_DISTRIBUTED, *_STREAMING, *_CONTROL_PLANE]

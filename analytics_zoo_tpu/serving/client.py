@@ -24,10 +24,12 @@ from analytics_zoo_tpu.observability import trace_context
 from analytics_zoo_tpu.serving.codec import decode_ndarray, encode_ndarray
 
 
-def _post(url: str, payload: Dict[str, Any], timeout: float = 60.0):
+def _post(url: str, payload: Dict[str, Any], timeout: float = 60.0,
+          headers: Optional[Dict[str, str]] = None):
     req = urllib.request.Request(
         url, data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"})
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return json.loads(r.read())
@@ -41,11 +43,13 @@ def _post(url: str, payload: Dict[str, Any], timeout: float = 60.0):
 
 
 def _post_bytes(url: str, blob: bytes, content_type: str,
-                timeout: float = 60.0) -> bytes:
+                timeout: float = 60.0,
+                headers: Optional[Dict[str, str]] = None) -> bytes:
     """Raw-body POST sharing _post's error-body handling (error
     responses are JSON even on binary endpoints)."""
     req = urllib.request.Request(
-        url, data=blob, headers={"Content-Type": content_type})
+        url, data=blob, headers=dict({"Content-Type": content_type},
+                                     **(headers or {})))
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.read()
@@ -64,14 +68,37 @@ def _get(url: str, timeout: float = 60.0):
 
 class InputQueue:
     def __init__(self, host: str = "127.0.0.1", port: int = 10020,
-                 codec: str = "json"):
+                 codec: str = "json", model: Optional[str] = None,
+                 tenant: Optional[str] = None):
         """`codec`: "json" (base64 ndarrays, the reference client
         default) or "arrow" (Arrow IPC binary tensors — the reference's
-        Arrow serialization, smaller and faster on big payloads)."""
+        Arrow serialization, smaller and faster on big payloads).
+
+        `model` / `tenant` (docs/control-plane.md) attribute every
+        request this queue sends: they ride as X-Model / X-Tenant
+        headers (and as record doc fields on the durable-stream path)
+        — the server resolves X-Model through its ModelRegistry's A/B
+        + shadow policies and charges X-Tenant's quota bucket.  Both
+        can be overridden per call."""
         if codec not in ("json", "arrow"):
             raise ValueError("codec must be 'json' or 'arrow'")
         self.base = f"http://{host}:{port}"
         self.codec = codec
+        self.model = model
+        self.tenant = tenant
+
+    def _attribution(self, model: Optional[str],
+                     tenant: Optional[str]) -> Dict[str, str]:
+        """X-Model/X-Tenant headers from the per-call override or the
+        queue's defaults (empty when neither is set)."""
+        headers: Dict[str, str] = {}
+        model = model if model is not None else self.model
+        tenant = tenant if tenant is not None else self.tenant
+        if model:
+            headers["X-Model"] = str(model)
+        if tenant:
+            headers["X-Tenant"] = str(tenant)
+        return headers
 
     def predict(self, *inputs: np.ndarray, batched: bool = False):
         """Synchronous prediction.  By default each input is ONE record
@@ -80,6 +107,7 @@ class InputQueue:
         arrays = [np.asarray(a) for a in inputs]
         if not batched:
             arrays = [a[None] for a in arrays]
+        headers = self._attribution(None, None)
         if self.codec == "arrow":
             from analytics_zoo_tpu.serving.codec import (
                 ARROW_CONTENT_TYPE,
@@ -88,10 +116,11 @@ class InputQueue:
             )
             outs = decode_arrow_tensors(_post_bytes(
                 f"{self.base}/predict", encode_arrow_tensors(arrays),
-                ARROW_CONTENT_TYPE))
+                ARROW_CONTENT_TYPE, headers=headers))
         else:
             resp = _post(f"{self.base}/predict",
-                         {"inputs": [encode_ndarray(a) for a in arrays]})
+                         {"inputs": [encode_ndarray(a) for a in arrays]},
+                         headers=headers)
             if "error" in resp:
                 raise RuntimeError(f"serving error: {resp['error']}")
             outs = [decode_ndarray(o) for o in resp["outputs"]]
@@ -118,7 +147,9 @@ class InputQueue:
     def generate(self, tokens, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id: Optional[int] = None, timeout: float = 300.0,
-                 request_id: Optional[str] = None, retry=None):
+                 request_id: Optional[str] = None, retry=None,
+                 model: Optional[str] = None,
+                 tenant: Optional[str] = None):
         """Streaming generation client for POST /generate: a generator
         yielding token ids AS THE SERVER SAMPLES THEM (chunked ndjson
         lines decoded incrementally — first token arrives at decode
@@ -139,7 +170,14 @@ class InputQueue:
         deterministic backoff, and re-sends the SAME X-Request-Id so
         the whole journey shares one lifecycle-log record trail.
         Retries happen only before the first token — a broken stream
-        is never silently re-run."""
+        is never silently re-run.  A 429 (tenant over quota,
+        docs/control-plane.md) retries the same way, honoring the
+        quota bucket's refill ETA in Retry-After.
+
+        `model` / `tenant` (or the queue's defaults) ride as
+        X-Model / X-Tenant; the server's echoed X-Model — the
+        RESOLVED model@version when a registry routed the request —
+        lands in `self.last_model`."""
         payload = {"tokens": [int(t) for t in tokens],
                    "max_new_tokens": max_new_tokens,
                    "temperature": temperature, "top_k": top_k,
@@ -149,6 +187,7 @@ class InputQueue:
             import uuid
             request_id = f"cli-{uuid.uuid4().hex[:12]}"
         headers = {"Content-Type": "application/json"}
+        headers.update(self._attribution(model, tenant))
         if request_id is not None:
             headers["X-Request-Id"] = str(request_id)
         # trace propagation: a client calling from inside a span (or
@@ -158,6 +197,7 @@ class InputQueue:
         trace_context.inject_headers(headers)
         self.last_request_id = None
         self.last_traceparent = None
+        self.last_model = None
         self.last_retries = 0
         max_attempts = retry.max_attempts if retry is not None else 1
         resp = None
@@ -175,7 +215,7 @@ class InputQueue:
                     err = json.loads(e.read()).get("error", str(e))
                 except Exception:
                     err = str(e)
-                if retry is None or e.code != 503 or \
+                if retry is None or e.code not in (429, 503) or \
                         attempt >= max_attempts:
                     raise RuntimeError(
                         f"serving error: {err}") from None
@@ -201,6 +241,7 @@ class InputQueue:
                 self.last_retries += 1
                 time.sleep(retry.backoff(attempt))
         self.last_request_id = resp.headers.get("X-Request-Id")
+        self.last_model = resp.headers.get("X-Model")
         self.last_traceparent = resp.headers.get(
             trace_context.TRACEPARENT_HEADER)
         with resp:
@@ -221,7 +262,9 @@ class InputQueue:
         return list(self.generate(tokens, **kw))
 
     def enqueue(self, uri: str, stream: Optional[str] = None,
-                retry=None, timeout: float = 60.0, **inputs) -> str:
+                retry=None, timeout: float = 60.0,
+                model: Optional[str] = None,
+                tenant: Optional[str] = None, **inputs) -> str:
         """Async enqueue of one record (reference InputQueue.enqueue);
         fetch via OutputQueue.dequeue(uri).
 
@@ -234,21 +277,34 @@ class InputQueue:
         the consumer groups can't keep up the server sheds with 429 +
         Retry-After; pass `retry` (a `resilience.RetryPolicy`) to back
         off by the server's drain-rate hint (jittered via
-        `retry.spread` when the policy enables it) and re-send."""
+        `retry.spread` when the policy enables it) and re-send.
+
+        `model` / `tenant` (or the queue's defaults) ride as headers
+        AND — on the durable path — as ``"model"``/``"tenant"``
+        fields on the record document, so whichever consumer leases
+        the record (now or after a crash replay) carries the same
+        attribution into its submit/predict."""
+        attribution = self._attribution(model, tenant)
         arrays = [np.asarray(a)[None] for a in inputs.values()]
         payload = {"uri": uri,
                    "inputs": [encode_ndarray(a) for a in arrays]}
         if stream is None:
-            resp = _post(f"{self.base}/enqueue", payload)
+            resp = _post(f"{self.base}/enqueue", payload,
+                         headers=attribution)
             if resp.get("status") != "queued":
                 raise RuntimeError(f"enqueue failed: {resp}")
             return resp["uri"]
         self.last_record_id = None
         # durable-mode propagation: the context rides BOTH the header
         # and the record document itself — the doc copy is what a
-        # consumer process sees after a lease (or a crash replay)
+        # consumer process sees after a lease (or a crash replay);
+        # model/tenant attribution travels the same two ways
+        if attribution.get("X-Model"):
+            payload["model"] = attribution["X-Model"]
+        if attribution.get("X-Tenant"):
+            payload["tenant"] = attribution["X-Tenant"]
         stream_headers = trace_context.inject_headers(
-            {"Content-Type": "application/json"})
+            dict({"Content-Type": "application/json"}, **attribution))
         trace_context.inject_record(payload)
         max_attempts = retry.max_attempts if retry is not None else 1
         for attempt in range(1, max_attempts + 1):

@@ -1,0 +1,53 @@
+"""Serving control plane (L7) — which model, which tenant, which
+priority, for every request (docs/control-plane.md).
+
+The reference's Cluster Serving fronted many named models behind one
+ingestion plane (SURVEY §2.5, §3.5: the Redis stream carried a model
+key, the Flink job resolved it against a model dir); our reproduction
+served exactly one anonymous model per process until this package.
+Three cooperating parts:
+
+* `AdmissionCore` (admission.py) — THE admission decision, extracted
+  from GenerationEngine.submit and the WorkerPool checkout queue:
+  queue-bound + SLO-aware shedding (verbatim PR 7/11 semantics),
+  typed request classes (interactive/batch/shadow) mapping to
+  scheduler priorities, and per-tenant token-bucket quotas
+  (`OrcaContext.tenant_quotas`) shed with 429 + Retry-After.
+* `ModelRegistry` (registry.py) — named models × versions with
+  lifecycle states (loading/ready/draining/retired), registration
+  gated on the PR 7 commit-marker protocol, and `hot_swap()` /
+  `rollback()` repointing the serving version with zero dropped
+  in-flight requests.
+* Routing policies (routing.py) — weighted A/B between two versions
+  of one model, and shadow traffic: a sampled fraction duplicated to
+  a candidate version, output discarded, latency/SLO recorded on the
+  shadow side only.
+"""
+
+from analytics_zoo_tpu.serving.control_plane.admission import (  # noqa: F401,E501
+    CLASS_PRIORITY,
+    REQUEST_CLASSES,
+    AdmissionCore,
+    TenantLedger,
+    TokenBucket,
+    get_tenant_ledger,
+    reset_tenant_ledger,
+)
+from analytics_zoo_tpu.serving.control_plane.registry import (  # noqa: F401,E501
+    MODEL_STATES,
+    ModelRegistry,
+    ModelVersion,
+)
+from analytics_zoo_tpu.serving.control_plane.routing import (  # noqa: F401,E501
+    ShadowSampler,
+    WeightedAB,
+    run_shadow,
+)
+
+__all__ = [
+    "AdmissionCore", "TokenBucket", "TenantLedger",
+    "get_tenant_ledger", "reset_tenant_ledger",
+    "REQUEST_CLASSES", "CLASS_PRIORITY",
+    "ModelRegistry", "ModelVersion", "MODEL_STATES",
+    "WeightedAB", "ShadowSampler", "run_shadow",
+]

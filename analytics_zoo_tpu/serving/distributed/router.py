@@ -328,14 +328,20 @@ class ReplicaRouter:
                temperature: float = 0.0, top_k: int = 0,
                eos_id: Optional[int] = None,
                stream_timeout: float = 120.0,
-               request_id: Optional[str] = None) -> RouterStream:
+               request_id: Optional[str] = None,
+               tenant: Optional[str] = None,
+               request_class: str = "interactive") -> RouterStream:
         """Admit one request on the least-loaded active replica.
 
         Raises exactly what `GenerationEngine.submit` raises —
         ValueError / `RequestTooLarge` propagate from the first
         replica tried (geometry is identical across replicas), and
         `QueueFull` (with the smallest Retry-After hint) when EVERY
-        replica sheds or none is admitting."""
+        replica sheds or none is admitting.  `TenantQuotaExceeded`
+        (429) propagates from the FIRST replica that reached its
+        quota gate: the tenant ledger is process-global, so shopping
+        the request to another replica would charge the same empty
+        bucket — deliberately NOT part of the shed-retry loop below."""
         if self._stopped:
             raise ReplicaStopped("replica router stopped")
         act = fault_point("router.dispatch",
@@ -349,7 +355,8 @@ class ReplicaRouter:
         self.heartbeat()
         kwargs = dict(max_new_tokens=int(max_new_tokens),
                       temperature=temperature, top_k=top_k,
-                      eos_id=eos_id, stream_timeout=stream_timeout)
+                      eos_id=eos_id, stream_timeout=stream_timeout,
+                      tenant=tenant, request_class=request_class)
         with self._lock:
             candidates = self._ordered(self._candidates())
         if not candidates:

@@ -28,6 +28,18 @@ ERROR_HTTP_STATUS = {
     "ReplicaStopped": 503,
     "ReplicaDiedMidPredict": 503,
     "QueueFull": 503,
+    # control plane (serving/control_plane/): per-tenant quota sheds
+    # are 429 — the SERVICE has capacity, this tenant's token bucket
+    # is empty, and retrying another replica cannot help (the ledger
+    # is process-global), so this is deliberately NOT a QueueFull
+    # subclass (the router's shed-retry loop must not spin on it)
+    "TenantQuotaExceeded": 429,
+    # registry lifecycle misuse: registering/swapping onto a
+    # checkpoint without a durable commit marker, or naming a model
+    # the registry does not hold (4xx — the caller's config is wrong,
+    # the serving fleet is healthy)
+    "UncommittedCheckpointError": 409,
+    "ModelNotFound": 404,
     # streaming data plane: bounded-buffer backpressure at enqueue —
     # 429 (the stream exists and is healthy, the CALLER is outrunning
     # the consumer groups' drain rate; responses carry Retry-After
@@ -61,6 +73,56 @@ class ReplicaDiedMidPredict(RuntimeError):
     once on a healthy replica (HTTP 503 when it does escape)."""
 
 
+class QueueFull(RuntimeError):
+    """Admission shed: the waiting queue is at its bound or the SLO
+    shedder judged the backlog unserveable (HTTP 503).  Raised by the
+    unified AdmissionCore (serving/control_plane/admission.py) on
+    behalf of every front door — GenerationEngine.submit, the
+    WorkerPool checkout, ServingServer's /predict batcher and the
+    ReplicaRouter (when EVERY replica shed).  Carries the server's
+    backoff hint: ``retry_after_s`` (seconds), surfaced as the HTTP
+    Retry-After header."""
+
+    def __init__(self, message: str,
+                 retry_after_s: "float | None" = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class RequestTooLarge(ValueError):
+    """The request can NEVER fit this engine's compiled geometry
+    (prompt + max_new_tokens vs max_context) — a client error (HTTP
+    413), not a load condition; retrying unchanged cannot succeed."""
+
+
+class TenantQuotaExceeded(RuntimeError):
+    """Per-tenant token-bucket quota exhausted (HTTP 429 — the caller
+    should back off for ``retry_after_s``, the bucket's refill ETA).
+    Deliberately not a QueueFull subclass: the quota ledger is shared
+    by every replica in the process, so shopping the request around
+    the fleet cannot admit it (docs/control-plane.md)."""
+
+    def __init__(self, message: str,
+                 retry_after_s: "float | None" = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class UncommittedCheckpointError(RuntimeError):
+    """The ModelRegistry refused to register or hot-swap a version
+    whose source checkpoint lacks a durable commit marker (the PR 7
+    protocol: ``<path>.commit`` written after fsync) — a torn or
+    in-flight write must never take traffic.  HTTP 409: the conflict
+    is between the caller's intent and the checkpoint's state; finish
+    (or re-run) the commit, then retry."""
+
+
+class ModelNotFound(KeyError):
+    """The request named a model (or model version) the registry does
+    not hold — HTTP 404.  Carries the registered names so a typo is
+    diagnosable from the error body alone."""
+
+
 def http_status_for(exc: BaseException, default: int = 500) -> int:
     """Resolve an exception (walking its MRO, so subclasses inherit
     their base's mapping) to an HTTP status."""
@@ -72,4 +134,6 @@ def http_status_for(exc: BaseException, default: int = 500) -> int:
 
 
 __all__ = ["ERROR_HTTP_STATUS", "http_status_for", "ReplicaStopped",
-           "ReplicaDiedMidPredict"]
+           "ReplicaDiedMidPredict", "QueueFull", "RequestTooLarge",
+           "TenantQuotaExceeded", "UncommittedCheckpointError",
+           "ModelNotFound"]

@@ -79,26 +79,18 @@ from analytics_zoo_tpu.serving.generation.scheduler import (
 
 _STREAM_END = object()
 
-
-class RequestTooLarge(ValueError):
-    """The request can never be served by this engine's geometry
-    (prompt + max_new_tokens beyond max_context, or more KV blocks than
-    the whole pool).  The HTTP layer maps it to 413."""
-
-
-class QueueFull(RuntimeError):
-    """Admission control: the waiting queue is at `max_queue`, OR the
-    SLO-aware shedder (`OrcaContext.slo_shed_attainment`) is turning
-    load away while attainment is below target.  The HTTP layer maps
-    it to 503 and forwards `retry_after_s` as the Retry-After header —
-    shed load at the door with a comeback hint instead of queueing
-    unboundedly."""
-
-    def __init__(self, message: str,
-                 retry_after_s: Optional[float] = None):
-        super().__init__(message)
-        #: backoff hint for the client (None -> the server's default)
-        self.retry_after_s = retry_after_s
+# admission policy lives in the unified AdmissionCore
+# (serving/control_plane/admission.py) — one door policy for the
+# engine, the worker pool and the /predict batcher.  The exception
+# types moved to serving/errors.py next to the taxonomy table; these
+# re-exports keep every historical import path working.
+from analytics_zoo_tpu.serving.control_plane.admission import (  # noqa: E402,E501
+    AdmissionCore,
+)
+from analytics_zoo_tpu.serving.errors import (  # noqa: E402,F401
+    QueueFull,
+    RequestTooLarge,
+)
 
 
 class GenerationStream:
@@ -263,16 +255,21 @@ class GenerationEngine:
                    if b <= prefill_token_budget]
         self._chunk_cap = (max(fitting) if fitting
                            else self.scheduler.prefill_buckets[0])
-        #: admission control: submit() raises QueueFull beyond this
-        #: many waiting requests (None = unbounded, the library
-        #: default; servers should bound it)
-        self.max_queue = max_queue
-        #: floor on the waiting-queue depth before SLO-attainment
-        #: shedding kicks in (OrcaContext.slo_shed_attainment): never
-        #: shed an empty queue just because attainment dipped.
-        #: Default: one queued request per decode lane.
-        self.slo_shed_min_queue = (max_slots if slo_shed_min_queue
-                                   is None else int(slo_shed_min_queue))
+        #: admission policy — the unified AdmissionCore
+        #: (serving/control_plane/admission.py): queue bound
+        #: (`max_queue`; None = unbounded, servers should bound it),
+        #: SLO-aware shedding past `slo_shed_min_queue` waiting
+        #: (default: one queued request per decode lane), and the
+        #: per-tenant quota gate.  `max_queue`/`slo_shed_min_queue`
+        #: remain attributes of the engine via properties below.
+        self.admission = AdmissionCore(
+            max_queue=max_queue,
+            slo_shed_min_queue=(max_slots if slo_shed_min_queue is None
+                                else int(slo_shed_min_queue)),
+            retry_after=self.retry_after_s)
+        #: registry label ("model@version") stamped on this engine's
+        #: request-log records; None outside a ModelRegistry
+        self.model_label: Optional[str] = None
         self._rng = jax.random.PRNGKey(seed)
         if self._tp is not None:
             # commit the key to the mesh once; splits stay on-mesh, so
@@ -633,44 +630,44 @@ class GenerationEngine:
             return float(min(10.0, max(0.05, (depth + 1) * mean)))
         return 0.5
 
-    def _shed_reason(self) -> Optional[str]:
-        """Why a new request should be turned away right now (None =
-        admit).  Two gates: the hard `max_queue` bound, and — when
-        `OrcaContext.slo_targets` + `slo_shed_attainment` are set —
-        the SLO-aware shedder: attainment below target with at least
-        `slo_shed_min_queue` requests already waiting means admitting
-        more load would spend latency the objective does not have
-        (ROADMAP item 5: slo.py *drives* 503s instead of judging
-        after the fact)."""
-        depth = len(self.scheduler.waiting)
-        if self.max_queue is not None and depth >= self.max_queue:
-            return (f"{depth} requests already waiting "
-                    f"(max_queue={self.max_queue})")
-        from analytics_zoo_tpu.common.context import OrcaContext
-        thr = OrcaContext.slo_shed_attainment
-        if thr is not None and OrcaContext.slo_targets:
-            from analytics_zoo_tpu.observability import get_slo_tracker
-            att = get_slo_tracker().attainment()
-            if att == att and att < thr and \
-                    depth >= self.slo_shed_min_queue:
-                return (f"shedding under SLO pressure: attainment "
-                        f"{att:.3f} < {thr} with {depth} waiting")
-        return None
+    # queue-bound knobs live on the AdmissionCore (the single door
+    # policy); these properties keep `engine.max_queue = N` working
+    @property
+    def max_queue(self) -> Optional[int]:
+        return self.admission.max_queue
+
+    @max_queue.setter
+    def max_queue(self, value: Optional[int]) -> None:
+        self.admission.max_queue = value
+
+    @property
+    def slo_shed_min_queue(self) -> int:
+        return self.admission.slo_shed_min_queue
+
+    @slo_shed_min_queue.setter
+    def slo_shed_min_queue(self, value: int) -> None:
+        self.admission.slo_shed_min_queue = int(value)
 
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0,
                eos_id: Optional[int] = None,
                stream_timeout: float = 120.0,
-               request_id: Optional[str] = None) -> GenerationStream:
+               request_id: Optional[str] = None,
+               tenant: Optional[str] = None,
+               request_class: str = "interactive") -> GenerationStream:
         """Queue one request; returns its token stream.  Raises up
         front when the request can never run: ValueError for malformed
         prompts, `RequestTooLarge` (a ValueError; HTTP 413) when the
         prompt + max_new_tokens exceed max_context or the whole block
-        pool, `QueueFull` (HTTP 503) past `max_queue` waiting requests.
+        pool, `QueueFull` (HTTP 503) / `TenantQuotaExceeded` (HTTP
+        429) from the AdmissionCore's queue/SLO/quota gates.
 
         `request_id` keys the per-request lifecycle log (request_log);
         one is generated when absent and is readable from the returned
-        stream's `.request_id`."""
+        stream's `.request_id`.  `tenant` attributes the request to a
+        quota bucket (`OrcaContext.tenant_quotas`); `request_class`
+        ("interactive" | "batch" | "shadow") sets its scheduler
+        priority — lower classes admit first and preempt last."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -686,22 +683,17 @@ class GenerationEngine:
             raise RequestTooLarge(
                 f"request needs {self.cache.blocks_for(total)} KV "
                 f"blocks, pool holds {self.cache.allocator.capacity}")
-        shed = self._shed_reason()
-        if shed is not None:
-            raise QueueFull(shed, retry_after_s=self.retry_after_s())
-        # fault-injection site (resilience/faults.py): "refuse" sheds
-        # this request exactly like an organic overload — the client's
-        # RetryPolicy + Retry-After path is testable on demand
-        act = fault_point("serving.admission",
-                          queue_depth=len(self.scheduler.waiting))
-        if act == "refuse":
-            raise QueueFull("injected admission refusal (fault plan)",
-                            retry_after_s=self.retry_after_s())
+        priority = self.admission.admit(
+            len(self.scheduler.waiting), tenant=tenant,
+            request_class=request_class)
         rid = request_log.start(request_id, prompt_len=len(prompt),
-                                max_new_tokens=int(max_new_tokens))
+                                max_new_tokens=int(max_new_tokens),
+                                model=self.model_label, tenant=tenant,
+                                request_class=request_class)
         seq = Sequence(prompt, max_new_tokens=max_new_tokens,
                        temperature=temperature, top_k=top_k,
-                       eos_id=eos_id, request_id=rid)
+                       eos_id=eos_id, request_id=rid,
+                       priority=priority)
         seq.stream = GenerationStream(seq, timeout=stream_timeout)
         with self._lock:
             self.scheduler.submit(seq)

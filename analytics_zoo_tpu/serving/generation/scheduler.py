@@ -60,12 +60,13 @@ class Sequence:
                  "temperature", "top_k", "eos_id", "stream",
                  "block_table", "slot", "status", "finish_reason",
                  "n_preempted", "_admit_order", "request_id",
-                 "prefill_pos", "prefix_tokens")
+                 "prefill_pos", "prefix_tokens", "priority")
 
     def __init__(self, prompt, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id: Optional[int] = None, stream=None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 priority: int = 0):
         self.uid = next(_UIDS)
         #: lifecycle-log key, stable across preempt/resume (one id per
         #: request end to end — the X-Request-Id the HTTP layer echoes)
@@ -83,6 +84,10 @@ class Sequence:
         self.finish_reason: Optional[str] = None
         self.n_preempted = 0
         self._admit_order = -1
+        #: request-class priority (control_plane.CLASS_PRIORITY): 0
+        #: admits first and preempts last; ties stay FCFS / newest-
+        #: preempted-first, so all-default traffic is bitwise legacy
+        self.priority = int(priority)
         #: context tokens whose KV is already written (chunk-prefill
         #: progress; starts at the prefix-cache match length)
         self.prefill_pos = 0
@@ -140,6 +145,14 @@ class SlotScheduler:
                 f"prompt ({seq.context_len}) + max_new_tokens "
                 f"({seq.max_new_tokens}) exceeds max_context "
                 f"{self.max_context}")
+        # priority admission: queue ahead of the first strictly
+        # lower-priority waiter (higher number = less important),
+        # behind every peer — FCFS within a class, so all-default
+        # traffic (priority 0 everywhere) is bitwise legacy append
+        for i, other in enumerate(self.waiting):
+            if other.priority > seq.priority:
+                self.waiting.insert(i, seq)
+                return
         self.waiting.append(seq)
 
     def has_work(self) -> bool:
@@ -188,7 +201,11 @@ class SlotScheduler:
         victims = self.slotted()
         if not victims:
             return None
-        victim = max(victims, key=lambda s: s._admit_order)
+        # lowest class first (shadow before batch before interactive),
+        # newest-admitted within a class — priority composes with the
+        # legacy newest-first rule instead of replacing it
+        victim = max(victims, key=lambda s: (s.priority,
+                                             s._admit_order))
         # per-lane decision trail for the flight recorder: a post-
         # mortem shows WHY lanes emptied under cache pressure
         flight_recorder.record("sched_preempt", uid=victim.uid,
@@ -215,9 +232,10 @@ class SlotScheduler:
         entry at position context_len - 1; grow its block table (or
         evict cold cache blocks, then preempt, newest first, under
         cache pressure — possibly the needy sequence itself)."""
-        # oldest first: under pressure the newest yield to the oldest
+        # highest class then oldest first: under pressure the newest
+        # and least-important lanes yield to the oldest interactive
         for seq in sorted(self.running(),
-                          key=lambda s: s._admit_order):
+                          key=lambda s: (s.priority, s._admit_order)):
             if seq.slot is None:      # already preempted this round
                 continue
             need = seq.context_len - 1  # position being written

@@ -57,7 +57,16 @@ Endpoints:
                    endpoint submits through the ReplicaRouter's
                    least-loaded admission instead of a single engine;
                    /stats grows per-replica rows
-                   (docs/distributed-serving.md).
+                   (docs/distributed-serving.md).  With
+                   `model_registry=` (serving/control_plane/) the
+                   client's X-Model header (or "model" field) resolves
+                   a registered model through the A/B + shadow
+                   routing policies; X-Tenant keys the per-tenant
+                   quota bucket (429 + Retry-After when over) and SLO
+                   windows.  Both headers are echoed back like
+                   X-Request-Id — X-Model as the RESOLVED
+                   model@version, so an A/B-routed client learns
+                   which arm served it (docs/control-plane.md).
   GET  /healthz  — liveness + records served
   GET  /metrics  — Prometheus text exposition: this server's per-op
                    latency summaries (serving_queue_wait_seconds,
@@ -151,19 +160,29 @@ class ServingServer:
                  result_ttl_s: float = 600.0, max_results: int = 10_000,
                  worker_pool=None, generation_engine=None,
                  router=None, stream_hub=None,
+                 model_registry=None,
                  adaptive_batching: bool = True,
                  adaptive_k: float = 2.0):
         if model is None and worker_pool is None and \
                 generation_engine is None and router is None and \
-                stream_hub is None:
+                stream_hub is None and model_registry is None:
             raise ValueError("need a model, a worker_pool, a "
-                             "generation_engine, a router or a "
-                             "stream_hub")
+                             "generation_engine, a router, a "
+                             "stream_hub or a model_registry")
         if router is not None and generation_engine is not None:
             raise ValueError("pass either generation_engine= or "
                              "router=, not both — the router owns its "
                              "own engine replicas")
+        if model_registry is not None and (
+                generation_engine is not None or router is not None):
+            raise ValueError("pass either model_registry= or a bare "
+                             "generation_engine=/router= — register "
+                             "the engine as a version instead")
         self.model = model
+        #: control-plane front (serving/control_plane/ModelRegistry):
+        #: /generate resolves X-Model through the registry's A/B +
+        #: shadow policies and submits to the serving version's target
+        self.model_registry = model_registry
         #: continuous-batching autoregressive engine behind
         #: POST /generate (serving/generation/); its loop thread is
         #: started/stopped with the server
@@ -185,6 +204,21 @@ class ServingServer:
         self._predict = (worker_pool.predict if worker_pool is not None
                          else model.predict if model is not None
                          else None)   # generation-only server
+        # tenant quota gate on the record-predict doors (/predict,
+        # /enqueue): the worker pool's AdmissionCore when there is one
+        # (so its max_queue bound applies too), else a door-local core
+        # over the shared process ledger.  The generation door charges
+        # inside engine.submit instead — one charge per admitted
+        # request either way (docs/control-plane.md).
+        if worker_pool is not None:
+            self._door_admission = worker_pool.admission
+        elif self._predict is not None:
+            from analytics_zoo_tpu.serving.control_plane.admission import (
+                AdmissionCore,
+            )
+            self._door_admission = AdmissionCore()
+        else:
+            self._door_admission = None
         self.max_batch_size = max_batch_size
         self.batch_timeout_s = batch_timeout_ms / 1e3
         #: adaptive batching deadline (docs/serving-guide.md): the
@@ -290,7 +324,16 @@ class ServingServer:
                 self.send_header("Content-Length", str(len(body)))
                 if request_id is not None:
                     self.send_header("X-Request-Id", request_id)
-                for k, v in (headers or {}).items():
+                hdrs = dict(headers or {})
+                # client-sent model/tenant attribution is echoed back
+                # on every response, same contract as X-Request-Id —
+                # unless the handler resolved a more specific value
+                # (e.g. the A/B-chosen model@version)
+                for h in ("X-Model", "X-Tenant"):
+                    v = self.headers.get(h)
+                    if v and h not in hdrs:
+                        hdrs[h] = v
+                for k, v in hdrs.items():
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
@@ -413,7 +456,9 @@ class ServingServer:
                 flight-recorder bundles.  Error mapping: malformed
                 payload → 400, prompt that can never fit → 413,
                 admission queue full → 503."""
-                eng = (server.router if server.router is not None
+                eng = (server.model_registry
+                       if server.model_registry is not None
+                       else server.router if server.router is not None
                        else server.generation_engine)
                 if eng is None:
                     self._json(404, {"error": "no generation engine "
@@ -422,6 +467,14 @@ class ServingServer:
                 rid = request_log.sanitize_request_id(
                     self.headers.get("X-Request-Id")
                     or request_log.new_request_id())
+                # control-plane attribution (docs/control-plane.md):
+                # X-Model picks the registry entry (A/B + shadow
+                # policies resolve the version), X-Tenant keys the
+                # quota bucket and per-tenant SLO windows; both are
+                # echoed back like X-Request-Id.  JSON fields work too
+                # for header-less clients.
+                model = self.headers.get("X-Model") or None
+                tenant = self.headers.get("X-Tenant") or None
                 # cross-process trace context: a client-sent
                 # traceparent header makes this handler's span (and
                 # everything under it — router dispatch, requeues) a
@@ -436,11 +489,13 @@ class ServingServer:
                         {trace_context.TRACEPARENT_HEADER:
                          tparent.traceparent()}
                         if tparent is not None else None)
-                    if code == 503:
+                    if code in (429, 503):
                         # every shed carries a comeback hint so a
                         # well-behaved client (InputQueue with a
                         # RetryPolicy) backs off by the server's
-                        # estimate instead of hammering the door
+                        # estimate instead of hammering the door —
+                        # 503 from the queue/SLO gates, 429 from a
+                        # tenant quota bucket
                         ra = retry_after_s if retry_after_s else 1.0
                         payload["retry_after_s"] = round(ra, 3)
                         headers = dict(headers or {},
@@ -454,8 +509,12 @@ class ServingServer:
                 except Exception as e:
                     reject(400, f"bad request: {e}")
                     return
+                model = model or req.get("model") or None
+                tenant = tenant or req.get("tenant") or None
                 from analytics_zoo_tpu.serving.errors import (
+                    ModelNotFound,
                     ReplicaStopped,
+                    TenantQuotaExceeded,
                 )
                 from analytics_zoo_tpu.serving.generation.engine import (
                     QueueFull,
@@ -468,18 +527,21 @@ class ServingServer:
                            if tparent is not None else {})
                 with trace("serving.generate", prompt=len(tokens),
                            request_id=rid, **span_kw) as span:
+                    kw = dict(
+                        max_new_tokens=int(req.get("max_new_tokens",
+                                                   32)),
+                        temperature=float(req.get("temperature",
+                                                  0.0)),
+                        top_k=int(req.get("top_k", 0)),
+                        eos_id=(int(req["eos_id"])
+                                if req.get("eos_id") is not None
+                                else None),
+                        request_id=rid,
+                        tenant=tenant)
+                    if server.model_registry is not None:
+                        kw["model"] = model
                     try:
-                        stream = eng.submit(
-                            tokens,
-                            max_new_tokens=int(req.get("max_new_tokens",
-                                                       32)),
-                            temperature=float(req.get("temperature",
-                                                      0.0)),
-                            top_k=int(req.get("top_k", 0)),
-                            eos_id=(int(req["eos_id"])
-                                    if req.get("eos_id") is not None
-                                    else None),
-                            request_id=rid)
+                        stream = eng.submit(tokens, **kw)
                     except RequestTooLarge as e:
                         reject(413, str(e))
                         return
@@ -487,6 +549,18 @@ class ServingServer:
                         reject(503, str(e),
                                retry_after_s=getattr(e, "retry_after_s",
                                                      None))
+                        return
+                    except TenantQuotaExceeded as e:
+                        # taxonomy: over-quota is the TENANT's budget,
+                        # not server pressure — 429, and the router
+                        # must not shop it to another replica (the
+                        # ledger is process-global)
+                        reject(429, str(e),
+                               retry_after_s=getattr(e, "retry_after_s",
+                                                     None))
+                        return
+                    except ModelNotFound as e:
+                        reject(404, str(e))
                         return
                     except ReplicaStopped as e:
                         # taxonomy (serving/errors.py): the router/pool
@@ -505,6 +579,14 @@ class ServingServer:
                                      "application/x-ndjson")
                     self.send_header("Transfer-Encoding", "chunked")
                     self.send_header("X-Request-Id", rid)
+                    # resolved attribution: the registry stamps the
+                    # A/B-chosen model@version on the stream
+                    served_model = getattr(stream, "model_label",
+                                           None) or model
+                    if served_model:
+                        self.send_header("X-Model", served_model)
+                    if tenant:
+                        self.send_header("X-Tenant", tenant)
                     self.send_header(
                         trace_context.TRACEPARENT_HEADER,
                         trace_context.TraceContext(
@@ -677,6 +759,30 @@ class ServingServer:
                     self._json(400, {"error": "this server has no "
                                      "predict model (generation-only)"})
                     return
+                tenant = self.headers.get("X-Tenant") or None
+                if tenant is not None and \
+                        server._door_admission is not None:
+                    # same AdmissionCore as the generation door: the
+                    # tenant bucket is charged ONCE, here at the
+                    # admitting edge — the batcher mixes tenants into
+                    # one device batch, so the charge cannot live there
+                    from analytics_zoo_tpu.serving.errors import (
+                        QueueFull,
+                        TenantQuotaExceeded,
+                    )
+                    try:
+                        server._door_admission.admit(
+                            server._queue.qsize(), tenant=tenant)
+                    except (QueueFull, TenantQuotaExceeded) as e:
+                        from analytics_zoo_tpu.serving.errors import (
+                            http_status_for,
+                        )
+                        ra = getattr(e, "retry_after_s", None) or 1.0
+                        self._json(http_status_for(e),
+                                   {"error": str(e),
+                                    "retry_after_s": round(ra, 3)},
+                                   headers={"Retry-After": f"{ra:.3f}"})
+                        return
                 arrow = (self.headers.get("Content-Type", "")
                          .startswith(ARROW_CONTENT_TYPE))
                 if arrow:
@@ -981,13 +1087,34 @@ class ServingServer:
             # per-stream backlog + per-group lag rows
             # (serving/streaming/stream.py stats)
             out["streams"] = self.stream_hub.stats()
-        if self.generation_engine is not None or self.router is not None:
+        if self.model_registry is not None:
+            # control-plane model table: versions, states, serving
+            # pointer, A/B weights, shadow policy, swap counters
+            out["registry"] = self.model_registry.stats()
+            from analytics_zoo_tpu.observability import (
+                get_shadow_slo_tracker,
+            )
+            # shadow-side SLO judged separately — a slow candidate
+            # never dents the primary attainment below
+            out["shadow"] = get_shadow_slo_tracker().snapshot()
+        from analytics_zoo_tpu.common.context import OrcaContext as _Ctx
+        if _Ctx.tenant_quotas is not None:
+            from analytics_zoo_tpu.serving.control_plane.admission \
+                import get_tenant_ledger
+            # per-tenant admission ledger: quota config, bucket level,
+            # admitted/shed counts (docs/control-plane.md)
+            out["tenants"] = get_tenant_ledger().stats()
+        if (self.generation_engine is not None
+                or self.router is not None
+                or self.model_registry is not None):
             rl = request_log.get_request_log()
             slo = get_slo_tracker().snapshot()
             out["requests"] = {
                 "active": rl.active_count(),
                 "finished_in_ring": rl.finished_count(),
                 "slo_attainment": slo["attainment"],
+                "slo_attainment_by_model": slo["attainment_by_model"],
+                "slo_attainment_by_tenant": slo["attainment_by_tenant"],
                 "slo_targets": slo["targets"],
             }
         from analytics_zoo_tpu.common.context import OrcaContext
@@ -1026,6 +1153,8 @@ class ServingServer:
             self.generation_engine.ensure_started()
         if self.router is not None:
             self.router.ensure_started()
+        if self.model_registry is not None:
+            self.model_registry.ensure_started()
         self._http_started = http
         if http:
             if self._httpd is None:
@@ -1048,6 +1177,8 @@ class ServingServer:
             self.generation_engine.stop()
         if self.router is not None:
             self.router.stop()
+        if self.model_registry is not None:
+            self.model_registry.stop()
         # shutdown() blocks on the serve_forever loop — only valid when
         # that loop actually ran (http=False never builds the listener)
         if self._httpd is not None:

@@ -169,7 +169,18 @@ def predict_consumer(stream: DurableStream, predict_fn: Callable,
                        for x in doc.get("inputs", []))
         if not inputs:
             raise ValueError(f"record {rec.record_id}: no inputs")
-        outs = predict_fn(*inputs)
+        # tenant attribution travels ON the record (client.py stamps
+        # it, like the traceparent) — the leasing process charges the
+        # same bucket a front-door request would, replay included
+        tenant = doc.get("tenant")
+        if tenant is not None:
+            try:
+                outs = predict_fn(*inputs, tenant=str(tenant))
+            except TypeError:
+                # plain predict callable without admission kwargs
+                outs = predict_fn(*inputs)
+        else:
+            outs = predict_fn(*inputs)
         if not isinstance(outs, tuple):
             outs = (outs,)
         return {"uri": doc.get("uri"), "record_id": rec.record_id,
@@ -187,23 +198,35 @@ def generation_consumer(stream: DurableStream, engine,
                         consumer: str = "generate-0",
                         **kw) -> StreamConsumer:
     """Token-generation group member over `engine` (a
-    GenerationEngine OR a ReplicaRouter — both expose ``submit``).
-    Record docs: ``{"uri", "tokens", "max_new_tokens", "temperature",
-    "top_k", "eos_id"}``.  The request id is derived from the RECORD
+    GenerationEngine, a ReplicaRouter or a control-plane
+    ModelRegistry — all expose ``submit``).  Record docs: ``{"uri",
+    "tokens", "max_new_tokens", "temperature", "top_k", "eos_id"}``
+    plus optional ``"model"`` (registry routing) and ``"tenant"``
+    (quota + SLO attribution) fields, stamped by the client like the
+    traceparent.  The request id is derived from the RECORD
     id, so a replayed record re-enters the engine under the same
     lifecycle trail — composing with the router's own mid-stream
     death requeue (docs/distributed-serving.md)."""
 
     def handle(doc: Dict[str, Any], rec) -> Dict[str, Any]:
         rid = f"strm-{stream.name}-{rec.record_id}"
-        gen = engine.submit(
-            [int(t) for t in doc["tokens"]],
+        kw: Dict[str, Any] = dict(
             max_new_tokens=int(doc.get("max_new_tokens", 32)),
             temperature=float(doc.get("temperature", 0.0)),
             top_k=int(doc.get("top_k", 0)),
             eos_id=(int(doc["eos_id"])
                     if doc.get("eos_id") is not None else None),
             request_id=rid)
+        # control-plane attribution rides the record document (the
+        # same idiom as the traceparent field): the leasing process —
+        # engine, router or registry — charges the tenant's bucket
+        # and routes the named model, replay included
+        if doc.get("tenant") is not None:
+            kw["tenant"] = str(doc["tenant"])
+        if doc.get("model") is not None and hasattr(engine, "set_ab"):
+            # only a ModelRegistry target routes by name
+            kw["model"] = str(doc["model"])
+        gen = engine.submit([int(t) for t in doc["tokens"]], **kw)
         rid = getattr(gen, "request_id", None) or rid
         request_log.event(rid, "stream_lease",
                           stream=stream.name,

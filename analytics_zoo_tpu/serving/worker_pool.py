@@ -155,7 +155,8 @@ class WorkerPool:
                  decrypt_key_env: Optional[str] = None,
                  worker_env: Optional[Dict[str, str]] = None,
                  max_batch_size: int = 256,
-                 model_parallelism: int = 1):
+                 model_parallelism: int = 1,
+                 max_queue: Optional[int] = None):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
         self.n_workers = n_workers
@@ -193,6 +194,26 @@ class WorkerPool:
         self._c_respawns = reg.counter(
             "serving_worker_respawns_total",
             help="replica processes respawned after dying mid-predict")
+        # the pool's door is the same unified AdmissionCore that
+        # fronts the generation engine (serving/control_plane/
+        # admission.py): `max_queue` bounds the batches blocked on
+        # checkout (None = unbounded, the legacy behavior) and tenant
+        # quotas charge here too — the pool carries NO shed logic of
+        # its own
+        from analytics_zoo_tpu.serving.control_plane.admission import (
+            AdmissionCore,
+        )
+        self._waiting = 0
+        self.admission = AdmissionCore(max_queue=max_queue,
+                                       retry_after=self._retry_after)
+
+    def _retry_after(self) -> float:
+        """Shed-response backoff hint: the measured mean checkout wait
+        (0.5s before any batch has waited), clamped to [0.05s, 10s]."""
+        h = self._h_checkout
+        if h.calls:
+            return float(min(10.0, max(0.05, h.total / h.calls)))
+        return 0.5
 
     @property
     def records_served(self) -> int:
@@ -208,11 +229,26 @@ class WorkerPool:
         """busy / n_workers in [0, 1]."""
         return self.busy_workers / max(self.n_workers, 1)
 
-    def predict(self, *inputs) -> Any:
+    def predict(self, *inputs, tenant: Optional[str] = None,
+                request_class: str = "interactive") -> Any:
         import numpy as np
         arrays = tuple(np.asarray(a) for a in inputs)
-        with self._h_checkout.time():
-            w = self._free.get()
+        # one admission decision (queue bound + fault site + tenant
+        # quota) BEFORE blocking on checkout: a shed request never
+        # occupies a waiter slot.  Raises QueueFull (503) /
+        # TenantQuotaExceeded (429); the HTTP layer maps both.
+        with self._served_lock:
+            depth = self._waiting
+        self.admission.admit(depth, tenant=tenant,
+                             request_class=request_class)
+        with self._served_lock:
+            self._waiting += 1
+        try:
+            with self._h_checkout.time():
+                w = self._free.get()
+        finally:
+            with self._served_lock:
+                self._waiting -= 1
         with self._served_lock:
             self._busy += 1
         try:

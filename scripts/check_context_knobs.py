@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Lint: every `OrcaContext` knob is documented in the knob index of
+docs/control-plane.md, and every documented knob still exists — in
+BOTH directions (the same contract scripts/check_metric_names.py and
+scripts/check_fault_sites.py enforce for metrics and fault sites).
+
+A knob is a class-level property WITH a setter on `OrcaContextMeta`
+(common/context.py) — that is the definition of "user-settable global
+config" in this codebase; the read-only runtime properties (``mesh``,
+``cluster_mode``, ``initialized``, ``num_devices``, ``devices``) are
+state, not knobs, and are excluded by the no-setter rule.
+
+An undocumented knob is config nobody can discover without reading
+source; a documented knob that no longer exists is worse — an
+operator sets it, the metaclass property lookup fails or (plain
+attribute assignment) silently does nothing, and they conclude the
+feature is on.  Two checks close the loop statically:
+
+1. every settable `OrcaContextMeta` property appears as a backticked
+   row in the '## OrcaContext knob index' table of
+   docs/control-plane.md;
+2. every knob documented there exists as a settable property.
+
+Run directly (`python scripts/check_context_knobs.py`) or via the
+tier-1 wrapper `tests/test_context_knobs.py`.  Exit code 0 = clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONTEXT = os.path.join(REPO, "analytics_zoo_tpu", "common",
+                       "context.py")
+DOCS = os.path.join(REPO, "docs", "control-plane.md")
+
+#: a knob name: lowercase identifier (matches the property names)
+KNOB = re.compile(r"^[a-z][a-z0-9_]*$")
+
+#: the docs section holding the knob table
+SECTION = "## OrcaContext knob index"
+
+
+def context_knobs(context_text=None):
+    """Settable properties of OrcaContextMeta, parsed from source
+    (not imported: the lint must run without jax et al)."""
+    if context_text is None:
+        with open(CONTEXT, encoding="utf-8") as f:
+            context_text = f.read()
+    tree = ast.parse(context_text)
+    meta = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and \
+                node.name == "OrcaContextMeta":
+            meta = node
+            break
+    if meta is None:
+        raise AssertionError(
+            "OrcaContextMeta class not found in common/context.py")
+    props, setters = set(), set()
+    for node in meta.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Name) and dec.id == "property":
+                props.add(node.name)
+            elif isinstance(dec, ast.Attribute) and \
+                    dec.attr == "setter":
+                setters.add(node.name)
+    return sorted(props & setters)
+
+
+def documented_knobs(docs_text=None):
+    """Backticked knob tokens from the first cell of the knob-index
+    table rows (the table inside the '## OrcaContext knob index'
+    section of docs/control-plane.md)."""
+    if docs_text is None:
+        with open(DOCS, encoding="utf-8") as f:
+            docs_text = f.read()
+    in_section = False
+    knobs = []
+    for line in docs_text.splitlines():
+        if line.startswith("## "):
+            in_section = line.startswith(SECTION)
+            continue
+        if not (in_section and line.lstrip().startswith("|")):
+            continue
+        cells = line.split("|")
+        if len(cells) < 2:
+            continue
+        for tok in re.findall(r"`([^`]+)`", cells[1]):
+            if KNOB.match(tok):
+                knobs.append(tok)
+    return sorted(set(knobs))
+
+
+def find_violations():
+    knobs = set(context_knobs())
+    documented = set(documented_knobs())
+    violations = []
+    for name in sorted(knobs - documented):
+        violations.append(
+            f"OrcaContext knob {name!r} missing from the "
+            f"'{SECTION}' table in docs/control-plane.md")
+    for name in sorted(documented - knobs):
+        violations.append(
+            f"docs/control-plane.md documents knob {name!r} that is "
+            f"not a settable OrcaContextMeta property")
+    return violations
+
+
+def main() -> int:
+    violations = find_violations()
+    if not violations:
+        print("check_context_knobs: clean "
+              f"({len(context_knobs())} knobs)")
+        return 0
+    print("check_context_knobs: knob registry / docs disagree:",
+          file=sys.stderr)
+    for v in violations:
+        print(f"  {v}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Tier-1 wiring for scripts/check_context_knobs.py: the build goes
+red when an `OrcaContext` knob (a settable `OrcaContextMeta`
+property) is missing from the knob index table in
+docs/control-plane.md, or the docs list a knob that no longer exists
+— the two-direction contract check_metric_names / check_fault_sites
+enforce for metrics and fault sites, applied to config."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_context_knobs.py")
+
+
+def _load():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("azt_knob_lint",
+                                                  SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_context_knobs_documented():
+    proc = subprocess.run([sys.executable, SCRIPT],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        "OrcaContext knob registry / docs drifted:\n" + proc.stderr)
+
+
+def test_lint_parses_the_live_tree():
+    """Knob extraction matches the runtime class: every extracted
+    knob is settable on OrcaContext, the read-only runtime
+    properties are excluded, and the live tree is clean."""
+    mod = _load()
+    assert mod.find_violations() == []
+    knobs = mod.context_knobs()
+    # the control-plane knobs of this PR are knobs; runtime state
+    # (no setter) is not
+    for name in ("tenant_quotas", "slo_targets",
+                 "slo_shed_attainment", "fault_plan"):
+        assert name in knobs
+    for name in ("mesh", "cluster_mode", "initialized",
+                 "num_devices", "devices"):
+        assert name not in knobs
+    from analytics_zoo_tpu.common.context import OrcaContextMeta
+
+    for name in knobs:
+        prop = getattr(OrcaContextMeta, name, None)
+        assert isinstance(prop, property), name
+        assert prop.fset is not None, name
+
+
+def test_lint_detects_each_direction():
+    """Synthetic drift in both directions is caught, and parsing is
+    source-level (no package import)."""
+    mod = _load()
+    src = (
+        "class OrcaContextMeta(type):\n"
+        "    @property\n"
+        "    def a_knob(cls):\n"
+        "        return 1\n"
+        "    @a_knob.setter\n"
+        "    def a_knob(cls, v):\n"
+        "        pass\n"
+        "    @property\n"
+        "    def read_only(cls):\n"
+        "        return 2\n")
+    assert mod.context_knobs(src) == ["a_knob"]
+    docs = ("## OrcaContext knob index\n"
+            "| knob | default | read by |\n"
+            "|---|---|---|\n"
+            "| `a_knob` / `dead_knob` | 1 | here (`not_a_cell1_tok` "
+            "in cell 2 is ignored) |\n"
+            "## Next section\n"
+            "| `other` | ignored | too |\n")
+    assert mod.documented_knobs(docs) == ["a_knob", "dead_knob"]
