@@ -69,6 +69,8 @@ class OrcaContextMeta(type):
     _slo_shed_attainment = None
     _prefix_caching = False
     _chunked_prefill = False
+    _speculative_decoding = False
+    _speculative_k = 4
     _host_input_prefetch = 2
     _decode_tensor_parallel = 0
     _serving_replicas = 0
@@ -516,6 +518,47 @@ class OrcaContextMeta(type):
     @chunked_prefill.setter
     def chunked_prefill(cls, value):
         cls._chunked_prefill = bool(value)
+
+    @property
+    def speculative_decoding(cls):
+        """Draft-free speculative decoding in the generation engine
+        (serving/generation/speculation.py; docs/generation.md).
+        False (default) keeps the decode loop bitwise untouched: one
+        token per jitted step per lane.  True: greedy lanes propose up
+        to `speculative_k` continuation tokens per round via n-gram
+        prompt lookup over their own token history, ONE verify step
+        scores them all (the chunk-step ctx-read shape), and the
+        longest prefix matching the model's greedy argmax is accepted
+        — plus the bonus token the verify logits yield for free.
+        Accepted tokens equal what single-step greedy would emit, so
+        output streams are identical either way; rejected drafts
+        rewind through the refcounted block allocator at free-list
+        cost.  Read at engine construction
+        (`GenerationEngine(speculative_decoding=...)` overrides)."""
+        return cls._speculative_decoding
+
+    @speculative_decoding.setter
+    def speculative_decoding(cls, value):
+        cls._speculative_decoding = bool(value)
+
+    @property
+    def speculative_k(cls):
+        """Max drafted tokens per lane per speculative-decoding round
+        (default 4; used only while `speculative_decoding` is on).
+        Verify programs compile per pow2 draft-length bucket, so k
+        adds O(log k) compiled families next to the single decode
+        family — the zero-recompile contract holds with speculation
+        armed.  Read at engine construction
+        (`GenerationEngine(speculative_k=...)` overrides)."""
+        return cls._speculative_k
+
+    @speculative_k.setter
+    def speculative_k(cls, value):
+        value = int(value)
+        if value < 1:
+            raise ValueError(
+                f"speculative_k must be >= 1, got {value}")
+        cls._speculative_k = value
 
     @property
     def decode_tensor_parallel(cls):

@@ -30,8 +30,14 @@ Boundedness: finished records live in a ring of
 kept), and decode rounds are sampled at powers of two (rounds 1, 2, 4,
 8, ...) so a 10k-token generation stores O(log n) events while
 `n_rounds` / `n_tokens` stay exact.  Invariants the tests pin: event
-timestamps are monotone per record, `ttft <= e2e`, `n_rounds >=
-n_tokens`, and a preempted-then-resumed request keeps ONE id.
+timestamps are monotone per record, `ttft <= e2e`, and a
+preempted-then-resumed request keeps ONE id.  Without speculative
+decoding `n_rounds >= n_tokens`; a speculative verify round
+(engine.py `_spec_round`) counts as ONE round but can emit up to k+1
+accepted tokens (its `spec_propose`/`spec_accept` events are
+pow2-sampled like decode rounds), so under
+`OrcaContext.speculative_decoding` that inequality deliberately
+flips.
 
 Everything here is observability: the hot-loop entry points
 (`event`/`decode_round`/`token`/`finish`) never raise into the engine.

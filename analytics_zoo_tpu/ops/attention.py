@@ -13,6 +13,14 @@ engine routes through (enforced by scripts/check_kernel_dispatch.py),
 picking the Pallas paged kernel (block-table gather inside the kernel,
 ops/pallas/paged_attention.py) on TPU and an XLA fallback that
 bit-matches the gather+concat-attend path everywhere else.
+
+`paged_verify_attention` is its q_len>1 sibling for speculative
+decoding's verify step (serving/generation/speculation.py): each lane's
+pending token plus its k drafted tokens attend causally over the lane's
+paged context in one call.  It reuses the decode path's XLA fallback
+(block-table gather + the `dot_product_attention` ctx read path) on
+every backend today — the dedicated q_len>1 Pallas kernel is future
+TPU-round work, and the gather path is what the CPU parity tests pin.
 """
 
 from __future__ import annotations
@@ -168,3 +176,46 @@ def paged_decode_attention(q, new_k, new_v, k_pool, v_pool,
         q, new_k, new_v, k_pool, v_pool, block_tables, ctx_len,
         k_scale=k_scale, v_scale=v_scale, block_gather=block_gather,
         interpret=interpret)
+
+
+def paged_verify_attention(q, new_k, new_v, k_pool, v_pool,
+                           block_tables, ctx_len, *, k_scale=None,
+                           v_scale=None, impl: str = "auto",
+                           compute_dtype=jnp.float32):
+    """Verify-step attention of q_len>1 new tokens per lane over its
+    paged KV cache — speculative decoding's scoring pass
+    (serving/generation/speculation.py; docs/generation.md).
+
+    q / new_k / new_v: [S, T, heads, head_dim] — lane s's pending token
+    followed by its T-1 drafted tokens at absolute positions
+    ctx_len[s]..ctx_len[s]+T-1; they attend causally over
+    [cached context ; themselves], exactly the chunk-prefill read
+    semantics (`dot_product_attention`'s ctx path).
+    k_pool / v_pool / block_tables / ctx_len / k_scale / v_scale: as in
+    `paged_decode_attention`.  Returns [S, T, heads, head_dim] float32.
+
+    impl: "auto" | "pallas" | "xla" — all three currently run the XLA
+    gather path (the decode fallback generalized to T queries); a
+    dedicated q_len>1 Pallas verify kernel is future TPU-round work,
+    so engines pinned to `paged_attention_impl="pallas"` verify
+    through the same fallback their CPU parity tests exercise."""
+    s, t, h, d = q.shape
+    nb, bs = k_pool.shape[:2]
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown paged_verify_attention impl "
+                         f"{impl!r}; use 'auto', 'pallas' or 'xla'")
+    flat_k = k_pool.reshape(nb * bs, h, d)
+    flat_v = v_pool.reshape(nb * bs, h, d)
+    fk_scale = (None if k_scale is None
+                else k_scale.reshape(nb * bs).astype(jnp.float32))
+    fv_scale = (None if v_scale is None
+                else v_scale.reshape(nb * bs).astype(jnp.float32))
+    tok_idx = (block_tables[:, :, None] * bs
+               + jnp.arange(bs)[None, None, :]).reshape(s, -1)
+    return dot_product_attention(
+        q, new_k, new_v, compute_dtype=compute_dtype,
+        ctx_k=_paged_dequant(flat_k, fk_scale, tok_idx),
+        ctx_v=_paged_dequant(flat_v, fv_scale, tok_idx),
+        ctx_len=ctx_len)

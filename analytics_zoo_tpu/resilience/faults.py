@@ -35,6 +35,10 @@ site                        threaded into
                             retry budget, not escape it)
 ``generation.decode``       engine decode round, before dispatch
 ``generation.prefix_lookup`` prefix-cache radix lookup on admission
+``generation.spec_verify``  speculative verify step, before dispatch
+                            (a raise evicts nothing — the drafted
+                            lanes fall back to single-token decode
+                            for that round)
 ``serving.admission``       AdmissionCore queue/SLO check (every door)
 ``admission.quota``         AdmissionCore per-tenant quota charge
 ``registry.swap``           ModelRegistry.hot_swap, before repointing
@@ -85,6 +89,7 @@ KNOWN_SITES = (
     "checkpoint.before_rename", "checkpoint.before_commit",
     "checkpoint.after_commit", "checkpoint.load",
     "generation.decode", "generation.prefix_lookup",
+    "generation.spec_verify",
     "serving.admission", "admission.quota", "registry.swap",
     "router.dispatch",
     "stream.append", "stream.fsync", "stream.lease", "stream.ack",

@@ -22,6 +22,10 @@ subsystem:
 * `CausalLM` — a GPT-style decoder on
   `ops.attention.dot_product_attention`'s KV-cache read path
   (model.py), with greedy/temperature/top-k sampling (sampling.py).
+* `Speculator` / `ngram_draft` — draft-free speculative decoding:
+  n-gram prompt-lookup proposals verified k-at-a-time by one compiled
+  step, accepted-prefix emission, free-list rollback (speculation.py;
+  `OrcaContext.speculative_decoding`).
 * `GenerationEngine` — the decode loop tying them together: bucketed
   prefill + ONE static-shape decode step (zero recompiles after
   warmup), token streaming, tokens/sec + cache-occupancy metrics
@@ -54,9 +58,14 @@ from analytics_zoo_tpu.serving.generation.scheduler import (  # noqa: F401
     Sequence,
     SlotScheduler,
 )
+from analytics_zoo_tpu.serving.generation.speculation import (  # noqa: F401,E501
+    SpecState,
+    Speculator,
+    ngram_draft,
+)
 
 __all__ = ["BlockAllocator", "CausalLM", "GenerationEngine",
            "GenerationStream", "PagedKVCache", "PrefixCache",
            "QueueFull", "RequestTooLarge", "Sequence", "SlotScheduler",
-           "dequantize_kv_tokens", "quantize_kv_tokens",
-           "sample_tokens"]
+           "SpecState", "Speculator", "dequantize_kv_tokens",
+           "ngram_draft", "quantize_kv_tokens", "sample_tokens"]

@@ -37,6 +37,22 @@ running lane.  The radix tree, refcounted block sharing and
 copy-on-write live in prefix_cache.py + scheduler.py; the decode
 program is identical in every mode, so the zero-recompile contract
 survives with everything armed.
+
+Speculative decoding (`OrcaContext.speculative_decoding` +
+`speculative_k`, default off → the decode path is bitwise untouched):
+greedy lanes draft up to k continuation tokens from their own token
+history (speculation.py's n-gram prompt lookup), and ONE spec-verify
+step — a fixed [max_slots, 1+bucket] grid per pow2 k-bucket, the
+chunk step's ctx-read shape over the pool — scores every drafted lane
+at once, writing draft KV into freshly allocated blocks and taking
+greedy argmax at every position.  The longest draft prefix matching
+argmax is accepted plus the bonus token the verify logits yield for
+free (1..k+1 tokens per lane per round); rejected tail blocks decref
+straight back through the allocator (`rollback_speculation`) and the
+non-drafting lanes run the unchanged decode step.  Verify tokens
+charge the same per-round `prefill_token_budget` chunked prefill
+spends, and the verify families are warmed in `warmup()` alongside
+decode — zero recompiles with speculation armed.
 """
 
 from __future__ import annotations
@@ -44,7 +60,7 @@ from __future__ import annotations
 import queue
 import threading
 from functools import partial
-from typing import List, Optional, Sequence as Seq
+from typing import List, Optional, Sequence as Seq, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -67,9 +83,11 @@ from analytics_zoo_tpu.serving.generation.kv_cache import (
     quantize_kv_tokens,
 )
 from analytics_zoo_tpu.resilience.faults import (
+    FaultInjected,
     PoisonedRequestError,
     fault_point,
 )
+from analytics_zoo_tpu.serving.generation.speculation import Speculator
 from analytics_zoo_tpu.serving.generation.prefix_cache import PrefixCache
 from analytics_zoo_tpu.serving.generation.sampling import sample_tokens
 from analytics_zoo_tpu.serving.generation.scheduler import (
@@ -151,7 +169,8 @@ class GenerationEngine:
                  decode_attention: str = "paged",
                  slo_shed_min_queue: Optional[int] = None,
                  prefix_caching="auto", chunked_prefill="auto",
-                 tensor_parallel="auto"):
+                 tensor_parallel="auto", speculative_decoding="auto",
+                 speculative_k="auto"):
         if model.max_position_len < max_context:
             raise ValueError(
                 f"model.max_position_len {model.max_position_len} < "
@@ -207,6 +226,16 @@ class GenerationEngine:
         #: ctx-aware prefill program); both off keeps the legacy
         #: whole-prompt prefill path untouched
         self._use_chunks = self.prefix_caching or self.chunked_prefill
+        #: draft-free speculative decoding (speculation.py) — "auto"
+        #: reads OrcaContext.speculative_decoding; off (the default)
+        #: keeps the decode loop bitwise untouched
+        if speculative_decoding == "auto":
+            speculative_decoding = OrcaContext.speculative_decoding
+        if speculative_k == "auto":
+            speculative_k = OrcaContext.speculative_k
+        self.speculative_decoding = bool(speculative_decoding)
+        self.speculation = (Speculator(int(speculative_k))
+                            if self.speculative_decoding else None)
         if num_blocks is None:
             # comfortable default: every lane can hold a full context
             num_blocks = max_slots * (-(-max_context // block_size)) + 1
@@ -326,6 +355,27 @@ class GenerationEngine:
             help="shared blocks copy-on-write un-shared before a "
                  "decode write (0 in normal operation — see "
                  "prefix_cache.py)") if self.prefix_caching else None)
+        if self.speculation is not None:
+            self._c_spec_proposed = reg.counter(
+                "speculation_proposed_total",
+                help="drafted tokens fed to the spec-verify step")
+            self._c_spec_accepted = reg.counter(
+                "speculation_accepted_total",
+                help="drafted tokens accepted (argmax-matched); the "
+                     "free bonus tokens are NOT counted here")
+            self._c_spec_rounds = reg.counter(
+                "speculation_rounds_total",
+                help="per-lane verify rounds (one lane scored once)")
+            reg.gauge(
+                "speculation_acceptance_rate",
+                fn=lambda: (self._c_spec_accepted.value
+                            / self._c_spec_proposed.value
+                            if self._c_spec_proposed.value else 0.0),
+                help="accepted / proposed drafted tokens, lifetime")
+            self._h_spec_accepted = reg.histogram(
+                "speculation_accepted_length",
+                help="accepted draft length per lane verify round "
+                     "(one record per round; 0 = fully rejected)")
         #: KV-pool occupancy rides the memory-telemetry track too, so
         #: the timeline draws cache pressure under the request slices
         memory.register_provider("kv_pool", self._kv_pool_stats)
@@ -334,6 +384,11 @@ class GenerationEngine:
         #: the token vector), so every iteration is fully accounted
         self._clock_prefill = step_clock("generation_prefill")
         self._clock_decode = step_clock("generation_decode")
+        #: speculative verify rounds get their own goodput track, so
+        #: the Perfetto timeline shows them as distinct slices next to
+        #: generation_decode (docs/observability.md)
+        self._clock_spec = (step_clock("generation_spec_verify")
+                            if self.speculation is not None else None)
         #: stall watchdog (opt-in via OrcaContext.watchdog_deadline_s):
         #: armed while the engine has work, beaten once per scheduling
         #: round — a wedged decode dispatch dumps a flight bundle
@@ -514,6 +569,59 @@ class GenerationEngine:
             nxt = sample_tokens(last[None], rng, temperature, top_k)[0]
             return kv, kv_scale, nxt, last
 
+        def spec_verify(params, kv, kv_scale, tokens, block_tables,
+                        start, length, active):
+            # speculative verify over the whole slot grid: tokens
+            # [S, W] = each drafted lane's [pending token ; draft ;
+            # pad], start [S] = context tokens whose KV is already
+            # written (= context_len - 1), length [S] = 1 + real draft
+            # tokens, active [S].  Every position attends over the
+            # lane's pool context plus the preceding new tokens (the
+            # chunk step's ctx-read semantics, batched over lanes —
+            # ops.attention.paged_verify_attention), writes its KV
+            # into the lane's (pre-grown) block slots, and the host
+            # accepts the longest draft prefix matching the returned
+            # per-position greedy argmax.  Speculation is greedy-only,
+            # so no rng/temperature ride in.
+            S, W = tokens.shape
+            rel = jnp.arange(W)
+            pos = jnp.minimum(start[:, None] + rel[None], max_pos - 1)
+            if paged:
+                kvp = kv.reshape(kv.shape[0], 2, nb, bs,
+                                 *kv.shape[-2:])
+                scl = (kv_scale.reshape(kv.shape[0], 2, nb, bs)
+                       if quantized else None)
+                logits, new_k, new_v = model.apply(
+                    {"params": params}, tokens, pos,
+                    kv_pool=kvp, kv_scale=scl,
+                    block_tables=block_tables, ctx_len=start)
+            else:
+                tok_idx = (block_tables[:, :, None] * bs
+                           + jnp.arange(bs)[None, None, :]
+                           ).reshape(S, -1)
+                ctx_k = kv[:, 0][:, tok_idx]
+                ctx_v = kv[:, 1][:, tok_idx]
+                if quantized:
+                    ctx_k = dequantize_kv_tokens(
+                        ctx_k, kv_scale[:, 0][:, tok_idx])
+                    ctx_v = dequantize_kv_tokens(
+                        ctx_v, kv_scale[:, 1][:, tok_idx])
+                logits, new_k, new_v = model.apply(
+                    {"params": params}, tokens, pos,
+                    ctx_k=ctx_k, ctx_v=ctx_v, ctx_len=start)
+            abs_pos = start[:, None] + rel[None]        # [S, W]
+            dest = block_tables[jnp.arange(S)[:, None],
+                                abs_pos // bs] * bs + abs_pos % bs
+            dest = jnp.where((rel[None] < length[:, None])
+                             & active[:, None], dest, 0).reshape(-1)
+            L = new_k.shape[0]
+            kv, kv_scale = write_kv(
+                kv, kv_scale, dest,
+                new_k.reshape(L, S * W, *new_k.shape[-2:]),
+                new_v.reshape(L, S * W, *new_v.shape[-2:]))
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return kv, kv_scale, greedy
+
         def copy_block(kv, kv_scale, src, dst):
             # copy-on-write: duplicate one pool block's token slots
             # (and their dequant scales) so a shared block becomes
@@ -540,6 +648,7 @@ class GenerationEngine:
             self._copy_block_jit = self._tp.jit_step(
                 copy_block, ((0, 1) if donate else ()), 2)
             self._decode_jit = self._tp.jit_step(decode, donate, 4)
+            self._spec_jit = self._tp.jit_step(spec_verify, donate, 3)
         else:
             self._prefill_jit = jax.jit(prefill, donate_argnums=donate)
             self._chunk_jit = jax.jit(chunk_prefill,
@@ -548,6 +657,8 @@ class GenerationEngine:
                 copy_block,
                 donate_argnums=((0, 1) if donate else ()))
             self._decode_jit = jax.jit(decode, donate_argnums=donate)
+            self._spec_jit = jax.jit(spec_verify,
+                                     donate_argnums=donate)
 
     def _store_kv_state(self, kv, kv_scale) -> None:
         self.cache.kv = kv
@@ -561,6 +672,17 @@ class GenerationEngine:
         forever after — the zero-recompile guarantee; -1 when the jit
         cache API is unavailable)."""
         size = getattr(self._decode_jit, "_cache_size", None)
+        return size() if size is not None else -1
+
+    @property
+    def spec_verify_compile_count(self) -> int:
+        """Compiled variants of the speculative verify step — one per
+        pow2 k-bucket, all warmed in `warmup()`, fixed forever after
+        (the speculation half of the zero-recompile guarantee; 0 with
+        speculation off, -1 when the jit cache API is unavailable)."""
+        if self.speculation is None:
+            return 0
+        size = getattr(self._spec_jit, "_cache_size", None)
         return size() if size is not None else -1
 
     def warmup(self) -> None:
@@ -605,6 +727,18 @@ class GenerationEngine:
                 jnp.zeros(S, bool), jnp.zeros(S, jnp.float32),
                 jnp.zeros(S, jnp.int32), self._rng)
             self._store_kv_state(kv, scl)
+            if self.speculation is not None:
+                # every verify k-bucket compiles here too (inactive
+                # grid: all writes land in the null block)
+                for b in self.speculation.buckets:
+                    kv, scl, _ = self._spec_jit(
+                        self.params, self.cache.kv, self._kv_scale,
+                        jnp.zeros((S, 1 + b), jnp.int32),
+                        jnp.zeros((S, MB), jnp.int32),
+                        jnp.zeros(S, jnp.int32),
+                        jnp.zeros(S, jnp.int32), jnp.zeros(S, bool))
+                    self._store_kv_state(kv, scl)
+                    self._goodput_warm.add(("spec", b))
             # everything above compiled here: live traffic is warm
             self._goodput_warm.add("decode")
             if self._use_chunks:
@@ -778,14 +912,16 @@ class GenerationEngine:
     # chunked / prefix-cached prefill (the chunk-step path)
     # ------------------------------------------------------------------
 
-    def _prefill_round(self) -> bool:
+    def _prefill_round(self) -> Tuple[bool, int]:
         """Spend this round's prefill token budget on the lanes still
         prefilling (admit order).  Non-chunked mode covers a lane's
         whole remaining tail in one chunk; chunked mode caps chunks at
         `_chunk_cap` tokens so a long prompt yields to the decode step
         between chunks.  The head chunk always proceeds (no
         starvation), budget charges at bucket granularity like
-        admission always has."""
+        admission always has.  Returns (did work, leftover budget) —
+        the leftover is what the speculation round may spend on verify
+        tokens (same per-round account)."""
         did = False
         budget = self.scheduler.prefill_token_budget
         first = True
@@ -796,14 +932,14 @@ class GenerationEngine:
                        if self.chunked_prefill else remaining)
                 bucket = self.scheduler.bucket_for(cap)
                 if not first and bucket > budget:
-                    return did
+                    return did, 0
                 self._prefill_chunk(seq, bucket)
                 did = True
                 first = False
                 budget -= bucket
                 if budget <= 0 and seq.status == "prefilling":
-                    return did
-        return did
+                    return did, 0
+        return did, max(0, budget)
 
     def _prefill_chunk(self, seq: Sequence, bucket: int) -> None:
         """Run one chunk-prefill step: write KV for the next
@@ -865,7 +1001,148 @@ class GenerationEngine:
             if self._c_cow is not None:
                 self._c_cow.inc()
 
-    def _decode_all(self) -> None:
+    def _spec_round(self, budget: int) -> set:
+        """One speculative-decoding pass over the running lanes: draft
+        (greedy lanes, cooldown elapsed, n-gram match found), grow each
+        drafted lane's block table to cover its draft, score all
+        drafted lanes in ONE spec-verify dispatch, emit each lane's
+        accepted prefix plus the bonus token, and rewind (rollback the
+        over-allocated blocks).  Verify tokens charge `budget` (the
+        prefill round's leftover token budget) at bucket granularity.
+
+        Every OTHER greedy running lane rides the same dispatch as a
+        length-1 row — its position-0 argmax IS its decode token (the
+        block for that write exists: `ensure_decode_capacity` ran), so
+        a verify round REPLACES the decode round for greedy lanes
+        instead of adding a second dispatch to it.  That 1:1
+        substitution is what bounds the adversarial case: a round
+        whose every draft gets rejected costs one slightly wider
+        dispatch, not two dispatches (the bench's <= 1.1x gate).
+
+        Returns the lanes that already advanced this round — `step()`
+        excludes them from the decode step (sampling lanes never ride:
+        verify is argmax-only)."""
+        done: set = set()
+        spec = self.speculation
+        drafted = []                  # (seq, state, draft)
+        for seq in self.scheduler.running():
+            if seq.temperature > 0:
+                continue              # greedy lanes only
+            st = spec.state(seq)
+            if st.cooldown > 0:
+                st.cooldown -= 1
+                continue
+            draft = spec.draft_for(seq)
+            if not draft:
+                continue
+            bucket = spec.bucket_for(len(draft))
+            if 1 + bucket > budget:
+                continue              # out of this round's budget
+            if not self.scheduler.grow_for_speculation(
+                    seq, seq.context_len - 1 + len(draft)):
+                continue              # pool too tight: decode normally
+            budget -= 1 + bucket
+            drafted.append((seq, st, draft))
+        if not drafted:
+            return done
+        in_grid = {seq for seq, _st, _d in drafted}
+        riders = [seq for seq in self.scheduler.running()
+                  if seq.temperature <= 0 and seq not in in_grid]
+        rec = self._clock_spec.begin(force_fence=True)
+        S = self.max_slots
+        MB = self.scheduler.max_blocks_per_seq
+        W = 1 + spec.bucket_for(max(len(d) for _, _, d in drafted))
+        tokens = np.zeros((S, W), np.int32)
+        tables = np.zeros((S, MB), np.int32)
+        start = np.zeros(S, np.int32)
+        length = np.zeros(S, np.int32)
+        active = np.zeros(S, bool)
+        for seq, _st, draft in drafted:
+            i = seq.slot
+            tokens[i, 0] = seq.generated[-1] if seq.generated \
+                else seq.prompt[-1]
+            tokens[i, 1:1 + len(draft)] = draft
+            tables[i, :len(seq.block_table)] = seq.block_table
+            start[i] = seq.context_len - 1
+            length[i] = 1 + len(draft)
+            active[i] = True
+        for seq in riders:            # length-1 rows: draft-free decode
+            i = seq.slot
+            tokens[i, 0] = seq.generated[-1] if seq.generated \
+                else seq.prompt[-1]
+            tables[i, :len(seq.block_table)] = seq.block_table
+            start[i] = seq.context_len - 1
+            length[i] = 1
+            active[i] = True
+        rec.lap("host_input")
+        try:
+            # fault site: an injected raise costs exactly one round's
+            # speculation — nothing was emitted or written yet, so the
+            # drafted lanes just rejoin the normal decode step (after
+            # rewinding the blocks grown above); nothing is evicted
+            fault_point("generation.spec_verify",
+                        request_ids=[s.request_id
+                                     for s, _, _ in drafted]
+                        + [s.request_id for s in riders])
+        except FaultInjected:
+            for seq, _st, _draft in drafted:
+                self.scheduler.rollback_speculation(seq)
+            rec.end()
+            return done
+        t0 = now()
+        rec.cold = ("spec", W - 1) not in self._goodput_warm
+        kv, scl, greedy = self._spec_jit(
+            self.params, self.cache.kv, self._kv_scale,
+            jnp.asarray(tokens), jnp.asarray(tables),
+            jnp.asarray(start), jnp.asarray(length),
+            jnp.asarray(active))
+        self._store_kv_state(kv, scl)
+        rec.lap(None)
+        greedy = np.asarray(greedy)   # token fetch = device fence
+        rec.lap("device_compute")
+        self._goodput_warm.add(("spec", W - 1))
+        self._h_decode.record(now() - t0, len(drafted) + len(riders))
+        for seq in riders:
+            # a rider's row is an ordinary decode in verify clothing:
+            # it charges no speculation budget, ticks no speculation
+            # counters, and needs no rollback — position 0's argmax is
+            # the round's one token
+            request_log.decode_round(seq.request_id)
+            done.add(seq)
+            self._emit(seq, int(greedy[seq.slot, 0]))
+        for seq, st, draft in drafted:
+            i = seq.slot
+            m = 0
+            while m < len(draft) and draft[m] == greedy[i, m]:
+                m += 1
+            st.record(len(draft), m)
+            self._c_spec_rounds.inc()
+            self._c_spec_proposed.inc(len(draft))
+            self._c_spec_accepted.inc(m)
+            self._h_spec_accepted.record(m)
+            n = st.rounds
+            if n & (n - 1) == 0:      # pow2-sampled, like decode
+                request_log.event(seq.request_id, "spec_propose",
+                                  round=n, proposed=len(draft))
+                request_log.event(seq.request_id, "spec_accept",
+                                  round=n, accepted=m)
+            request_log.decode_round(seq.request_id)
+            done.add(seq)
+            # emit the accepted prefix + the bonus token — exactly the
+            # tokens greedy single-step decode would have produced —
+            # stopping at eos/length like the decode loop would
+            for j in range(m + 1):
+                self._emit(seq, int(greedy[i, j]))
+                if seq.status == "finished":
+                    break
+            if seq.status != "finished":
+                # the free-list rewind: drop table blocks past the
+                # next write position (rejected slots decref here)
+                self.scheduler.rollback_speculation(seq)
+        rec.end()
+        return done
+
+    def _decode_all(self, skip: frozenset = frozenset()) -> None:
         rec = self._clock_decode.begin(force_fence=True)
         S = self.max_slots
         MB = self.scheduler.max_blocks_per_seq
@@ -877,6 +1154,8 @@ class GenerationEngine:
         top_k = np.zeros(S, np.int32)
         lanes = {}
         for seq in self.scheduler.running():
+            if seq in skip:
+                continue              # already advanced via verify
             i = seq.slot
             lanes[i] = seq
             tokens[i] = seq.generated[-1] if seq.generated \
@@ -943,9 +1222,11 @@ class GenerationEngine:
         device work ran."""
         with self._lock:
             did = False
+            spec_budget = self.scheduler.prefill_token_budget
             admitted = self.scheduler.admit()
             if self._use_chunks:
-                did = self._prefill_round() or did
+                chunked, spec_budget = self._prefill_round()
+                did = chunked or did
             else:
                 for seq in admitted:
                     self._prefill_seq(seq)
@@ -953,9 +1234,15 @@ class GenerationEngine:
             self.scheduler.ensure_decode_capacity()
             if self.prefix_cache is not None:
                 self._apply_cow()
-            if self.scheduler.running():
+            advanced: set = set()
+            if self.speculation is not None \
+                    and self.scheduler.running():
+                advanced = self._spec_round(spec_budget)
+                did = did or bool(advanced)
+            if any(s not in advanced
+                   for s in self.scheduler.running()):
                 try:
-                    self._decode_all()
+                    self._decode_all(skip=advanced)
                 except PoisonedRequestError as e:
                     self._evict_poisoned(e)
                 did = True

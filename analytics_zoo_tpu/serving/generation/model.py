@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from analytics_zoo_tpu.ops.attention import (
     dot_product_attention,
     paged_decode_attention,
+    paged_verify_attention,
 )
 from analytics_zoo_tpu.ops.normalization import LayerNorm
 
@@ -42,6 +43,12 @@ class CausalLM(nn.Module):
     `kv_scale` [n_block, 2, num_blocks, block_size] when the pool is
     int8 — each new token attends over [its block table ; itself]
     through `ops.attention.paged_decode_attention`.
+    Paged verify (t > 1, same args): speculative decoding's scoring
+    pass — each lane's pending token plus its drafted tokens attend
+    causally over [its block table ; themselves] through
+    `ops.attention.paged_verify_attention` (the chunk-step read
+    semantics over the pool).  The t == 1 branch is untouched, so the
+    compiled decode program is identical with speculation armed.
     Concat decode (parity oracle) AND chunked/prefix-cached prefill:
     pass `ctx_k`/`ctx_v` [n_block, batch, ctx, heads, head_dim]
     (gathered from the pool) and `ctx_len` [batch].  The ctx read path
@@ -68,9 +75,6 @@ class CausalLM(nn.Module):
                  ctx_k=None, ctx_v=None, ctx_len=None,
                  kv_pool=None, kv_scale=None, block_tables=None):
         b, t = input_ids.shape
-        if kv_pool is not None and t != 1:
-            raise ValueError("the paged decode path is q_len=1 per "
-                             f"lane; got t={t}")
         h = self.n_head
         hd = self.hidden_size // h
         x = nn.Embed(self.vocab, self.hidden_size,
@@ -96,7 +100,7 @@ class CausalLM(nn.Module):
             # raw per-token keys/values before attention consumes them
             new_k.append(k.astype(jnp.float32))
             new_v.append(v.astype(jnp.float32))
-            if kv_pool is not None:
+            if kv_pool is not None and t == 1:
                 a = paged_decode_attention(
                     q[:, 0], k[:, 0], v[:, 0],
                     kv_pool[i, 0], kv_pool[i, 1], block_tables,
@@ -107,6 +111,16 @@ class CausalLM(nn.Module):
                              else kv_scale[i, 1]),
                     impl=self.paged_attention_impl or "auto",
                     compute_dtype=self.compute_dtype)[:, None]
+            elif kv_pool is not None:
+                a = paged_verify_attention(
+                    q, k, v, kv_pool[i, 0], kv_pool[i, 1],
+                    block_tables, ctx_len,
+                    k_scale=(None if kv_scale is None
+                             else kv_scale[i, 0]),
+                    v_scale=(None if kv_scale is None
+                             else kv_scale[i, 1]),
+                    impl=self.paged_attention_impl or "auto",
+                    compute_dtype=self.compute_dtype)
             elif ctx_k is not None:
                 a = dot_product_attention(
                     q, k, v, compute_dtype=self.compute_dtype,

@@ -60,7 +60,7 @@ class Sequence:
                  "temperature", "top_k", "eos_id", "stream",
                  "block_table", "slot", "status", "finish_reason",
                  "n_preempted", "_admit_order", "request_id",
-                 "prefill_pos", "prefix_tokens", "priority")
+                 "prefill_pos", "prefix_tokens", "priority", "spec")
 
     def __init__(self, prompt, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
@@ -93,6 +93,12 @@ class Sequence:
         self.prefill_pos = 0
         #: tokens skipped via the prefix cache at the LAST admission
         self.prefix_tokens = 0
+        #: per-lane speculative-decoding draft state (a
+        #: `speculation.SpecState`, attached lazily by the engine's
+        #: Speculator; None while the lane has never drafted).  It
+        #: survives preemption — drafting reads only the token
+        #: history, which recompute-on-resume preserves.
+        self.spec = None
 
     @property
     def context_len(self) -> int:
@@ -247,6 +253,46 @@ class SlotScheduler:
                 victim = self._preempt_newest()
                 if victim is None or victim is seq:
                     break             # seq itself yielded its lane
+
+    def grow_for_speculation(self, seq: Sequence,
+                             last_pos: int) -> bool:
+        """Extend `seq`'s block table to cover a speculative verify
+        step's writes through position `last_pos` (the last drafted
+        token's slot).  Speculation is opportunistic: allocation comes
+        straight off the free list — no cache eviction, no preemption
+        — and False means the lane simply decodes normally this round.
+        The extension blocks are freshly allocated (refcount 1), so
+        `rollback_speculation` can decref them without touching any
+        shared prefix block."""
+        need = last_pos // self.cache.block_size + 1
+        added: List[int] = []
+        while len(seq.block_table) < need:
+            got = self.cache.allocator.alloc(1)
+            if got is None:
+                if added:
+                    self.cache.allocator.free(added)
+                    del seq.block_table[-len(added):]
+                return False
+            added.extend(got)
+            seq.block_table.extend(got)
+        return True
+
+    def rollback_speculation(self, seq: Sequence) -> None:
+        """The free-list half of speculative rollback: after the
+        accepted prefix advanced `context_len`, decref every table
+        block past the one the lane's next write (position
+        context_len - 1) lands in.  Rejected drafted tokens' KV stays
+        in retained blocks as garbage past ctx_len — every attention
+        read masks by ctx_len and each future write overwrites exactly
+        its own slot, so the write cursor rewind is purely this host-
+        side bookkeeping (no device work, no recompile)."""
+        if not seq.block_table:
+            return
+        keep = (seq.context_len - 1) // self.cache.block_size + 1
+        if len(seq.block_table) > keep:
+            extra = seq.block_table[keep:]
+            del seq.block_table[keep:]
+            self.cache.allocator.free(extra)
 
     def resolve_write_conflicts(self) \
             -> List[Tuple[Sequence, int, int, int]]:

@@ -1427,6 +1427,195 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
     }
 
 
+def _cycle_lm(vocab: int = 96, cycle_len: int = 8, seed: int = 0):
+    """A CausalLM whose greedy decode is a known token cycle, plus its
+    untouched random-init params.
+
+    Speculation's win condition is traffic the model CONTINUES
+    predictably (templated output, copy-heavy RAG) — a random-init
+    model's greedy output never repeats, so it can't show the win
+    honestly.  Instead of training one, wire the weights: zero every
+    block's output projection (identity residual — the compiled step
+    still runs every matmul, so dispatch cost is unchanged), zero the
+    position table, identity token embedding, and an lm head that maps
+    token t to perm[t], where perm holds tokens 0..cycle_len-1 in one
+    short cycle.  Greedy decode of any prompt inside the cycle walks
+    it forever; prompts outside it (the adversarial window) wander the
+    long random cycles and never repeat within a request."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.serving.generation import CausalLM
+
+    model = CausalLM(vocab=vocab, hidden_size=128, n_head=4, n_block=2,
+                     intermediate_size=512, max_position_len=1024)
+    raw = model.init(jax.random.PRNGKey(seed),
+                     jnp.zeros((1, 8), jnp.int32),
+                     jnp.arange(8)[None])["params"]
+    rng = np.random.default_rng(seed)
+    rest = rng.permutation(np.arange(cycle_len, vocab))
+    perm = np.empty(vocab, dtype=np.int64)
+    for i in range(cycle_len):
+        perm[i] = (i + 1) % cycle_len
+    # one long cycle over the remaining tokens: adversarial prompts
+    # starting there take >= vocab - cycle_len steps to repeat
+    for i, t in enumerate(rest):
+        perm[t] = rest[(i + 1) % len(rest)]
+    p = jax.device_get(raw)
+    for b in range(2):
+        for name in (f"block_{b}_proj", f"block_{b}_fc2"):
+            p[name]["kernel"] = np.zeros_like(p[name]["kernel"])
+            p[name]["bias"] = np.zeros_like(p[name]["bias"])
+    p["position_embed"]["embedding"] = np.zeros_like(
+        p["position_embed"]["embedding"])
+    emb = np.zeros_like(p["token_embed"]["embedding"])
+    head = np.zeros_like(p["lm_head"]["kernel"])
+    for t in range(vocab):
+        emb[t, t] = 1.0
+        head[t, perm[t]] = 10.0
+    p["token_embed"]["embedding"] = emb
+    p["lm_head"]["kernel"] = head
+    p["lm_head"]["bias"] = np.zeros_like(p["lm_head"]["bias"])
+    cyc = jax.tree_util.tree_map(jnp.asarray, p)
+    return model, cyc, perm
+
+
+def speculation_metrics(n_requests: int = 12, slots: int = 4,
+                        seed: int = 2):
+    """Speculative decoding window (PR 15): n-gram self-drafting +
+    verify-k on the paged engine, spec-ON vs spec-OFF on the SAME
+    armed stack (prefix caching + chunked prefill + int8 KV + SLO +
+    memory sampler + watchdog).
+
+    Two workloads, two gates:
+
+    * `speculation` — a repeated-system-prompt workload on the wired
+      cycle model (`_cycle_lm`): every request shares a 64-token
+      system prompt that loops an 8-token cycle and greedy decode
+      keeps looping it, so the drafter's prompt-lookup proposals are
+      continuously accepted.  Gate: >= 1.5x tokens/s over spec-off,
+      token streams BIT-IDENTICAL (greedy speculation is exact, not
+      approximate), decode_compiles == 1 and verify compiles ==
+      len(buckets).
+    * `adversarial` — random-token prompts on the same engines: the
+      few spurious 1-gram matches get rejected and the exponential
+      cooldown (speculation.py) parks the lanes.  Gate: spec-on costs
+      <= 1.1x the spec-off wall clock (slowdown bound, the price of
+      losing every bet)."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.common.context import OrcaContext
+    from analytics_zoo_tpu.observability.registry import MetricsRegistry
+
+    model, cyc_params, perm = _cycle_lm(seed=seed)
+    vocab = int(perm.shape[0])
+    rng = np.random.default_rng(seed)
+
+    def chain(start, n):
+        out = [int(start)]
+        for _ in range(n - 1):
+            out.append(int(perm[out[-1]]))
+        return out
+
+    sys_prompt = chain(0, 64)                  # loops the 8-cycle
+    spec_reqs = [(sys_prompt + chain(i % 8, 4), 48)
+                 for i in range(n_requests)]
+    # adversarial: wander the long cycle (starts outside 0..7), plus
+    # pure-random prompts for spurious short matches
+    adv_reqs = [(list(rng.integers(8, vocab, 24)), 32)
+                for _ in range(n_requests)]
+
+    prev_slo = OrcaContext.slo_targets
+    prev_wd = OrcaContext.watchdog_deadline_s
+    prev_mem = OrcaContext.memory_sample_interval_s
+    OrcaContext.slo_targets = {"ttft_s": 60.0, "e2e_s": 600.0}
+    OrcaContext.watchdog_deadline_s = 600.0
+    OrcaContext.memory_sample_interval_s = 0.0
+    try:
+        def build(spec_on: bool):
+            return make_engine(model, cyc_params, slots=slots,
+                               cache_dtype=jnp.float16,
+                               kv_quantization="int8",
+                               prefix_caching=True,
+                               chunked_prefill=True,
+                               registry=MetricsRegistry(),
+                               speculative_decoding=spec_on,
+                               speculative_k=4)
+
+        def timed(engine, reqs):
+            p0, n0 = reqs[0]
+            warm = engine.submit(p0, max_new_tokens=n0)
+            engine.run_until_idle()
+            first = warm.tokens()
+            t0 = time.monotonic()
+            streams = [engine.submit(p, max_new_tokens=n)
+                       for p, n in reqs[1:]]
+            engine.run_until_idle()
+            wall = time.monotonic() - t0
+            outs = [s.tokens() for s in streams]
+            return sum(len(o) for o in outs) / wall, [first] + outs
+
+        eng_on, eng_off = build(True), build(False)
+        on_tput, on_streams = timed(eng_on, spec_reqs)
+        off_tput, off_streams = timed(eng_off, spec_reqs)
+        if on_streams != off_streams:
+            raise RuntimeError(
+                "speculative greedy streams diverged from the legacy "
+                "engine — acceptance is supposed to be exact")
+        if on_tput < 1.5 * off_tput:
+            raise RuntimeError(
+                f"speculation tokens/s {on_tput:.1f} < 1.5x the "
+                f"non-speculative {off_tput:.1f} on the repeated-"
+                "system-prompt workload")
+        n_buckets = len(eng_on.speculation.buckets)
+        if eng_on.decode_compile_count != 1 \
+                or eng_on.spec_verify_compile_count != n_buckets:
+            raise RuntimeError(
+                f"compiled-family contract broke: decode "
+                f"{eng_on.decode_compile_count} (want 1), verify "
+                f"{eng_on.spec_verify_compile_count} (want {n_buckets})")
+        proposed = int(eng_on._c_spec_proposed.value)
+        accepted = int(eng_on._c_spec_accepted.value)
+        rounds = int(eng_on._c_spec_rounds.value)
+        if accepted == 0:
+            raise RuntimeError("speculation window never accepted a "
+                               "draft — the workload is broken")
+
+        # adversarial: same engines, incompressible traffic
+        adv_on_tput, adv_on_streams = timed(eng_on, adv_reqs)
+        adv_off_tput, adv_off_streams = timed(eng_off, adv_reqs)
+        if adv_on_streams != adv_off_streams:
+            raise RuntimeError("adversarial streams diverged")
+        slowdown = adv_off_tput / adv_on_tput
+        if slowdown > 1.1:
+            raise RuntimeError(
+                f"speculation costs {slowdown:.2f}x on adversarial "
+                "traffic — the cooldown failed to bound the losses")
+    finally:
+        OrcaContext.slo_targets = prev_slo
+        OrcaContext.watchdog_deadline_s = prev_wd
+        OrcaContext.memory_sample_interval_s = prev_mem
+
+    return {
+        "speculation_tokens_per_sec": round(on_tput, 1),
+        "speculation_off_tokens_per_sec": round(off_tput, 1),
+        "speculation_vs_off_tokens_per_sec": round(
+            on_tput / off_tput, 3),
+        "speculation_acceptance_rate": round(accepted / proposed, 4),
+        "speculation_proposed_total": proposed,
+        "speculation_accepted_total": accepted,
+        "speculation_rounds_total": rounds,
+        "speculation_decode_compiles": eng_on.decode_compile_count,
+        "speculation_verify_compiles":
+            eng_on.spec_verify_compile_count,
+        "speculation_adversarial_slowdown": round(slowdown, 3),
+        "speculation_adversarial_tokens_per_sec": round(
+            adv_on_tput, 1),
+        "speculation_adversarial_off_tokens_per_sec": round(
+            adv_off_tput, 1),
+    }
+
+
 def router_metrics(n_requests: int = 16, slots: int = 4,
                    seed: int = 1):
     """Replica scale-out (PR 10): the same closed-loop generation
@@ -1952,6 +2141,19 @@ def main():
         generation = {"generation_error":
                       f"{type(e).__name__}: {e}"[:120]}
 
+    specw = {}
+    try:
+        # speculative-decoding window (PR 15): spec-on vs spec-off on
+        # the armed stack, repeated-system-prompt (>= 1.5x gate, bit-
+        # identical streams) + adversarial (<= 1.1x slowdown gate) —
+        # four engine warmups, ~60s warm, budget-gated
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining < 150:
+            raise TimeoutError(f"only {remaining:.0f}s left")
+        specw = speculation_metrics()
+    except Exception as e:
+        specw = {"speculation_error": f"{type(e).__name__}: {e}"[:120]}
+
     routerw = {}
     try:
         # replica scale-out window (PR 10): 1 vs 2 router replicas on
@@ -2009,6 +2211,7 @@ def main():
             **serving,
             **overload,
             **generation,
+            **specw,
             **routerw,
             **tenantw,
             **bert_extra,
