@@ -77,6 +77,8 @@ class OrcaContextMeta(type):
     _telemetry_spool_interval_s = 1.0
     _telemetry_spool_max_bytes = 1024 * 1024
     _tenant_quotas = None
+    _metrics_history_interval_s = None
+    _metrics_history_max_bytes = 8 * 1024 * 1024
 
     # --- TPU runtime state ---
     _mesh = None
@@ -249,6 +251,47 @@ class OrcaContextMeta(type):
         if int(value) < 4096:
             raise ValueError("telemetry_spool_max_bytes must be >= 4096")
         cls._telemetry_spool_max_bytes = int(value)
+
+    @property
+    def metrics_history_interval_s(cls):
+        """Sampling cadence of the metrics history recorder
+        (observability/history.py) in seconds; None (default) leaves
+        the recorder disarmed.  When set, `maybe_record()` hooks in the
+        generation engine loop, the durable-stream consumer and the
+        elastic supervisor sample every registered registry into a
+        bounded in-memory ring and — when `observability_dir` is set —
+        an append-only CRC32C-framed sample log under
+        `observability_dir/history/<proc>/` (crash-durable: recovery
+        truncates at the first torn frame).  Each sample also steps the
+        built-in AlertEngine (docs/observability.md, 'Metrics history
+        + alerting').  A forced sample is always available on demand
+        (`GET /metrics/history` takes one), so None only disables the
+        cadence, not the plane."""
+        return cls._metrics_history_interval_s
+
+    @metrics_history_interval_s.setter
+    def metrics_history_interval_s(cls, value):
+        if value is not None and float(value) <= 0:
+            raise ValueError(
+                "metrics_history_interval_s must be > 0 or None")
+        cls._metrics_history_interval_s = (None if value is None
+                                           else float(value))
+
+    @property
+    def metrics_history_max_bytes(cls):
+        """On-disk budget for one process's metrics-history sample log
+        (default 8 MiB).  The recorder rotates segments and drops the
+        oldest whole segments once the per-process directory exceeds
+        this — retention is bounded, never the append path (appends are
+        tmp-less and flushed per sample so a SIGKILL'd replica's
+        history survives)."""
+        return cls._metrics_history_max_bytes
+
+    @metrics_history_max_bytes.setter
+    def metrics_history_max_bytes(cls, value):
+        if int(value) < 4096:
+            raise ValueError("metrics_history_max_bytes must be >= 4096")
+        cls._metrics_history_max_bytes = int(value)
 
     @property
     def tenant_quotas(cls):

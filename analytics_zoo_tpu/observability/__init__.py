@@ -59,11 +59,26 @@ from analytics_zoo_tpu.observability.goodput import (  # noqa: F401
 )
 from analytics_zoo_tpu.observability import (  # noqa: F401
     flight_recorder,
+    history,
     memory,
     request_log,
     telemetry_spool,
     timeline,
     trace_context,
+)
+from analytics_zoo_tpu.observability.alerts import (  # noqa: F401
+    AlertEngine,
+    AlertRule,
+    BUILTIN_ALERTS,
+    builtin_rules,
+)
+from analytics_zoo_tpu.observability.history import (  # noqa: F401
+    HistoryReader,
+    MetricsRecorder,
+    SampleLog,
+    get_recorder,
+    maybe_record,
+    reset_recorder,
 )
 from analytics_zoo_tpu.observability.fleet import (  # noqa: F401
     FleetAggregator,
@@ -101,19 +116,25 @@ from analytics_zoo_tpu.observability.watchdog import (  # noqa: F401
 )
 
 __all__ = [
-    "Counter", "FleetAggregator", "Gauge", "Histogram",
-    "MetricsRegistry", "RequestLog", "SLOTracker", "Span", "StepClock",
+    "AlertEngine", "AlertRule", "BUILTIN_ALERTS", "Counter",
+    "FleetAggregator", "Gauge", "Histogram", "HistoryReader",
+    "MetricsRecorder", "MetricsRegistry", "RequestLog", "SLOTracker",
+    "SampleLog", "Span", "StepClock",
     "TelemetrySpool", "TraceContext", "Watchdog", "annotate",
+    "builtin_rules",
     "clear_spans", "close_sink", "current_span",
     "current_trace_context", "export_timeline", "flight_recorder",
-    "get_registry", "get_request_log", "get_shadow_slo_tracker",
-    "get_slo_tracker",
-    "goodput_tables", "labeled_prometheus_text", "localize_nonfinite",
-    "log_event", "maybe_spool", "maybe_watchdog", "memory",
+    "get_recorder", "get_registry", "get_request_log",
+    "get_shadow_slo_tracker", "get_slo_tracker",
+    "goodput_tables", "history", "labeled_prometheus_text",
+    "localize_nonfinite",
+    "log_event", "maybe_record", "maybe_spool", "maybe_watchdog",
+    "memory",
     "merged_prometheus_text", "nearest_rank", "new_request_id",
     "nonfinite_leaves", "now", "parse_prometheus_text",
     "parse_traceparent", "process_goodput_ratio", "recent_spans",
-    "request_log", "reset_registry", "reset_request_log",
+    "request_log", "reset_recorder", "reset_registry",
+    "reset_request_log",
     "reset_slo_tracker", "sanitize_metric_name", "step_clock",
     "telemetry_spool", "timeline", "trace", "trace_context",
 ]

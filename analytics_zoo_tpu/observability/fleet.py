@@ -374,6 +374,37 @@ class FleetAggregator:
         }
 
     # ------------------------------------------------------------------
+    # metrics history
+    # ------------------------------------------------------------------
+
+    def fleet_history(self, *, family: Optional[str] = None,
+                      since: Optional[float] = None,
+                      derive: Optional[str] = None,
+                      window_s: Optional[float] = None
+                      ) -> Dict[str, Any]:
+        """The GET /metrics/history?fleet=1 body: every process's
+        durable sample log under ``<observability_dir>/history/``
+        merged onto one wall clock with the local recorder's live ring
+        (dedup by (proc, seq) — a live process's ring overlaps its own
+        log).  A SIGKILL'd replica's recorded history merges exactly
+        like a live one — same contract as the spool harvest above."""
+        from analytics_zoo_tpu.common.context import OrcaContext
+        from analytics_zoo_tpu.observability import history
+
+        base_dir = self._dir or OrcaContext.observability_dir
+        reader = history.HistoryReader(base_dir)
+        disk = reader.read_samples()
+        rec = history.get_recorder()
+        ring = rec.tail() if rec is not None else []
+        merged = history.merge_samples(disk, ring)
+        self._c_harvests.inc()
+        return history.history_payload(
+            merged, family=family, since=since, derive=derive,
+            window_s=window_s, fleet=True,
+            enabled=OrcaContext.metrics_history_interval_s is not None
+            or bool(merged))
+
+    # ------------------------------------------------------------------
     # SLO
     # ------------------------------------------------------------------
 

@@ -162,6 +162,8 @@ def dump(reason: str, extra: Optional[Dict[str, Any]] = None,
             "metrics": get_registry().snapshot(),
             "goodput": _goodput_tables_safe(),
             "memory": _memory_snapshot_safe(),
+            "history_tail": _history_tail_safe(),
+            "alerts_active": _alerts_active_safe(),
             "thread_stacks": _thread_stacks(),
         }
         if exc is not None:
@@ -209,6 +211,29 @@ def _memory_snapshot_safe() -> Dict[str, Any]:
     try:
         from analytics_zoo_tpu.observability import memory
         return memory.snapshot()
+    except Exception:
+        return {}
+
+
+def _history_tail_safe(n: int = 64) -> List[Dict[str, Any]]:
+    """The recorder's recent sample window, so a post-mortem shows the
+    minutes BEFORE the crash, not just the instant (empty when the
+    history plane is disarmed)."""
+    try:
+        from analytics_zoo_tpu.observability import history
+        rec = history.get_recorder()
+        return rec.tail(n) if rec is not None else []
+    except Exception:
+        return []
+
+
+def _alerts_active_safe() -> Dict[str, Any]:
+    try:
+        from analytics_zoo_tpu.observability import history
+        rec = history.get_recorder()
+        if rec is None or rec.alerts is None:
+            return {}
+        return rec.alerts.evaluate(rec.tail()).get("active", {})
     except Exception:
         return {}
 

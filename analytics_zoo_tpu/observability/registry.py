@@ -282,6 +282,39 @@ class MetricsRegistry:
                 out[name] = m.value
         return out
 
+    def sample_values(
+            self, families: Optional[Tuple[str, ...]] = None,
+    ) -> Dict[str, Dict[str, float]]:
+        """One flat numeric snapshot for the metrics history recorder
+        (observability/history.py): ``{"counters": {name: v},
+        "gauges": {name: v}}``.  Histograms contribute their cumulative
+        ``<name>_sum`` / ``<name>_count`` accumulators as counters
+        (what a rate over time needs; reservoir quantiles are a
+        point-in-time artifact and stay out of history).  Callback
+        gauges are sampled; a non-finite gauge read is skipped rather
+        than recorded (NaN poisons every derived series downstream).
+        `families` is an optional tuple of name prefixes to keep."""
+        def keep(name: str) -> bool:
+            return families is None or any(
+                name.startswith(f) for f in families)
+
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        for name, m in self.metrics().items():
+            if not keep(name):
+                continue
+            if isinstance(m, Counter):
+                counters[name] = m.value
+            elif isinstance(m, Gauge):
+                v = m.value
+                if math.isfinite(v):
+                    gauges[name] = v
+            else:
+                calls, _records, total, _mx, _s = m._snap()
+                counters[name + "_sum"] = total
+                counters[name + "_count"] = float(calls)
+        return {"counters": counters, "gauges": gauges}
+
     def prometheus_text(self) -> str:
         """Prometheus text exposition format.  Histograms are emitted
         as `summary` metrics (quantile labels + _sum/_count, plus a

@@ -152,32 +152,44 @@ class TelemetrySpool:
     def write(self) -> bool:
         """Commit one snapshot now.  Never raises; returns success."""
         with self._lock:
-            try:
-                doc = self.snapshot_doc()
-                blob = self._encode_bounded(doc)
-                os.makedirs(self.dir, exist_ok=True)
-                tmp = f"{self.path}.tmp"
-                with open(tmp, "wb") as f:
-                    f.write(blob)
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, self.path)
-            except Exception:
-                self._c_errors.inc()
-                return False
-            self.seq += 1
-            self._last_write = now()
-            self._c_writes.inc()
-            self._g_bytes.set(len(blob))
-            return True
+            return self._write_locked()
+
+    def _write_locked(self) -> bool:
+        try:
+            doc = self.snapshot_doc()
+            blob = self._encode_bounded(doc)
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = f"{self.path}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except Exception:
+            self._c_errors.inc()
+            return False
+        self.seq += 1
+        self._last_write = now()
+        self._c_writes.inc()
+        self._g_bytes.set(len(blob))
+        return True
+
+    def _due(self) -> bool:
+        return (self._last_write is None
+                or now() - self._last_write >= self.interval_s)
 
     def maybe_write(self) -> bool:
-        """Time-gated `write` — at most one snapshot per `interval_s`."""
-        t = now()
-        if (self._last_write is not None
-                and t - self._last_write < self.interval_s):
-            return False
-        return self.write()
+        """Time-gated `write` — at most one snapshot per `interval_s`.
+        The gate is re-checked UNDER the lock: N threads racing the
+        unlocked fast path must collapse to one write per interval,
+        not serialize into N redundant commits (each an fsync+rename —
+        pinned by the concurrency test in tests/test_fleet_telemetry)."""
+        if not self._due():
+            return False         # cheap unlocked fast path
+        with self._lock:
+            if not self._due():
+                return False
+            return self._write_locked()
 
 
 # ----------------------------------------------------------------------

@@ -265,12 +265,14 @@ class ElasticTrainingDriver:
     def _monitor(self, members) -> Dict[str, Any]:
         """Poll liveness + heartbeat staleness until the gang finishes
         or a member dies/stalls.  Returns the attempt verdict."""
-        from analytics_zoo_tpu.observability import maybe_spool
+        from analytics_zoo_tpu.observability import (maybe_record,
+                                                     maybe_spool)
         while True:
             # the driver (and its in-process thread members) spool
             # telemetry each poll tick — a driver SIGKILL leaves its
             # last restart ledger/metrics behind for the fleet view
             maybe_spool("elastic-driver")
+            maybe_record()
             dead, stalled, running = [], [], 0
             now = time.monotonic()
             for i, m in enumerate(members):

@@ -70,6 +70,7 @@ from analytics_zoo_tpu.observability import (
     flight_recorder,
     get_registry,
     log_event,
+    maybe_record,
     maybe_spool,
     maybe_watchdog,
     memory,
@@ -1297,6 +1298,10 @@ class GenerationEngine:
             # for the fleet harvest (no-op while observability_dir is
             # unset; time-gated otherwise)
             maybe_spool(self.spool_name, (self.registry,))
+            # metrics history: time-series samples for burn-rate
+            # alerting + replay (disarmed unless
+            # metrics_history_interval_s is set)
+            maybe_record((self.registry,))
             if not self.scheduler.has_work():
                 if self.watchdog is not None:
                     # idle is not a stall: disarm until work arrives

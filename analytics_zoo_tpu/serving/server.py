@@ -349,6 +349,52 @@ class ServingServer:
                                      if server.router else 1),
                         "batches_run": server._batches_run})
                     return
+                if self.path.startswith("/metrics/history"):
+                    # recorded metric time series (observability/
+                    # history.py): a forced sample is taken first so
+                    # the response always carries a current point,
+                    # then the local recorder's ring (or, ?fleet=1,
+                    # every process's durable sample log merged with
+                    # it) is served with optional derived series —
+                    # ?family=<prefix>&since=<wall ts>&derive=rate|
+                    # delta|quantiles&window=<s>.  Disarmed (knob
+                    # unset, no recorded history): enabled=false,
+                    # empty samples.
+                    from urllib.parse import parse_qs
+                    from analytics_zoo_tpu.observability import (
+                        history)
+                    q = parse_qs(self.path.partition("?")[2])
+
+                    def _qf(key):
+                        try:
+                            return float(q[key][0])
+                        except (KeyError, ValueError, IndexError):
+                            return None
+
+                    family = (q.get("family") or [None])[0]
+                    derive = (q.get("derive") or [None])[0]
+                    if derive and derive not in history.DERIVE_KINDS:
+                        self._json(400, {
+                            "error": f"derive must be one of "
+                                     f"{list(history.DERIVE_KINDS)}"})
+                        return
+                    rec = history.get_recorder(
+                        registries=(server.registry,))
+                    if rec is not None:
+                        rec.sample()
+                    if (q.get("fleet") or ["0"])[0] == "1":
+                        payload = server.fleet().fleet_history(
+                            family=family, since=_qf("since"),
+                            derive=derive, window_s=_qf("window"))
+                    else:
+                        samples = rec.tail() if rec is not None else []
+                        payload = history.history_payload(
+                            samples, family=family,
+                            since=_qf("since"), derive=derive,
+                            window_s=_qf("window"),
+                            enabled=rec is not None)
+                    self._json(200, payload)
+                    return
                 if self.path.startswith("/metrics"):
                     # Prometheus text exposition (pull model): this
                     # server's op summaries/counters/gauges + the

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from analytics_zoo_tpu.observability import (
     log_event,
+    maybe_record,
     maybe_spool,
     request_log,
     trace,
@@ -97,6 +98,7 @@ class StreamConsumer:
             # survive a SIGKILL (no-op while observability_dir is
             # unset; time-gated otherwise)
             maybe_spool(f"consumer-{self.group}-{self.consumer}")
+            maybe_record()
 
     def _handle(self, rec) -> None:
         try:

@@ -1982,6 +1982,126 @@ def multi_tenant_metrics(slots: int = 4, seed: int = 5):
     return out
 
 
+def history_metrics(n_requests: int = 8, slots: int = 4, seed: int = 9):
+    """Metrics-history window (docs/observability.md "Metrics history
+    + alerting"): arms the durable recorder + alert engine on a live
+    engine run and publishes GATES, not throughput — the plane's whole
+    contract is invariants:
+
+    - history_replay_deterministic_pass: evaluating the recorded trace
+      twice (alert verdicts + derived series) is byte-identical;
+    - history_burn_rate_fires_pass: a synthetic SLO collapse grafted
+      onto the recorded wall clock makes `slo_burn_rate` fire and
+      resolve with hysteresis;
+    - history_endpoint_schema_pass: GET /metrics/history (and
+      ?fleet=1) serves the documented payload shape;
+    - history_zero_recompile_pass: decode_compile_count stays 1 with
+      the recorder and alert engine armed in the hot loop."""
+    import shutil
+    import tempfile
+    import urllib.request as _rq
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from analytics_zoo_tpu.common.context import OrcaContext
+    from analytics_zoo_tpu.observability import history
+    from analytics_zoo_tpu.observability.alerts import (
+        AlertEngine,
+        builtin_rules,
+    )
+    from analytics_zoo_tpu.observability.registry import MetricsRegistry
+    from analytics_zoo_tpu.serving import ServingServer
+    from analytics_zoo_tpu.serving.generation import CausalLM
+
+    model = CausalLM(vocab=256, hidden_size=64, n_head=4, n_block=2,
+                     intermediate_size=128, max_position_len=576)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32),
+                        jnp.arange(8)[None])["params"]
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-history-")
+    prev_dir = OrcaContext.observability_dir
+    prev_int = OrcaContext.metrics_history_interval_s
+    OrcaContext.observability_dir = tmpdir
+    OrcaContext.metrics_history_interval_s = 0.05
+    history.reset_recorder()
+    eng = srv = None
+    try:
+        eng = make_engine(model, params, slots=slots,
+                          registry=MetricsRegistry())
+        rng = np.random.default_rng(seed)
+        reqs = [(list(rng.integers(0, 256, 16 + 4 * i)), 16)
+                for i in range(n_requests)]
+        eng.ensure_started()                # the REAL hot loop: the
+        streams = [eng.submit(p, max_new_tokens=n) for p, n in reqs]
+        assert all(len(s.tokens()) == 16 for s in streams)
+        rec = history.get_recorder(registries=(eng.registry,))
+        deadline = time.monotonic() + 10    # loop-thread maybe_record
+        while (len(rec.tail()) < 3 and time.monotonic() < deadline):
+            time.sleep(0.05)
+        rec.sample()                        # one forced full sample
+
+        # replay determinism: two passes over the same recorded trace
+        disk = history.HistoryReader(tmpdir).read_samples()
+        trace = history.merge_samples(disk, rec.tail())
+        outs = []
+        for _ in range(2):
+            verdict = AlertEngine(builtin_rules()).evaluate(trace)
+            payload = history.history_payload(trace, derive="rate")
+            outs.append(json.dumps({"v": verdict, "p": payload},
+                                   sort_keys=True))
+        replay_ok = outs[0] == outs[1]
+
+        # burn-rate on a synthetic collapse grafted onto the recorded
+        # clock: healthy -> hard miss -> recovery
+        t0 = trace[-1]["ts"]
+        degraded = [1.0] * 20 + [0.0] * 40 + [1.0] * 40
+        synth = [{"ts": t0 + i, "proc": "bench-synth", "seq": i + 1,
+                  "counters": {},
+                  "gauges": {"slo_attainment_ratio": g}}
+                 for i, g in enumerate(degraded)]
+        events = AlertEngine(builtin_rules()).evaluate(synth)["events"]
+        burn = [e["state"] for e in events
+                if e["rule"] == "slo_burn_rate"]
+        burn_ok = burn == ["firing", "resolved"]
+
+        # endpoint schema, live + fleet
+        srv = ServingServer(generation_engine=eng).start()
+        def _get(path):
+            url = f"http://{srv.host}:{srv.port}{path}"
+            with _rq.urlopen(url, timeout=30) as r:
+                return json.loads(r.read().decode())
+        want = {"enabled", "fleet", "family", "since", "n_samples",
+                "procs", "names", "samples"}
+        body = _get("/metrics/history")
+        fleet = _get("/metrics/history?fleet=1&derive=rate")
+        schema_ok = (want <= set(body) and body["enabled"]
+                     and body["n_samples"] >= 1
+                     and want | {"derive", "series"} <= set(fleet)
+                     and fleet["fleet"] is True)
+
+        return {
+            "history_samples_recorded": len(trace),
+            "history_alert_events": len(events),
+            "history_replay_deterministic_pass": replay_ok,
+            "history_burn_rate_fires_pass": burn_ok,
+            "history_endpoint_schema_pass": schema_ok,
+            "history_zero_recompile_pass":
+                eng.decode_compile_count == 1,
+        }
+    finally:
+        if srv is not None:
+            srv.stop()
+        if eng is not None:
+            eng.stop()
+        history.reset_recorder()
+        OrcaContext.observability_dir = prev_dir
+        OrcaContext.metrics_history_interval_s = prev_int
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main():
     t_start = time.monotonic()
     # default budget leaves the BERT stage ~425s: enough for ONE cold
@@ -2181,6 +2301,19 @@ def main():
         tenantw = {"multi_tenant_error":
                    f"{type(e).__name__}: {e}"[:120]}
 
+    historyw = {}
+    try:
+        # metrics-history window (observability plane): replay
+        # determinism + burn-rate + endpoint schema + zero-recompile
+        # gates on a small armed engine — one warmup, ~20s warm,
+        # budget-gated last (gates, not throughput: cheap by design)
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining < 60:
+            raise TimeoutError(f"only {remaining:.0f}s left")
+        historyw = history_metrics()
+    except Exception as e:
+        historyw = {"history_error": f"{type(e).__name__}: {e}"[:120]}
+
     cpu = None
     for cpu_batch in (batch, 4096, 512):
         try:
@@ -2214,6 +2347,7 @@ def main():
             **specw,
             **routerw,
             **tenantw,
+            **historyw,
             **bert_extra,
         },
     }))
@@ -2278,6 +2412,12 @@ if __name__ == "__main__":
         from analytics_zoo_tpu import init_orca_context
         init_orca_context(cluster_mode="local")
         print(json.dumps(multi_tenant_metrics()))
+    elif "history" in sys.argv:
+        # standalone metrics-history window (docs/observability.md):
+        # replay / burn-rate / endpoint / zero-recompile gates only
+        from analytics_zoo_tpu import init_orca_context
+        init_orca_context(cluster_mode="local")
+        print(json.dumps(history_metrics()))
     elif os.environ.get("_BENCH_ATTEMPT") == "1":
         main()
     else:

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Diff two bench records (BENCH_r*.json) and gate on regressions.
+
+`bench.py` leaves one record per round in the repo root::
+
+    BENCH_r07.json = {"n": 7, "cmd": ..., "rc": 0, "tail": ...,
+                      "parsed": {"metric", "value", "unit",
+                                 "vs_baseline", "extra": {...}}}
+
+This tool compares the two latest rounds (or any two records given on
+the command line), prints per-key deltas over every numeric key the two
+records share, and exits nonzero when a key on the CURATED list
+regresses by more than the threshold (default 10%).
+
+The curated list is deliberately the *stable* subset — pass/fail gates,
+compile counts, exact ratios — not raw throughput: on a noisy shared
+host tokens/sec swings ±30% between identical builds (measured across
+r06↔r07), so gating on it would cry wolf every round.  Directions are
+per-key: ``higher`` means a drop is a regression, ``lower`` means a
+rise is.  A tracked key missing from either record warns but does not
+fail (new gates appear over time; old ones must never silently vanish
+INTO the tracked list without a record carrying them).
+
+Usage::
+
+    python scripts/bench_diff.py                 # two latest rounds
+    python scripts/bench_diff.py OLD.json NEW.json
+    python scripts/bench_diff.py --threshold 0.2
+
+Self-tested on synthetic pairs by tests/test_bench_diff.py — CI never
+needs a real bench run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ROUND = re.compile(r"^BENCH_r(\d+)\.json$")
+
+#: curated regression gates: key -> direction ("higher" = bigger is
+#: better, a drop regresses; "lower" = smaller is better)
+TRACKED: Dict[str, str] = {
+    # NOT tracked: "value" (the headline samples/s) — raw throughput
+    # is exactly the ±30% noise this list exists to avoid gating on;
+    # the diff still prints it as a >1% mover every round
+    "generation_decode_compiles": "lower",  # zero-recompile discipline
+    "prefix_decode_compiles": "lower",
+    "goodput_buckets_sum_vs_wall": "higher",
+    "goodput_ratio": "higher",
+    "prefix_cache_hit_rate": "higher",
+    "prefix_hit_tokens_total": "higher",
+    "kv_bytes_per_token_int8": "lower",
+    "overload_gate_zero_acked_loss_pass": "higher",
+    "overload_gate_2x_attainment_pass": "higher",
+    "overload_gate_sheds_carry_retry_after_pass": "higher",
+    "serving_queue_wait_gate_40ms_pass": "higher",
+}
+
+DEFAULT_THRESHOLD = 0.10
+
+
+def find_rounds(root: str = REPO) -> List[str]:
+    """BENCH_r*.json paths in round order (oldest first)."""
+    out = []
+    for fn in os.listdir(root):
+        m = _ROUND.match(fn)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, fn)))
+    return [p for _n, p in sorted(out)]
+
+
+def flatten_record(rec: Dict[str, Any]) -> Dict[str, float]:
+    """Numeric view of one record: the headline ``value`` plus every
+    numeric key of ``parsed.extra`` (nested dicts dotted)."""
+    parsed = rec.get("parsed") or {}
+    flat: Dict[str, float] = {}
+
+    def put(key: str, v: Any) -> None:
+        if isinstance(v, bool):
+            flat[key] = float(v)
+        elif isinstance(v, (int, float)):
+            flat[key] = float(v)
+        elif isinstance(v, dict):
+            for k2, v2 in v.items():
+                put(f"{key}.{k2}", v2)
+
+    if isinstance(parsed.get("value"), (int, float)):
+        flat["value"] = float(parsed["value"])
+    put_extra = parsed.get("extra") or {}
+    for k, v in put_extra.items():
+        put(k, v)
+    return flat
+
+
+def diff(old: Dict[str, float], new: Dict[str, float]
+         ) -> List[Tuple[str, float, float, Optional[float]]]:
+    """(key, old, new, pct-change) over shared keys; pct None when the
+    old value is 0."""
+    rows = []
+    for k in sorted(set(old) & set(new)):
+        a, b = old[k], new[k]
+        pct = (b - a) / abs(a) if a else None
+        rows.append((k, a, b, pct))
+    return rows
+
+
+def find_regressions(old: Dict[str, float], new: Dict[str, float],
+                     tracked: Optional[Dict[str, str]] = None,
+                     threshold: float = DEFAULT_THRESHOLD
+                     ) -> Tuple[List[str], List[str]]:
+    """(regressions, warnings) on the curated keys.  A regression is a
+    direction-adjusted relative change worse than `threshold`; a
+    tracked key absent from either record is a warning."""
+    tracked = TRACKED if tracked is None else tracked
+    regressions, warnings = [], []
+    for key, direction in sorted(tracked.items()):
+        if key not in old or key not in new:
+            missing = "old" if key not in old else "new"
+            warnings.append(f"tracked key {key!r} missing from "
+                            f"{missing} record")
+            continue
+        a, b = old[key], new[key]
+        if a == 0.0:
+            if direction == "lower" and b > 0.0:
+                regressions.append(
+                    f"{key}: {a:g} -> {b:g} (was zero, now not)")
+            continue
+        change = (b - a) / abs(a)
+        worse = -change if direction == "higher" else change
+        if worse > threshold:
+            regressions.append(
+                f"{key}: {a:g} -> {b:g} ({change:+.1%}, "
+                f"{direction}-is-better, limit {threshold:.0%})")
+    return regressions, warnings
+
+
+def load_record(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("records", nargs="*",
+                    help="OLD.json NEW.json (default: two latest "
+                         "BENCH_r*.json in the repo root)")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD,
+                    help="relative regression limit on tracked keys "
+                         "(default 0.10)")
+    ap.add_argument("--all", action="store_true",
+                    help="print every shared key, not just tracked "
+                         "and >1%% movers")
+    args = ap.parse_args(argv)
+
+    if args.records and len(args.records) != 2:
+        ap.error("give exactly two records, or none for auto-detect")
+    if args.records:
+        old_path, new_path = args.records
+    else:
+        rounds = find_rounds()
+        if len(rounds) < 2:
+            print("bench_diff: need at least two BENCH_r*.json "
+                  "records", file=sys.stderr)
+            return 2
+        old_path, new_path = rounds[-2], rounds[-1]
+
+    old = flatten_record(load_record(old_path))
+    new = flatten_record(load_record(new_path))
+    print(f"bench_diff: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)} "
+          f"({len(set(old) & set(new))} shared numeric keys)")
+    for key, a, b, pct in diff(old, new):
+        tracked = key in TRACKED
+        if not args.all and not tracked and (
+                pct is None or abs(pct) < 0.01):
+            continue
+        mark = "*" if tracked else " "
+        pct_s = f"{pct:+8.1%}" if pct is not None else "     n/a"
+        print(f" {mark} {key:55s} {a:>14g} {b:>14g} {pct_s}")
+
+    regressions, warnings = find_regressions(
+        old, new, threshold=args.threshold)
+    for w in warnings:
+        print(f"bench_diff: WARN {w}")
+    if regressions:
+        print(f"bench_diff: {len(regressions)} regression(s) on "
+              "tracked keys:", file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    print("bench_diff: tracked keys clean "
+          f"({args.threshold:.0%} limit)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

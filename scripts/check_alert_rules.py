@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Lint: built-in alert rules and docs/observability.md's alert table
+agree — in BOTH directions (the check_metric_names / check_fault_sites
+contract, applied to the alerting plane).
+
+An alert rule that exists in code but not in the docs table fires at
+an operator who has no idea what it means or how to tune it; a
+documented rule with no counterpart in `BUILTIN_ALERTS` is worse — an
+operator relies on an alert that will never fire.  Two checks close
+the loop statically (source-parsed, not imported: the lint must run
+without the package's import-time dependencies):
+
+1. every name in `observability/alerts.py::BUILTIN_ALERTS` appears as
+   a backticked first-cell token in the alert table of
+   docs/observability.md's '## Metrics history + alerting' section;
+2. every rule documented there is registered in `BUILTIN_ALERTS`.
+
+Run directly (`python scripts/check_alert_rules.py`) or via the tier-1
+wrapper `tests/test_check_alert_rules.py`.  Exit code 0 = clean.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALERTS = os.path.join(REPO, "analytics_zoo_tpu", "observability",
+                      "alerts.py")
+DOCS = os.path.join(REPO, "docs", "observability.md")
+
+#: an alert rule name: lowercase snake_case
+RULE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+#: the BUILTIN_ALERTS tuple body in alerts.py
+REGISTRY = re.compile(r"BUILTIN_ALERTS\s*=\s*\((.*?)\)", re.DOTALL)
+
+SECTION = "## Metrics history + alerting"
+
+
+def registered_rules(alerts_text=None):
+    """BUILTIN_ALERTS, parsed from source."""
+    if alerts_text is None:
+        with open(ALERTS, encoding="utf-8") as f:
+            alerts_text = f.read()
+    m = REGISTRY.search(alerts_text)
+    if not m:
+        raise AssertionError(
+            "BUILTIN_ALERTS tuple not found in observability/alerts.py")
+    return sorted(re.findall(r"[\"']([a-z0-9_]+)[\"']", m.group(1)))
+
+
+def documented_rules(docs_text=None):
+    """Backticked rule tokens from the first cell of the alert-table
+    rows (the `| rule | ... |` table inside the
+    '## Metrics history + alerting' section)."""
+    if docs_text is None:
+        with open(DOCS, encoding="utf-8") as f:
+            docs_text = f.read()
+    in_section = False
+    rules = []
+    for line in docs_text.splitlines():
+        if line.startswith("## "):
+            in_section = line.startswith(SECTION)
+            continue
+        if not (in_section and line.lstrip().startswith("|")):
+            continue
+        cells = line.split("|")
+        if len(cells) < 2:
+            continue
+        for tok in re.findall(r"`([^`]+)`", cells[1]):
+            if RULE.match(tok):
+                rules.append(tok)
+    return sorted(set(rules))
+
+
+def find_violations(alerts_text=None, docs_text=None):
+    registered = set(registered_rules(alerts_text))
+    documented = set(documented_rules(docs_text))
+    violations = []
+    for rule in sorted(registered - documented):
+        violations.append(
+            f"BUILTIN_ALERTS entry {rule!r} missing from "
+            f"docs/observability.md's alert table")
+    for rule in sorted(documented - registered):
+        violations.append(
+            f"docs/observability.md documents alert rule {rule!r} "
+            f"that is not in BUILTIN_ALERTS")
+    return violations
+
+
+def main() -> int:
+    violations = find_violations()
+    if not violations:
+        print("check_alert_rules: clean "
+              f"({len(registered_rules())} rules)")
+        return 0
+    print("check_alert_rules: alert registry / docs disagree:",
+          file=sys.stderr)
+    for v in violations:
+        print(f"  {v}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,150 @@
+"""scripts/bench_diff.py self-test on synthetic record pairs — CI
+never needs a real bench run: flattening (headline value + dotted
+extras, bools as floats), shared-key diffing, and the curated
+regression gate with per-key directions, missing-key warnings, and the
+zero-baseline rule."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(ROOT, "scripts", "bench_diff.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+bd = _load()
+
+
+def _rec(value, extra):
+    return {"n": 1, "cmd": "synthetic", "rc": 0, "tail": "",
+            "parsed": {"metric": "samples_per_sec", "value": value,
+                       "unit": "samples/sec", "vs_baseline": None,
+                       "extra": extra}}
+
+
+def test_flatten_dots_nested_and_casts_bools():
+    flat = bd.flatten_record(_rec(123.4, {
+        "goodput_ratio": 0.9,
+        "overload_gate_zero_acked_loss_pass": True,
+        "nested": {"p50_s": 0.1, "deeper": {"x": 2}},
+        "ignored_string": "text"}))
+    assert flat["value"] == 123.4
+    assert flat["goodput_ratio"] == 0.9
+    assert flat["overload_gate_zero_acked_loss_pass"] == 1.0
+    assert flat["nested.p50_s"] == 0.1
+    assert flat["nested.deeper.x"] == 2.0
+    assert "ignored_string" not in flat
+    # records with no parsed block flatten to nothing, not a crash
+    assert bd.flatten_record({"rc": 1}) == {}
+
+
+def test_diff_shared_keys_only_with_pct():
+    rows = bd.diff({"a": 10.0, "b": 5.0, "gone": 1.0},
+                   {"a": 11.0, "b": 0.0, "new": 2.0})
+    assert [r[0] for r in rows] == ["a", "b"]
+    a = rows[0]
+    assert a[1] == 10.0 and a[2] == 11.0
+    assert a[3] == pytest.approx(0.10)
+    # zero old value -> pct is None, not a ZeroDivisionError
+    assert bd.diff({"z": 0.0}, {"z": 3.0})[0][3] is None
+
+
+def test_direction_higher_flags_drop_not_rise():
+    tracked = {"goodput_ratio": "higher"}
+    regs, warns = bd.find_regressions(
+        {"goodput_ratio": 1.0}, {"goodput_ratio": 0.8},
+        tracked=tracked, threshold=0.10)
+    assert len(regs) == 1 and "goodput_ratio" in regs[0]
+    regs, _ = bd.find_regressions(
+        {"goodput_ratio": 0.8}, {"goodput_ratio": 1.0},
+        tracked=tracked, threshold=0.10)
+    assert regs == [], "an improvement must never gate"
+
+
+def test_direction_lower_flags_rise_and_zero_baseline():
+    tracked = {"generation_decode_compiles": "lower"}
+    regs, _ = bd.find_regressions(
+        {"generation_decode_compiles": 1.0},
+        {"generation_decode_compiles": 2.0},
+        tracked=tracked, threshold=0.10)
+    assert len(regs) == 1
+    # zero -> nonzero on a lower-is-better key regresses even though
+    # the relative change is undefined
+    regs, _ = bd.find_regressions(
+        {"generation_decode_compiles": 0.0},
+        {"generation_decode_compiles": 1.0},
+        tracked=tracked, threshold=0.10)
+    assert len(regs) == 1 and "was zero" in regs[0]
+    # zero -> zero is clean
+    regs, _ = bd.find_regressions(
+        {"generation_decode_compiles": 0.0},
+        {"generation_decode_compiles": 0.0},
+        tracked=tracked, threshold=0.10)
+    assert regs == []
+
+
+def test_threshold_is_a_limit_not_a_trigger():
+    tracked = {"value": "higher"}
+    regs, _ = bd.find_regressions({"value": 100.0}, {"value": 91.0},
+                                  tracked=tracked, threshold=0.10)
+    assert regs == [], "a 9% drop is inside the 10% limit"
+    regs, _ = bd.find_regressions({"value": 100.0}, {"value": 89.0},
+                                  tracked=tracked, threshold=0.10)
+    assert len(regs) == 1
+
+
+def test_missing_tracked_key_warns_but_does_not_fail():
+    regs, warns = bd.find_regressions(
+        {"value": 1.0}, {"value": 1.0, "goodput_ratio": 0.9},
+        tracked={"value": "higher", "goodput_ratio": "higher"},
+        threshold=0.10)
+    assert regs == []
+    assert len(warns) == 1 and "goodput_ratio" in warns[0]
+    assert "old" in warns[0]
+
+
+def test_tracked_keys_exist_in_recent_real_records():
+    """The curated list must not silently rot: every tracked key is
+    present in at least one of the two newest BENCH_r*.json records
+    the repo carries.  (Union, not newest-only: a single window that
+    errored out of one round is bench_diff's documented missing-key
+    WARNING, not a phantom gate — a key absent from BOTH rounds is.)"""
+    rounds = bd.find_rounds()
+    assert len(rounds) >= 2, "repo ships at least two bench rounds"
+    recent = {}
+    for path in rounds[-2:]:
+        recent.update(bd.flatten_record(bd.load_record(path)))
+    missing = [k for k in bd.TRACKED if k not in recent]
+    assert not missing, f"tracked keys absent from the two newest " \
+                        f"records: {missing}"
+
+
+def test_main_end_to_end_exit_codes(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_rec(100.0, {"goodput_ratio": 0.9})))
+    new.write_text(json.dumps(_rec(102.0, {"goodput_ratio": 0.91})))
+    assert bd.main([str(old), str(new)]) == 0
+    assert "tracked keys clean" in capsys.readouterr().out
+    # a gated drop exits 1; the untracked headline value does not
+    new.write_text(json.dumps(_rec(50.0, {"goodput_ratio": 0.5})))
+    assert bd.main([str(old), str(new)]) == 1
+    # --threshold loosens the gate
+    assert bd.main([str(old), str(new), "--threshold", "0.6"]) == 0
+    # a raw-throughput collapse alone never gates (documented noise)
+    new.write_text(json.dumps(_rec(50.0, {"goodput_ratio": 0.9})))
+    assert bd.main([str(old), str(new)]) == 0
+
+
+def test_main_real_rounds_are_clean():
+    """The gate the driver runs: the repo's two newest committed bench
+    rounds must not regress on the curated keys."""
+    assert bd.main([]) == 0

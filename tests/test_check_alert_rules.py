@@ -1,0 +1,85 @@
+"""Tier-1 wrapper for scripts/check_alert_rules.py: the repo is clean
+in both directions, and the lint actually catches synthetic drift
+(undocumented rule in code; documented rule with no registration)."""
+
+import importlib.util
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_alert_rules",
+        os.path.join(ROOT, "scripts", "check_alert_rules.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+car = _load()
+
+ALERTS_OK = 'BUILTIN_ALERTS = (\n    "slo_burn_rate",\n)\n'
+DOCS_OK = """\
+# observability
+
+## Metrics history + alerting
+
+| rule | severity | fires when |
+| --- | --- | --- |
+| `slo_burn_rate` | page | burn > 2x on both windows |
+
+## Metric index
+
+| metric | kind |
+| --- | --- |
+| `alert_fired_total` | counter |
+"""
+
+
+def test_repo_is_clean():
+    assert car.find_violations() == []
+    assert car.main() == 0
+
+
+def test_registry_matches_import():
+    """The source-parsed registry equals the importable tuple — the
+    lint reads source (no import-time deps) but must track reality."""
+    from analytics_zoo_tpu.observability.alerts import BUILTIN_ALERTS
+    assert car.registered_rules() == sorted(BUILTIN_ALERTS)
+
+
+def test_synthetic_pair_is_clean():
+    assert car.find_violations(ALERTS_OK, DOCS_OK) == []
+
+
+def test_detects_undocumented_rule():
+    drifted = ALERTS_OK.replace(
+        '"slo_burn_rate",', '"slo_burn_rate",\n    "ghost_rule",')
+    viol = car.find_violations(drifted, DOCS_OK)
+    assert len(viol) == 1 and "ghost_rule" in viol[0]
+    assert "missing from" in viol[0]
+
+
+def test_detects_unregistered_documented_rule():
+    drifted = DOCS_OK.replace(
+        "| `slo_burn_rate` | page | burn > 2x on both windows |",
+        "| `slo_burn_rate` | page | burn > 2x on both windows |\n"
+        "| `phantom_alert` | warn | never |")
+    viol = car.find_violations(ALERTS_OK, drifted)
+    assert len(viol) == 1 and "phantom_alert" in viol[0]
+    assert "not in BUILTIN_ALERTS" in viol[0]
+
+
+def test_parse_stops_at_next_section():
+    """Backticked tokens in OTHER sections (e.g. the metric index)
+    never count as documented alert rules."""
+    docs = car.documented_rules(DOCS_OK)
+    assert docs == ["slo_burn_rate"]
+    assert "alert_fired_total" not in docs
+
+
+def test_subheadings_do_not_end_the_section():
+    docs = DOCS_OK.replace(
+        "| rule | severity | fires when |",
+        "### Alert rules\n\n| rule | severity | fires when |")
+    assert car.documented_rules(docs) == ["slo_burn_rate"]

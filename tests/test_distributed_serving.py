@@ -314,18 +314,24 @@ def test_replica_death_mid_stream_requeues_once(lm, router, server):
 def test_router_zero_recompile_fully_armed(lm, tmp_path):
     """decode_compiles == 1 PER REPLICA with router + tp=2 + prefix
     cache + chunked prefill + int8 KV + SLO targets + shedder +
-    watchdog all armed — and it STAYS 1 when the durable-stream
-    consumer path feeds the same router (the fully-loaded acceptance
-    gate, streaming included)."""
+    watchdog + metrics-history recorder/alert engine all armed — and
+    it STAYS 1 when the durable-stream consumer path feeds the same
+    router (the fully-loaded acceptance gate, streaming included)."""
+    from analytics_zoo_tpu.observability import history
     model, params = lm
     prev_slo = OrcaContext.slo_targets
     prev_shed = OrcaContext.slo_shed_attainment
     prev_wd = OrcaContext.watchdog_deadline_s
     prev_mem = OrcaContext.memory_sample_interval_s
+    prev_obs = OrcaContext.observability_dir
+    prev_hist = OrcaContext.metrics_history_interval_s
     OrcaContext.slo_targets = {"ttft_s": 60.0, "e2e_s": 600.0}
     OrcaContext.slo_shed_attainment = 0.05
     OrcaContext.watchdog_deadline_s = 600.0
     OrcaContext.memory_sample_interval_s = 0.0
+    OrcaContext.observability_dir = str(tmp_path / "obs")
+    OrcaContext.metrics_history_interval_s = 0.05
+    history.reset_recorder()
     try:
         engines = [
             GenerationEngine(model, params, max_slots=4, block_size=8,
@@ -384,11 +390,21 @@ def test_router_zero_recompile_fully_armed(lm, tmp_path):
         jobs.close()
         outs.close()
         r.stop()
+        # the recorder + alert engine actually ran in the hot loops
+        rec = history.get_recorder()
+        assert rec is not None and len(rec.tail()) >= 1, \
+            "armed recorder never sampled in the engine loops"
+        for e in engines:
+            assert e.decode_compile_count == 1, \
+                "metrics-history recording recompiled the decode step"
     finally:
+        history.reset_recorder()
         OrcaContext.slo_targets = prev_slo
         OrcaContext.slo_shed_attainment = prev_shed
         OrcaContext.watchdog_deadline_s = prev_wd
         OrcaContext.memory_sample_interval_s = prev_mem
+        OrcaContext.observability_dir = prev_obs
+        OrcaContext.metrics_history_interval_s = prev_hist
 
 
 def test_knobs_default_off():

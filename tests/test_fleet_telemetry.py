@@ -272,6 +272,59 @@ def test_fleet_counter_sums_are_exact(spool_dir):
     assert get_registry().gauge("fleet_spooled_sources").value == 2
 
 
+def test_spool_maybe_write_race_collapses_to_one(spool_dir):
+    """N threads hitting maybe_write() at the same instant must
+    collapse to AT MOST one write per interval (the gate re-checks
+    under the lock), and a concurrent fleet harvest never sees torn
+    snapshots or inexact counter sums."""
+    import threading
+    prev = OrcaContext.telemetry_spool_interval_s
+    OrcaContext.telemetry_spool_interval_s = 0.01
+    local = MetricsRegistry()
+    c = local.counter("fleet_race_total")
+    c.inc(7)
+    try:
+        sp = TelemetrySpool("hammer", registries=(local,))
+        agg = FleetAggregator(local_registries=(local,),
+                              local_name="here")
+        n_threads, n_rounds = 8, 20
+        barrier = threading.Barrier(n_threads)
+        results = [[] for _ in range(n_threads)]
+        errors = []
+
+        def worker(slot):
+            try:
+                for _ in range(n_rounds):
+                    barrier.wait(timeout=30)
+                    results[slot].append(bool(sp.maybe_write()))
+                    time.sleep(0.012)       # next round is due again
+            except Exception as e:          # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        # harvest while the hammering runs: parses clean, sums exact
+        for _ in range(n_rounds):
+            text = agg.fleet_prometheus_text()
+            assert parse_prometheus_text(text)[
+                "fleet_race_total"]["value"] == 7
+            for doc in read_snapshots():
+                assert doc["proc"] == "hammer"   # valid JSON, whole
+            time.sleep(0.02)                     # let each round be due
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        per_round = [sum(results[s][r] for s in range(n_threads))
+                     for r in range(n_rounds)]
+        assert max(per_round) <= 1, \
+            f"racing threads wrote {max(per_round)}x in one interval"
+        assert sum(per_round) >= 2, "the spool never wrote at all"
+    finally:
+        OrcaContext.telemetry_spool_interval_s = prev
+
+
 def test_labeled_prometheus_text_folds_labels():
     text = ("# TYPE x_total counter\nx_total 4\n"
             '# TYPE y summary\ny{quantile="0.5"} 1.5\ny_count 2\n')

@@ -77,6 +77,10 @@ def test_ring_bounded_and_dump_contents(obs_dir):
                for frames in bundle["thread_stacks"].values())
     assert any(r["kind"] == "unit_fill" for r in bundle["ring"])
     assert "metrics" in bundle and "goodput" in bundle
+    # the metrics-history plane rides every bundle: with no recorder
+    # armed both fields are present and empty, never missing
+    assert bundle["history_tail"] == []
+    assert bundle["alerts_active"] == {}
     # secrets never reach disk
     assert bundle["extra"]["api_key"] == "<redacted>"
     assert "Bearer abc" not in bundle["extra"]["note"]

@@ -1,0 +1,718 @@
+"""Metrics history plane (observability/history.py + alerts.py):
+durable CRC-framed sample logs with torn-tail recovery, the recorder's
+ring + hot-loop gating, merged multi-process reads, pure derived-series
+math, the replay-determinism contract (no wall-clock reads in the
+evaluation path — enforced here by making every clock raise), the
+declarative alert engine with hysteresis + cooldown, the
+GET /metrics/history endpoint (+ ?fleet=1), and the crash-durable e2e:
+a SIGKILL'd process's recorded history survives, merges into the fleet
+view, and the SLO burn-rate alert fires from the merged trace."""
+
+import json
+import os
+import select
+import signal
+import struct
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from analytics_zoo_tpu.common.context import OrcaContext
+from analytics_zoo_tpu.observability import alerts, history
+from analytics_zoo_tpu.observability.alerts import (
+    BUILTIN_ALERTS,
+    AlertEngine,
+    AlertRule,
+    builtin_rules,
+)
+from analytics_zoo_tpu.observability.history import (
+    HistoryReader,
+    MetricsRecorder,
+    SampleLog,
+    encode_frame,
+)
+from analytics_zoo_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+T0 = 1_700_000_000.0      # fixed wall-clock origin for synthetic traces
+
+
+@pytest.fixture()
+def hist_env(tmp_path):
+    """Armed history knobs against a tmp observability dir, recorder
+    singleton reset both sides; everything restored after.  The
+    process-global registry is NOT swapped — module-level metric
+    handles (request_ttft_seconds, goodput_ratio, ...) cache it, so a
+    swap would orphan them for every later test in the session; the
+    suite convention is unique metric names instead."""
+    prev_dir = OrcaContext.observability_dir
+    prev_int = OrcaContext.metrics_history_interval_s
+    prev_max = OrcaContext.metrics_history_max_bytes
+    OrcaContext.observability_dir = str(tmp_path / "obs")
+    OrcaContext.metrics_history_interval_s = 0.05
+    history.reset_recorder()
+    yield str(tmp_path / "obs")
+    history.reset_recorder()
+    OrcaContext.observability_dir = prev_dir
+    OrcaContext.metrics_history_interval_s = prev_int
+    OrcaContext.metrics_history_max_bytes = prev_max
+
+
+def _mk_samples(attainment, proc="p0", t0=T0, spacing=1.0,
+                counters=None):
+    """Synthetic sample list: one gauge trajectory + optional counter
+    trajectories ({name: [values]})."""
+    out = []
+    for i, g in enumerate(attainment):
+        c = {name: vals[i] for name, vals in (counters or {}).items()}
+        out.append({"ts": t0 + i * spacing, "proc": proc, "seq": i + 1,
+                    "counters": c,
+                    "gauges": {"slo_attainment_ratio": g}})
+    return out
+
+
+# ----------------------------------------------------------------------
+# SampleLog: frames, recovery, retention
+# ----------------------------------------------------------------------
+
+def test_sample_log_roundtrip_and_recovery(tmp_path):
+    d = str(tmp_path / "log")
+    log = SampleLog(d)
+    for i in range(5):
+        assert log.append(json.dumps({"i": i}).encode()) == i + 1
+    log.close()
+    frames = SampleLog.read_dir(d)
+    assert [s for s, _p in frames] == [1, 2, 3, 4, 5]
+    assert json.loads(frames[-1][1]) == {"i": 4}
+    # reopen resumes the seq
+    log2 = SampleLog(d)
+    assert log2.append(b"x") == 6
+    log2.close()
+
+
+def test_sample_log_truncates_torn_tail(tmp_path):
+    d = str(tmp_path / "log")
+    log = SampleLog(d)
+    for i in range(3):
+        log.append(json.dumps({"i": i}).encode())
+    log.close()
+    seg = [os.path.join(d, f) for f in sorted(os.listdir(d))][0]
+    # torn mid-frame: half a valid frame appended (a SIGKILL mid-write)
+    frame = encode_frame(4, b'{"i": 3}')
+    with open(seg, "ab") as f:
+        f.write(frame[: len(frame) // 2])
+    # a reader tolerates the torn tail without repairing
+    assert [s for s, _p in SampleLog.read_dir(d)] == [1, 2, 3]
+    # reopening recovers: truncates the tail, appends continue clean
+    size_torn = os.path.getsize(seg)
+    log2 = SampleLog(d)
+    assert os.path.getsize(seg) < size_torn
+    assert log2.stats()["torn_frames"] == 1
+    assert log2.append(b"post") == 4
+    log2.close()
+    assert [s for s, _p in SampleLog.read_dir(d)] == [1, 2, 3, 4]
+
+
+def test_sample_log_rejects_bit_flip(tmp_path):
+    d = str(tmp_path / "log")
+    log = SampleLog(d)
+    log.append(b"payload-one")
+    log.append(b"payload-two")
+    log.close()
+    seg = [os.path.join(d, f) for f in sorted(os.listdir(d))][0]
+    with open(seg, "r+b") as f:
+        f.seek(history.HEADER_SIZE + 2)   # inside payload one
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0x40]))
+    # CRC catches the flip; the scan stops there (frame 2 is after the
+    # corrupt one in the same segment, so it is unreachable — torn
+    # PREFIX semantics, same as the stream log)
+    assert SampleLog.read_dir(d) == []
+
+
+def test_sample_log_retention_drops_oldest(tmp_path):
+    d = str(tmp_path / "log")
+    log = SampleLog(d, segment_bytes=256, max_bytes=1024)
+    payload = b"x" * 100
+    for _ in range(50):
+        log.append(payload)
+    log.close()
+    assert log.stats()["dropped_segments"] > 0
+    assert log.size_bytes() <= 1024 + 256  # bound + one active segment
+    # the survivors are the NEWEST frames, contiguous to the end
+    seqs = [s for s, _p in SampleLog.read_dir(d)]
+    assert seqs == list(range(seqs[0], 51))
+    assert seqs[0] > 1
+
+
+def test_sample_log_magic_is_distinct_from_stream_log():
+    from analytics_zoo_tpu.serving.streaming import log as stream_log
+    assert history.MAGIC != stream_log.MAGIC
+    # same header layout (the shared frame idiom), different magic
+    assert struct.calcsize(">HHQII") == history.HEADER_SIZE
+
+
+# ----------------------------------------------------------------------
+# MetricsRecorder
+# ----------------------------------------------------------------------
+
+def test_recorder_samples_ring_and_disk(hist_env):
+    reg = get_registry()
+    before = reg.counter("metrics_history_samples_total").value
+    reg.counter("histtest_ops_total").inc(7)
+    reg.gauge("histtest_depth").set(3.5)
+    reg.histogram("histtest_lat_seconds").record(0.25)
+    rec = MetricsRecorder(proc="t-rec", interval_s=0.01)
+    doc = rec.sample()
+    assert doc["proc"] == "t-rec" and doc["seq"] == 1
+    assert doc["counters"]["histtest_ops_total"] == 7.0
+    assert doc["gauges"]["histtest_depth"] == 3.5
+    # histograms contribute cumulative _sum/_count as counters
+    assert doc["counters"]["histtest_lat_seconds_sum"] == 0.25
+    assert doc["counters"]["histtest_lat_seconds_count"] == 1.0
+    rec.close()
+    # durable: a fresh reader sees the sample
+    samples = HistoryReader(hist_env).read_samples()
+    assert len(samples) == 1 and samples[0]["proc"] == "t-rec"
+    # recorder self-metrics
+    assert reg.counter("metrics_history_samples_total").value \
+        == before + 1
+    assert reg.metrics()["metrics_history_bytes"].value > 0
+
+
+def test_recorder_interval_gating_and_disarmed(hist_env):
+    rec = MetricsRecorder(proc="t-gate", interval_s=30.0)
+    assert rec.maybe_sample() is True       # first sample is due
+    assert rec.maybe_sample() is False      # gated
+    rec.close()
+    OrcaContext.metrics_history_interval_s = None
+    off = MetricsRecorder(proc="t-off", interval_s=None)  # knob off
+    assert off.maybe_sample() is False      # disarmed: cadence off
+    assert off.sample()["seq"] == 1         # forced path still works
+    off.close()
+
+
+def test_recorder_family_filter_and_nonfinite_gauges(hist_env):
+    reg = get_registry()
+    reg.counter("histtest_in_total").inc()
+    reg.counter("other_total").inc()
+    reg.gauge("histtest_nan", fn=lambda: float("nan")).value
+    rec = MetricsRecorder(proc="t-fam", families=("histtest_",))
+    doc = rec.sample()
+    assert "histtest_in_total" in doc["counters"]
+    assert "other_total" not in doc["counters"]
+    assert "histtest_nan" not in doc["gauges"]   # non-finite skipped
+    rec.close()
+
+
+def test_recorder_ring_bounded(hist_env):
+    rec = MetricsRecorder(proc="t-ring", ring_size=8, base_dir=None)
+    for i in range(20):
+        rec.sample(wall_ts=T0 + i)
+    tail = rec.tail()
+    assert len(tail) == 8
+    assert tail[-1]["seq"] == 20
+    rec.close()
+
+
+def test_get_recorder_disarmed_until_knob(hist_env):
+    OrcaContext.metrics_history_interval_s = None
+    history.reset_recorder()
+    assert history.get_recorder() is None
+    assert history.maybe_record() is False
+    OrcaContext.metrics_history_interval_s = 0.01
+    rec = history.get_recorder()
+    assert rec is not None
+    assert rec.alerts is not None           # builtin alerts attached
+    assert history.maybe_record() in (True, False)
+
+
+# ----------------------------------------------------------------------
+# reader: multi-process merge
+# ----------------------------------------------------------------------
+
+def test_reader_merges_procs_on_one_clock(hist_env):
+    ra = MetricsRecorder(proc="proc-a", interval_s=None)
+    rb = MetricsRecorder(proc="proc-b", interval_s=None)
+    reg = get_registry()
+    c = reg.counter("histtest_merge_total")
+    for i in range(3):
+        c.inc()
+        ra.sample(wall_ts=T0 + 2 * i)        # t, t+2, t+4
+        rb.sample(wall_ts=T0 + 2 * i + 1)    # t+1, t+3, t+5
+    ra.close(), rb.close()
+    reader = HistoryReader(hist_env)
+    assert reader.procs() == ["proc-a", "proc-b"]
+    merged = reader.read_samples()
+    assert [s["proc"] for s in merged] == \
+        ["proc-a", "proc-b"] * 3, "interleaved on the wall clock"
+    assert [s["ts"] for s in merged] == sorted(s["ts"] for s in merged)
+    # since filter
+    assert len(reader.read_samples(since=T0 + 3)) == 3
+    # dedup by (proc, seq): merging the disk samples with themselves
+    assert len(history.merge_samples(merged, merged)) == len(merged)
+
+
+# ----------------------------------------------------------------------
+# derived series: pure math
+# ----------------------------------------------------------------------
+
+def test_counter_rate_and_reset_safety():
+    samples = _mk_samples([1.0] * 5, counters={
+        "ops_total": [0, 10, 20, 5, 15]})   # reset between idx 2 and 3
+    rates = history.counter_rate(samples, "ops_total")
+    assert [r["value"] for r in rates] == [10.0, 10.0, 5.0, 10.0]
+    # the reset contributes the post-reset level, never negative
+    assert all(r["value"] >= 0 for r in rates)
+
+
+def test_gauge_delta_signed():
+    samples = _mk_samples([0.5, 0.75, 0.25])
+    deltas = history.gauge_delta(samples, "slo_attainment_ratio")
+    assert [d["value"] for d in deltas] == [0.25, -0.5]
+
+
+def test_window_quantiles_anchored_at_first_sample():
+    samples = _mk_samples([float(i) for i in range(20)])
+    rows = history.window_quantiles(samples, "slo_attainment_ratio",
+                                    window_s=10.0)
+    assert len(rows) == 2
+    assert rows[0]["ts_start"] == T0
+    assert rows[0]["n"] == 10 and rows[1]["n"] == 10
+    assert rows[0]["min"] == 0.0 and rows[0]["max"] == 9.0
+    assert rows[1]["p50"] == 14.0
+    with pytest.raises(ValueError):
+        history.derive_series(samples, "x", "nope")
+
+
+def test_history_payload_schema():
+    samples = _mk_samples([1.0, 0.5], counters={"ops_total": [1, 2]})
+    p = history.history_payload(samples, family=None, derive="rate")
+    assert set(p) == {"enabled", "fleet", "family", "since",
+                      "n_samples", "procs", "names", "samples",
+                      "derive", "series"}
+    assert p["n_samples"] == 2 and p["procs"] == ["p0"]
+    assert set(p["names"]) == {"ops_total", "slo_attainment_ratio"}
+    # family filter trims sample payloads AND the name list
+    p2 = history.history_payload(samples, family="ops_")
+    assert p2["names"] == ["ops_total"]
+    assert all("slo_attainment_ratio" not in s["gauges"]
+               for s in p2["samples"])
+
+
+# ----------------------------------------------------------------------
+# replay determinism: byte-identical, no clock reads
+# ----------------------------------------------------------------------
+
+def _poison_clocks(monkeypatch):
+    """Make every wall/monotonic clock raise — the evaluation path
+    must never consult one (the replay contract)."""
+    def boom(*_a, **_k):
+        raise AssertionError("clock read inside the evaluation path")
+    monkeypatch.setattr(time, "time", boom)
+    monkeypatch.setattr(time, "monotonic", boom)
+    monkeypatch.setattr(time, "perf_counter", boom)
+    import analytics_zoo_tpu.observability.registry as reg_mod
+    monkeypatch.setattr(reg_mod, "now", boom)
+    monkeypatch.setattr(history, "now", boom)
+
+
+def test_replay_is_byte_identical_with_clocks_poisoned(monkeypatch):
+    degraded = [1.0] * 30 + [0.2] * 40 + [0.9] * 30
+    samples = _mk_samples(degraded, counters={
+        "ops_total": [float(3 * i) for i in range(100)]})
+    _poison_clocks(monkeypatch)
+    outs = []
+    for _ in range(2):
+        engine = AlertEngine(builtin_rules())
+        verdict = engine.evaluate(samples)
+        series = {
+            "rate": history.counter_rate(samples, "ops_total"),
+            "delta": history.gauge_delta(samples,
+                                         "slo_attainment_ratio"),
+            "q": history.window_quantiles(
+                samples, "slo_attainment_ratio", 10.0),
+            "payload": history.history_payload(samples, derive="rate"),
+        }
+        outs.append(json.dumps({"verdict": verdict, "series": series},
+                               sort_keys=True))
+    assert outs[0] == outs[1], "replay must be byte-identical"
+    assert any(e["rule"] == "slo_burn_rate"
+               and e["state"] == "firing"
+               for e in json.loads(outs[0])["verdict"]["events"])
+
+
+# ----------------------------------------------------------------------
+# alert engine
+# ----------------------------------------------------------------------
+
+def test_burn_rate_fires_and_resolves_with_hysteresis():
+    # healthy -> hard SLO collapse -> recovery; target 0.9 so burn at
+    # attainment 0.0 is 10x, at 1.0 is 0x
+    trace = [1.0] * 20 + [0.0] * 30 + [1.0] * 40
+    events = AlertEngine(builtin_rules()).evaluate(
+        _mk_samples(trace))["events"]
+    burn = [e for e in events if e["rule"] == "slo_burn_rate"]
+    assert [e["state"] for e in burn] == ["firing", "resolved"]
+    fired, resolved = burn
+    # fires only once BOTH windows burn (needs the long window mean to
+    # cross, i.e. well into the collapse), resolves only after the
+    # short window recovers for clear_s
+    assert fired["ts"] > T0 + 20
+    assert resolved["ts"] > T0 + 50
+    assert fired["severity"] == "page"
+    assert fired["value"] > 2.0
+
+
+def test_burn_rate_ignores_short_blip():
+    # a 3-sample dip: the 60s-window burn never crosses 2x
+    trace = [1.0] * 40 + [0.0] * 3 + [1.0] * 40
+    events = AlertEngine(builtin_rules()).evaluate(
+        _mk_samples(trace))["events"]
+    assert not [e for e in events if e["rule"] == "slo_burn_rate"], \
+        "multi-window burn rate must not page on a blip"
+
+
+def test_cooldown_suppresses_refire():
+    rule = AlertRule("flappy", metric="slo_attainment_ratio",
+                     kind="floor",
+                     params={"floor": 0.5, "window_s": 2.0,
+                             "clear_ratio": 1.0},
+                     for_s=0.0, clear_s=0.0, cooldown_s=1000.0)
+    # collapse, recover, collapse again within the cooldown
+    trace = [0.0] * 5 + [1.0] * 5 + [0.0] * 5
+    events = AlertEngine((rule,)).evaluate(_mk_samples(trace))["events"]
+    assert [e["state"] for e in events] == ["firing", "resolved"], \
+        "second collapse is inside cooldown_s and must not re-fire"
+
+
+def test_slope_rule_on_queue_growth():
+    depth = [float(i * 2) for i in range(40)]        # +2/s steady
+    samples = [{"ts": T0 + i, "proc": "p0", "seq": i + 1,
+                "counters": {},
+                "gauges": {"generation_queue_depth": d}}
+               for i, d in enumerate(depth)]
+    events = AlertEngine(builtin_rules()).evaluate(samples)["events"]
+    growth = [e for e in events if e["rule"] == "queue_depth_growth"]
+    assert growth and growth[0]["state"] == "firing"
+    assert growth[0]["value"] > 0.5
+    # flat queue never fires
+    flat = [{"ts": T0 + i, "proc": "p0", "seq": i + 1, "counters": {},
+             "gauges": {"generation_queue_depth": 5.0}}
+            for i in range(40)]
+    assert not AlertEngine(builtin_rules()).evaluate(flat)["events"]
+
+
+def test_floor_rule_guard_requires_traffic():
+    def mk(hit_rate, hits):
+        return [{"ts": T0 + i, "proc": "p0", "seq": i + 1,
+                 "counters": {"prefix_cache_hits_total": h,
+                              "prefix_cache_misses_total": h},
+                 "gauges": {"prefix_cache_hit_rate": hit_rate}}
+                for i, h in enumerate(hits)]
+    # collapsed hit rate WITH traffic (>= 1 lookup/s): fires
+    busy = mk(0.01, [float(i * 10) for i in range(30)])
+    fired = AlertEngine(builtin_rules()).evaluate(busy)["events"]
+    assert any(e["rule"] == "prefix_cache_collapse" for e in fired)
+    # same hit rate with NO traffic: guarded, never fires
+    idle = mk(0.01, [0.0] * 30)
+    assert not AlertEngine(builtin_rules()).evaluate(idle)["events"]
+
+
+def test_builtin_rule_names_match_registry():
+    assert tuple(r.name for r in builtin_rules()) == BUILTIN_ALERTS
+    with pytest.raises(ValueError):
+        AlertRule("bad", metric="x", kind="nonsense")
+
+
+def test_step_emits_metrics_once_and_flight_instant(hist_env):
+    from analytics_zoo_tpu.observability import flight_recorder
+    flight_recorder.clear_ring()
+    engine = AlertEngine(builtin_rules())
+    samples = _mk_samples([1.0] * 20 + [0.0] * 40)
+    reg = get_registry()
+    fired0 = reg.counter("alert_fired_total").value
+    rule0 = reg.counter("alert_fired_slo_burn_rate_total").value
+    engine.step(samples)
+    assert reg.counter("alert_fired_total").value == fired0 + 1
+    assert reg.counter(
+        "alert_fired_slo_burn_rate_total").value == rule0 + 1
+    assert reg.metrics()["alert_active"].value == 1.0
+    ring = [e for e in flight_recorder.ring_contents()
+            if e["kind"] == "alert"]
+    assert ring and ring[0]["rule"] == "slo_burn_rate"
+    # stepping again over the same window must not double-fire
+    engine.step(samples)
+    assert reg.counter("alert_fired_total").value == fired0 + 1
+
+
+# ----------------------------------------------------------------------
+# flight-recorder bundles embed the history tail + active alerts
+# ----------------------------------------------------------------------
+
+def test_flight_bundle_embeds_history_and_alerts(hist_env):
+    from analytics_zoo_tpu.observability import flight_recorder
+    rec = history.get_recorder()
+    assert rec is not None
+    reg = get_registry()
+    g = reg.gauge("slo_attainment_ratio")
+    for i in range(30):
+        g.set(1.0 if i < 10 else 0.0)
+        rec.sample(wall_ts=T0 + i * 3.0)
+    path = flight_recorder.dump("history-test")
+    assert path is not None
+    bundle = json.load(open(path))
+    assert len(bundle["history_tail"]) > 0
+    assert bundle["history_tail"][-1]["proc"] == rec.proc
+    assert "slo_burn_rate" in bundle["alerts_active"], \
+        "active alerts must ride the post-mortem bundle"
+
+
+# ----------------------------------------------------------------------
+# serving endpoint
+# ----------------------------------------------------------------------
+
+def _get(srv, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}{path}", timeout=30) as r:
+            return r.read().decode()
+    except urllib.error.HTTPError as e:     # 4xx still carries JSON
+        return e.read().decode()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.serving.generation import CausalLM
+    model = CausalLM(vocab=31, hidden_size=16, n_head=2, n_block=1,
+                     intermediate_size=32, max_position_len=128)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        jnp.arange(8)[None])["params"]
+    return model, params
+
+
+def test_endpoint_serves_history_and_fleet(hist_env, lm):
+    from analytics_zoo_tpu.serving import ServingServer
+    from analytics_zoo_tpu.serving.distributed import ReplicaRouter
+    from analytics_zoo_tpu.serving.generation import GenerationEngine
+    model, params = lm
+    engines = [GenerationEngine(model, params, max_slots=2,
+                                block_size=8, max_context=64,
+                                registry=MetricsRegistry())
+               for _ in range(2)]
+    router = ReplicaRouter(engines).ensure_started()
+    srv = None
+    try:
+        srv = ServingServer(router=router).start()
+        streams = [router.submit([3, 1, 4, 1, 5 + j],
+                                 max_new_tokens=6) for j in range(4)]
+        assert all(len(s.tokens()) == 6 for s in streams)
+        assert {s.replica_name for s in streams} == \
+            {"replica-0", "replica-1"}
+        body = json.loads(_get(srv, "/metrics/history"))
+        assert body["enabled"] is True and body["fleet"] is False
+        assert body["n_samples"] >= 1          # the forced sample
+        assert body["samples"][-1]["counters"][
+            "metrics_history_samples_total"] >= 1
+        # family + derive params
+        body = json.loads(_get(
+            srv, "/metrics/history?family=generation_&derive=rate"))
+        assert all(n.startswith("generation_") for n in body["names"])
+        assert "series" in body
+        assert json.loads(_get(
+            srv, "/metrics/history?derive=bogus"))["error"]
+        # fleet mode merges the durable logs + live ring
+        fleet = json.loads(_get(srv, "/metrics/history?fleet=1"))
+        assert fleet["fleet"] is True and fleet["n_samples"] >= 1
+        # engine loops recorded through the hot-loop hook
+        deadline = time.monotonic() + 10
+        while (get_registry().metrics()[
+                "metrics_history_samples_total"].value < 3
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert get_registry().metrics()[
+            "metrics_history_samples_total"].value >= 3
+        for e in engines:
+            assert e.decode_compile_count == 1, \
+                "decode recompiled with the recorder armed"
+    finally:
+        if srv is not None:
+            srv.stop()
+        router.stop()
+
+
+def test_endpoint_disarmed_reports_disabled(tmp_path):
+    from analytics_zoo_tpu.serving import ServingServer
+    from analytics_zoo_tpu.serving.streaming import StreamHub
+    prev = OrcaContext.metrics_history_interval_s
+    OrcaContext.metrics_history_interval_s = None
+    history.reset_recorder()
+    hub = StreamHub(str(tmp_path / "hub"), max_backlog=16)
+    srv = None
+    try:
+        srv = ServingServer(stream_hub=hub).start()
+        body = json.loads(_get(srv, "/metrics/history"))
+        assert body["enabled"] is False and body["samples"] == []
+    finally:
+        if srv is not None:
+            srv.stop()
+        hub.close()
+        OrcaContext.metrics_history_interval_s = prev
+        history.reset_recorder()
+
+
+# ----------------------------------------------------------------------
+# crash-durable e2e: SIGKILL'd recorder's history merges; burn-rate
+# fires from the merged trace
+# ----------------------------------------------------------------------
+
+_CHILD_CODE = """
+import os, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from analytics_zoo_tpu.common.context import OrcaContext
+OrcaContext.observability_dir = {obs!r}
+OrcaContext.metrics_history_interval_s = 0.05
+from analytics_zoo_tpu.observability import get_registry
+from analytics_zoo_tpu.observability.history import MetricsRecorder
+reg = get_registry()
+g = reg.gauge("slo_attainment_ratio")
+c = reg.counter("histtest_child_ops_total")
+rec = MetricsRecorder(proc="hist-child", interval_s=0.05)
+t0 = {t0!r}
+# healthy, then a hard SLO collapse: synthetic wall timestamps span
+# the burn-rate windows so the recorded trace alone proves the alert
+for i in range(120):
+    g.set(1.0 if i < 40 else 0.0)
+    c.inc(3)
+    rec.sample(wall_ts=t0 + i)
+print("READY", os.getpid(), flush=True)
+while True:            # keep appending until the SIGKILL lands
+    rec.sample()
+    time.sleep(0.01)
+"""
+
+
+def _spawn(code):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen([sys.executable, "-c", code], cwd=ROOT,
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _wait_ready(proc, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    fd = proc.stdout.fileno()
+    buf = b""
+    while time.monotonic() < deadline:
+        if b"\n" in buf:
+            return buf.split(b"\n", 1)[0].decode()
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"child died rc={proc.returncode}: {proc.stderr.read()}")
+        r, _, _ = select.select([fd], [], [], 0.25)
+        if r:
+            buf += os.read(fd, 4096)
+    raise AssertionError(f"child never signalled READY (got {buf!r})")
+
+
+def test_e2e_sigkilled_history_merges_and_burn_rate_fires(
+        hist_env, lm):
+    """A child process records a degrading SLO trace to its durable
+    sample log and is SIGKILL'd mid-append; the parent (a live routed
+    server) merges the dead process's history into ?fleet=1 and the
+    burn-rate alert fires from the merged trace — while the parent's
+    own engines keep decode_compile_count at 1 with the recorder and
+    alert engine armed."""
+    from analytics_zoo_tpu.serving import ServingServer
+    from analytics_zoo_tpu.serving.distributed import ReplicaRouter
+    from analytics_zoo_tpu.serving.generation import GenerationEngine
+    model, params = lm
+    engines = [GenerationEngine(model, params, max_slots=2,
+                                block_size=8, max_context=64,
+                                registry=MetricsRegistry())
+               for _ in range(2)]
+    router = ReplicaRouter(engines).ensure_started()
+    srv = child = None
+    try:
+        srv = ServingServer(router=router).start()
+        child = _spawn(_CHILD_CODE.format(obs=hist_env, t0=T0))
+        child_pid = int(_wait_ready(child).split()[1])
+        assert child_pid != os.getpid()
+        # SIGKILL mid-append loop: no flush, no close, no goodbye
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+
+        streams = [router.submit([3, 1, 4, 1, 5 + j],
+                                 max_new_tokens=6) for j in range(4)]
+        assert all(len(s.tokens()) == 6 for s in streams)
+        assert {s.replica_name for s in streams} == \
+            {"replica-0", "replica-1"}
+
+        # the dead process's durable log survived and merges
+        reader = HistoryReader(hist_env)
+        assert "hist-child" in reader.procs()
+        merged = reader.read_samples()
+        child_samples = [s for s in merged
+                         if s["proc"] == "hist-child"]
+        assert len(child_samples) >= 120, \
+            "the SIGKILL'd recorder's samples must survive"
+        # ... and the recorded trace alone makes the burn-rate fire
+        verdict = AlertEngine(builtin_rules()).evaluate(child_samples)
+        fired = [e for e in verdict["events"]
+                 if e["rule"] == "slo_burn_rate"
+                 and e["state"] == "firing"]
+        assert fired, "burn rate must fire from the merged history"
+
+        # the fleet endpoint serves the merged view
+        fleet = json.loads(_get(srv, "/metrics/history?fleet=1"))
+        assert "hist-child" in fleet["procs"]
+        assert fleet["n_samples"] >= len(child_samples)
+        # derived counter rate over the dead process's counters
+        fleet = json.loads(_get(
+            srv, "/metrics/history?fleet=1&family=histtest_child_"
+                 "&derive=rate"))
+        rates = fleet["series"]["histtest_child_ops_total"]
+        assert rates and all(abs(r["value"] - 3.0) < 1e-6
+                             for r in rates[:100])
+
+        # zero recompile with the whole plane armed
+        for e in engines:
+            assert e.decode_compile_count == 1
+    finally:
+        if child is not None:
+            if child.poll() is None:
+                child.kill()
+            child.wait(timeout=10)
+            child.stdout.close()
+            child.stderr.close()
+        if srv is not None:
+            srv.stop()
+        router.stop()
+
+
+# ----------------------------------------------------------------------
+# knobs
+# ----------------------------------------------------------------------
+
+def test_history_knobs_validate():
+    assert OrcaContext.metrics_history_interval_s is None
+    assert OrcaContext.metrics_history_max_bytes == 8 * 1024 * 1024
+    with pytest.raises(ValueError):
+        OrcaContext.metrics_history_interval_s = 0
+    with pytest.raises(ValueError):
+        OrcaContext.metrics_history_max_bytes = 16
+    OrcaContext.metrics_history_interval_s = 2.5
+    assert OrcaContext.metrics_history_interval_s == 2.5
+    OrcaContext.metrics_history_interval_s = None
