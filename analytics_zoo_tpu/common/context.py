@@ -71,6 +71,8 @@ class OrcaContextMeta(type):
     _chunked_prefill = False
     _speculative_decoding = False
     _speculative_k = 4
+    _kv_host_tier_bytes = 0
+    _router_phase_aware = False
     _host_input_prefetch = 2
     _decode_tensor_parallel = 0
     _serving_replicas = 0
@@ -602,6 +604,54 @@ class OrcaContextMeta(type):
             raise ValueError(
                 f"speculative_k must be >= 1, got {value}")
         cls._speculative_k = value
+
+    @property
+    def kv_host_tier_bytes(cls):
+        """Host-RAM KV offload tier capacity in bytes for the
+        generation engine's prefix cache
+        (serving/generation/host_tier.py; docs/generation.md "Host
+        tier").  0 (default) = no tier: evicted prefix blocks are
+        dropped, bitwise the pre-tier behavior.  N > 0: radix-tree
+        evictions of refcount-1 blocks spill the block's KV rows (and
+        int8 scales) into a bounded-bytes host LRU, and a later radix
+        miss extending into a host-resident prefix restores the block
+        via a staged async `device_put` instead of recomputing its
+        prefill.  The tier is ADVISORY — a full/corrupt/lost entry
+        only costs a recompute, never correctness.  Effective only
+        with `prefix_caching` on; read at engine construction
+        (`GenerationEngine(kv_host_tier=...)` overrides, accepting a
+        byte count or a shared `HostKVTier` instance)."""
+        return cls._kv_host_tier_bytes
+
+    @kv_host_tier_bytes.setter
+    def kv_host_tier_bytes(cls, value):
+        value = int(value)
+        if value < 0:
+            raise ValueError(
+                "kv_host_tier_bytes must be >= 0 (0 = off)")
+        cls._kv_host_tier_bytes = value
+
+    @property
+    def router_phase_aware(cls):
+        """Prefill/decode phase-aware routing in the `ReplicaRouter`
+        (serving/distributed/router.py; docs/distributed-serving.md
+        "Phase-aware routing").  False (default) keeps pure
+        least-loaded admission.  True (with >= 2 replicas): the first
+        replica is tagged "prefill" and the rest "decode"; each
+        submit is classified by its prefix-match fraction — a
+        prefill-heavy request (long prompt, little cached) prefers the
+        prefill replica, which commits its blocks through the shared
+        host tier (`kv_host_tier_bytes`), and decode-heavy requests
+        prefer decode replicas, which adopt those blocks on lookup —
+        one replica's prefill work becomes every replica's prefix
+        hit.  Scoring stays load-first: a phase mismatch is a
+        penalty, not a hard pin, so a saturated preferred replica
+        never starves traffic.  Read at router construction."""
+        return cls._router_phase_aware
+
+    @router_phase_aware.setter
+    def router_phase_aware(cls, value):
+        cls._router_phase_aware = bool(value)
 
     @property
     def decode_tensor_parallel(cls):

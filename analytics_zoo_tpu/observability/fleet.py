@@ -340,6 +340,34 @@ class FleetAggregator:
                               "pid": pid, "tid": tid,
                               "args": {"name": f"requests:"
                                                f"{tid - req_tid_base}"}})
+            if src["kind"] == "live" and src["name"] == self.local_name:
+                # the host-tier DMA ring is process-local, so only the
+                # local source can vouch for these copies
+                dma_tid_base = req_tid_base + 16
+                dma_tids: Dict[str, int] = {}
+                try:
+                    from analytics_zoo_tpu.serving.generation.host_tier \
+                        import dma_events
+                    for e in dma_events():
+                        dur = float(e.get("dur_s", 0.0) or 0.0)
+                        lane = str(e.get("lane", "engine"))
+                        tid = dma_tids.setdefault(
+                            lane, dma_tid_base + len(dma_tids))
+                        events.append({
+                            "ph": "X",
+                            "name": str(e.get("kind", "host_copy")),
+                            "cat": "kv_dma", "pid": pid, "tid": tid,
+                            "ts": _us(float(e["ts"]) - dur),
+                            "dur": max(1, _us(dur)),
+                            "args": {"nbytes": int(e.get("nbytes", 0)),
+                                     "lane": lane}})
+                except Exception:
+                    pass   # host tier absent/broken: no DMA lane
+                for lane, tid in sorted(dma_tids.items(),
+                                        key=lambda kv: kv[1]):
+                    metas.append({"ph": "M", "name": "thread_name",
+                                  "pid": pid, "tid": tid,
+                                  "args": {"name": f"kv_dma:{lane}"}})
 
         # flow events: one flow per trace_id that touches >= 2 pids
         for tr, points in sorted(flows.items()):

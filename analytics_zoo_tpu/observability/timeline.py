@@ -31,6 +31,7 @@ Row layout (pids are stable so saved traces diff cleanly):
 | 4 `events`    | flight-ring instants |
 | 5 `memory`    | ``memory_bytes`` + provider counter tracks |
 | 6 `replicas`  | one tid per router replica: dispatch instants (which replica served which request — serving/distributed/router.py) |
+| 7 `kv_dma`    | one tid per engine/replica lane: ``host_spill`` / ``host_restore`` X slices for host-tier KV copies (serving/generation/host_tier.py) |
 
 Serving: `ServingServer` exposes the export as ``GET /timeline``
 (forcing a fresh memory sample first), and every flight-recorder
@@ -49,6 +50,7 @@ PID_REQUESTS = 3
 PID_EVENTS = 4
 PID_MEMORY = 5
 PID_REPLICAS = 6
+PID_KV_DMA = 7
 
 _PROCESS_NAMES = {
     PID_SPANS: "spans",
@@ -57,6 +59,7 @@ _PROCESS_NAMES = {
     PID_EVENTS: "events",
     PID_MEMORY: "memory",
     PID_REPLICAS: "replicas",
+    PID_KV_DMA: "kv_dma",
 }
 
 #: total event cap per export — /timeline must stay a bounded payload
@@ -209,6 +212,33 @@ def _replica_events(requests_n: Optional[int]
     return events, {tid: name for name, tid in tids.items()}
 
 
+def _kv_dma_events(dma_n: Optional[int]
+                   ) -> (List[Dict[str, Any]], Dict[int, str]):
+    """Host-tier KV copies (pid 7): one X slice per spill/restore,
+    one tid per engine/replica lane — the DMA-hiding story of the
+    hierarchical KV cache drawn next to the decode rounds it overlaps
+    (serving/generation/host_tier.py's module ring)."""
+    from analytics_zoo_tpu.serving.generation.host_tier import (
+        dma_events,
+    )
+
+    events: List[Dict[str, Any]] = []
+    tids: Dict[str, int] = {}
+    for e in dma_events(dma_n):
+        lane = str(e.get("lane", "engine"))
+        tid = tids.setdefault(lane, len(tids) + 1)
+        dur = float(e.get("dur_s", 0.0))
+        events.append({
+            "ph": "X", "name": e.get("kind", "host_copy"),
+            "cat": "kv_dma", "pid": PID_KV_DMA, "tid": tid,
+            "ts": _us(float(e["ts"]) - dur),
+            "dur": max(0, _us(dur)),
+            "args": {"nbytes": int(e.get("nbytes", 0)),
+                     "lane": lane},
+        })
+    return events, {tid: lane for lane, tid in tids.items()}
+
+
 def _ring_events(ring_n: Optional[int]) -> List[Dict[str, Any]]:
     from analytics_zoo_tpu.observability.flight_recorder import (
         ring_contents,
@@ -278,6 +308,7 @@ def export_timeline(spans_n: int = 512,
     good_ev, good_tids = _section(_goodput_events, steps_n)
     req_ev, req_tids = _section(_request_events, requests_n)
     repl_ev, repl_tids = _section(_replica_events, requests_n)
+    dma_ev, dma_tids = _section(_kv_dma_events, None)
     try:
         ring_ev = _ring_events(ring_n)
     except Exception:
@@ -288,8 +319,8 @@ def export_timeline(spans_n: int = 512,
         mem_ev = []
 
     used_pids = set()
-    for ev_list in (span_ev, good_ev, req_ev, repl_ev, ring_ev,
-                    mem_ev):
+    for ev_list in (span_ev, good_ev, req_ev, repl_ev, dma_ev,
+                    ring_ev, mem_ev):
         events.extend(ev_list)
         used_pids.update(e["pid"] for e in ev_list)
 
@@ -304,6 +335,8 @@ def export_timeline(spans_n: int = 512,
         metas.append(_meta(PID_REQUESTS, tid, "thread_name", name))
     for tid, name in sorted(repl_tids.items()):
         metas.append(_meta(PID_REPLICAS, tid, "thread_name", name))
+    for tid, name in sorted(dma_tids.items()):
+        metas.append(_meta(PID_KV_DMA, tid, "thread_name", name))
     if any(e["pid"] == PID_EVENTS for e in ring_ev):
         metas.append(_meta(PID_EVENTS, 1, "thread_name",
                            "flight_ring"))

@@ -39,6 +39,14 @@ site                        threaded into
                             (a raise evicts nothing — the drafted
                             lanes fall back to single-token decode
                             for that round)
+``generation.host_spill``   host-tier spill of an evicted prefix
+                            block (a raise skips the spill; the
+                            eviction proceeds unchanged)
+``generation.host_restore`` host-tier fetch before a restore (a
+                            raise or "nan" marks the entry corrupt:
+                            it is dropped, counted in
+                            kv_host_restore_failed_total, and the
+                            lane recomputes the prefix)
 ``serving.admission``       AdmissionCore queue/SLO check (every door)
 ``admission.quota``         AdmissionCore per-tenant quota charge
 ``registry.swap``           ModelRegistry.hot_swap, before repointing
@@ -90,6 +98,7 @@ KNOWN_SITES = (
     "checkpoint.after_commit", "checkpoint.load",
     "generation.decode", "generation.prefix_lookup",
     "generation.spec_verify",
+    "generation.host_spill", "generation.host_restore",
     "serving.admission", "admission.quota", "registry.swap",
     "router.dispatch",
     "stream.append", "stream.fsync", "stream.lease", "stream.ack",

@@ -20,6 +20,18 @@ When no replica admits, `submit` raises `QueueFull` carrying the
 smallest per-replica `retry_after_s` — the HTTP layer turns it into a
 503 with Retry-After, same as the single-engine shed path.
 
+Phase-aware routing (`OrcaContext.router_phase_aware`, default off —
+docs/distributed-serving.md): with >= 2 replicas, replica-0 is tagged
+``prefill`` and the rest ``decode``; every submit is classified by
+its prefix-match fraction against the replicas' radix trees and the
+shared host tier (serving/generation/host_tier.py) — prefill-heavy
+requests (long prompt, little cached) prefer the prefill replica,
+whose prefix cache write-through commits blocks to the host tier,
+and decode-heavy requests prefer decode replicas, which adopt those
+blocks on lookup.  The phase preference is a score PENALTY, not a
+pin: load still dominates, so a saturated preferred replica sheds to
+the other phase instead of queueing forever.
+
 A request is sticky: its stream consumes from the replica that
 admitted it for the stream's whole lifetime.  The one exception is
 replica death mid-stream — `RouterStream` re-queues the request ONCE
@@ -58,13 +70,15 @@ REPLICA_STATES = ("active", "draining", "dead")
 class _Replica:
     """One engine plus its router-side state."""
 
-    __slots__ = ("name", "engine", "state", "served")
+    __slots__ = ("name", "engine", "state", "served", "phase")
 
     def __init__(self, name: str, engine: GenerationEngine):
         self.name = name
         self.engine = engine
         self.state = "active"
         self.served = 0
+        #: "prefill" / "decode" under phase-aware routing, else None
+        self.phase: Optional[str] = None
         # each replica loop spools under its own name, so the fleet
         # aggregator can tell replica-0's last snapshot from replica-1's
         engine.spool_name = name
@@ -160,9 +174,15 @@ class ReplicaRouter:
     `stop()`, `retry_after_s()`, plus `stats()` for the per-replica
     /stats rows."""
 
+    #: load-score penalty for a phase-mismatched replica under
+    #: phase-aware routing — bigger than any occupancy/slot term but
+    #: comparable to a few queued requests, so load still wins when
+    #: the preferred replica is saturated
+    PHASE_PENALTY = 8.0
+
     def __init__(self, engines: List[GenerationEngine], *,
                  registry=None, occupancy_weight: float = 4.0,
-                 max_requeues: int = 1):
+                 max_requeues: int = 1, phase_aware="auto"):
         if not engines:
             raise ValueError("ReplicaRouter needs at least one engine")
         regs = {id(e.registry) for e in engines}
@@ -217,6 +237,31 @@ class ReplicaRouter:
             reg.counter("replica_" + r.name.replace("-", "_")
                         + "_served_total",
                         help=f"requests dispatched to {r.name}")
+        #: prefill/decode disaggregation — "auto" reads
+        #: OrcaContext.router_phase_aware; arms only with >= 2
+        #: replicas (one replica has no phases to split)
+        if phase_aware == "auto":
+            from analytics_zoo_tpu.common.context import OrcaContext
+            phase_aware = OrcaContext.router_phase_aware
+        self.phase_aware = bool(phase_aware) and len(self.replicas) >= 2
+        self._c_phase_prefill = reg.counter(
+            "router_phase_prefill_total",
+            help="submits classified prefill-heavy (phase-aware "
+                 "routing; 0 while router_phase_aware is off)")
+        self._c_phase_decode = reg.counter(
+            "router_phase_decode_total",
+            help="submits classified decode-heavy (phase-aware "
+                 "routing; 0 while router_phase_aware is off)")
+        if self.phase_aware:
+            self.replicas[0].phase = "prefill"
+            for r in self.replicas[1:]:
+                r.phase = "decode"
+            pc = self.replicas[0].engine.prefix_cache
+            if pc is not None and pc.host_tier is not None:
+                # the prefill replica publishes its committed blocks
+                # host-side immediately, so decode replicas sharing
+                # the tier adopt them without waiting for an eviction
+                pc.host_write_through = True
 
     # -- construction --------------------------------------------------
 
@@ -235,6 +280,16 @@ class ReplicaRouter:
             raise ValueError(
                 f"n_replicas must be >= 1, got {n} (set "
                 "OrcaContext.serving_replicas or pass n_replicas)")
+        if "kv_host_tier" not in engine_kwargs \
+                and OrcaContext.kv_host_tier_bytes > 0:
+            # ONE tier shared by every replica — the disaggregation
+            # transport: a per-replica tier would privatize spills and
+            # decode replicas could never adopt prefill-replica blocks
+            from analytics_zoo_tpu.serving.generation.host_tier import (
+                HostKVTier,
+            )
+            engine_kwargs["kv_host_tier"] = HostKVTier(
+                OrcaContext.kv_host_tier_bytes)
         engines = []
         for _ in range(n):
             eng = GenerationEngine(model, params,
@@ -299,17 +354,51 @@ class ReplicaRouter:
         return [r for r in self.replicas
                 if r.state == "active" and self._alive(r)]
 
-    def _ordered(self, candidates: List[_Replica]) -> List[_Replica]:
+    def _classify(self, prompt) -> str:
+        """Phase of one request: "decode" when most of its prompt is
+        already cached somewhere (any replica's radix tree or the
+        shared host tier) or the prompt is short; "prefill" when the
+        fleet would have to compute most of it.  Read-only probes —
+        no reference pinned, no hit/miss counter ticked."""
+        tokens = list(prompt)
+        best = 0
+        for r in self.replicas:
+            pc = r.engine.prefix_cache
+            if pc is None:
+                continue
+            try:
+                best = max(best, pc.peek(tokens))
+                if pc.host_tier is not None:
+                    best = max(best,
+                               pc.host_tier.match_tokens(tokens))
+            except Exception:
+                continue
+        bs = self.replicas[0].engine.cache.block_size
+        if len(tokens) < 2 * bs or 2 * best >= len(tokens):
+            return "decode"
+        return "prefill"
+
+    def _ordered(self, candidates: List[_Replica],
+                 phase: Optional[str] = None) -> List[_Replica]:
         """Ascending load score; equal scores rotate round-robin so an
-        idle fleet does not pile onto replica-0."""
+        idle fleet does not pile onto replica-0.  Under phase-aware
+        routing a phase-mismatched replica pays `PHASE_PENALTY` on
+        top of its load — a preference, never a pin."""
         n = len(self.replicas)
         rr = self._rr
         self._rr += 1
         idx = {id(r): i for i, r in enumerate(self.replicas)}
+
+        def score(r: _Replica) -> float:
+            s = r.load_score(self.occupancy_weight)
+            if phase is not None and r.phase is not None \
+                    and r.phase != phase:
+                s += self.PHASE_PENALTY
+            return s
+
         return sorted(
             candidates,
-            key=lambda r: (r.load_score(self.occupancy_weight),
-                           (idx[id(r)] - rr) % n))
+            key=lambda r: (score(r), (idx[id(r)] - rr) % n))
 
     def _dispatched(self, replica: _Replica, request_id: str) -> None:
         replica.served += 1
@@ -357,8 +446,14 @@ class ReplicaRouter:
                       temperature=temperature, top_k=top_k,
                       eos_id=eos_id, stream_timeout=stream_timeout,
                       tenant=tenant, request_class=request_class)
+        phase = None
+        if self.phase_aware:
+            phase = self._classify(prompt)
+            (self._c_phase_prefill if phase == "prefill"
+             else self._c_phase_decode).inc()
         with self._lock:
-            candidates = self._ordered(self._candidates())
+            candidates = self._ordered(self._candidates(),
+                                       phase=phase)
         if not candidates:
             self._c_sheds.inc()
             raise QueueFull(
@@ -499,6 +594,7 @@ class ReplicaRouter:
                 "served": r.served,
                 "tokens_total": int(eng._c_tokens.value),
                 "tensor_parallel": getattr(eng, "tensor_parallel", 0),
+                "phase": r.phase,
             })
         return {
             "replicas": rows,

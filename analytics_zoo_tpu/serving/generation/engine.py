@@ -89,6 +89,10 @@ from analytics_zoo_tpu.resilience.faults import (
     fault_point,
 )
 from analytics_zoo_tpu.serving.generation.speculation import Speculator
+from analytics_zoo_tpu.serving.generation.host_tier import (
+    HostKVTier,
+    record_dma,
+)
 from analytics_zoo_tpu.serving.generation.prefix_cache import PrefixCache
 from analytics_zoo_tpu.serving.generation.sampling import sample_tokens
 from analytics_zoo_tpu.serving.generation.scheduler import (
@@ -171,7 +175,7 @@ class GenerationEngine:
                  slo_shed_min_queue: Optional[int] = None,
                  prefix_caching="auto", chunked_prefill="auto",
                  tensor_parallel="auto", speculative_decoding="auto",
-                 speculative_k="auto"):
+                 speculative_k="auto", kv_host_tier="auto"):
         if model.max_position_len < max_context:
             raise ValueError(
                 f"model.max_position_len {model.max_position_len} < "
@@ -272,8 +276,29 @@ class GenerationEngine:
                 f"max_context {max_context}")
         reg = registry if registry is not None else get_registry()
         self.registry = reg
-        self.prefix_cache = (PrefixCache(self.cache, registry=reg)
+        #: host-RAM KV offload tier (host_tier.py) — "auto" reads
+        #: OrcaContext.kv_host_tier_bytes; 0 (the default) keeps the
+        #: eviction path bitwise untouched.  Accepts a byte capacity
+        #: OR an existing HostKVTier (the router shares ONE tier
+        #: across replicas for disaggregation).  Needs the prefix
+        #: cache; disabled under tensor parallelism (a head-sharded
+        #: pool has no single-host slab to spill).
+        if kv_host_tier == "auto":
+            kv_host_tier = OrcaContext.kv_host_tier_bytes
+        if isinstance(kv_host_tier, HostKVTier):
+            host_tier = kv_host_tier
+        else:
+            cap = int(kv_host_tier or 0)
+            host_tier = (HostKVTier(cap, registry=reg) if cap > 0
+                         else None)
+        self.host_tier = (host_tier if self.prefix_caching
+                          and self._tp is None else None)
+        self.prefix_cache = (PrefixCache(self.cache, registry=reg,
+                                         host_tier=self.host_tier)
                              if self.prefix_caching else None)
+        if self.prefix_cache is not None and self.host_tier is not None:
+            self.prefix_cache.owner = self
+            self.prefix_cache.restore_writer = self._host_restore_write
         self.scheduler = SlotScheduler(
             self.cache, max_slots, max_context, prefill_buckets,
             prefill_token_budget, prefix_cache=self.prefix_cache,
@@ -638,6 +663,20 @@ class GenerationEngine:
                     kv_scale, srows, dst * bs, axis=2)
             return kv, kv_scale
 
+        def restore_block(kv, kv_scale, dst, rows, srows):
+            # host-tier restore: land one host slab's token slots
+            # (rows [L, 2, bs, h, d] in pool dtype, srows [L, 2, bs]
+            # scales — a 1-element placeholder unquantized) into pool
+            # block `dst`.  A separate single-shape program, warmed in
+            # warmup(), never touching the decode step.
+            kv = jax.lax.dynamic_update_slice_in_dim(
+                kv, rows.astype(kv.dtype), dst * bs, axis=2)
+            if quantized:
+                kv_scale = jax.lax.dynamic_update_slice_in_dim(
+                    kv_scale, srows.astype(kv_scale.dtype),
+                    dst * bs, axis=2)
+            return kv, kv_scale
+
         if self._tp is not None:
             # identical step functions; only placement differs — the
             # wrapper pins out_shardings (pool head-sharded, scales/
@@ -648,6 +687,7 @@ class GenerationEngine:
                                                 donate, 4)
             self._copy_block_jit = self._tp.jit_step(
                 copy_block, ((0, 1) if donate else ()), 2)
+            self._restore_block_jit = None   # host tier off under TP
             self._decode_jit = self._tp.jit_step(decode, donate, 4)
             self._spec_jit = self._tp.jit_step(spec_verify, donate, 3)
         else:
@@ -656,6 +696,9 @@ class GenerationEngine:
                                       donate_argnums=donate)
             self._copy_block_jit = jax.jit(
                 copy_block,
+                donate_argnums=((0, 1) if donate else ()))
+            self._restore_block_jit = jax.jit(
+                restore_block,
                 donate_argnums=((0, 1) if donate else ()))
             self._decode_jit = jax.jit(decode, donate_argnums=donate)
             self._spec_jit = jax.jit(spec_verify,
@@ -720,6 +763,21 @@ class GenerationEngine:
                     jnp.int32(0))
                 self._store_kv_state(kv, scl)
                 self._goodput_warm.add("copy")
+            if self.host_tier is not None \
+                    and self._restore_block_jit is not None:
+                # the host-restore program (dst=null block: harmless)
+                bs = self.cache.block_size
+                kvs = self.cache.kv.shape
+                rows = jnp.zeros((kvs[0], 2, bs) + kvs[3:],
+                                 self.cache.kv.dtype)
+                srows = (jnp.zeros((kvs[0], 2, bs), jnp.float32)
+                         if self._quantized
+                         else jnp.zeros((1,), jnp.float32))
+                kv, scl = self._restore_block_jit(
+                    self.cache.kv, self._kv_scale, jnp.int32(0),
+                    rows, srows)
+                self._store_kv_state(kv, scl)
+                self._goodput_warm.add("host_restore")
             S = self.max_slots
             kv, scl, _, _ = self._decode_jit(
                 self.params, self.cache.kv, self._kv_scale,
@@ -988,6 +1046,64 @@ class GenerationEngine:
             self._emit(seq, nxt)
         rec.end()
 
+    # ------------------------------------------------------------------
+    # host-tier restore (the device half — prefix_cache.restore calls
+    # back through `restore_writer`)
+    # ------------------------------------------------------------------
+
+    def _host_restore_write(self, block: int, entry) -> bool:
+        """Land one host-tier entry's KV rows in pool block `block`.
+        Uses the slab staged by `_stage_host_restores` when the race
+        was won (the device_put already overlapped the previous decode
+        round), falling back to a synchronous transfer otherwise.
+        Returns False on any mismatch — the caller recomputes."""
+        if self._restore_block_jit is None:
+            return False
+        t0 = now()
+        rows = entry.staged_kv
+        if rows is None:
+            rows = jnp.asarray(entry.kv)
+        if self._quantized:
+            srows = entry.staged_scale
+            if srows is None:
+                if entry.scale is None:
+                    return False
+                srows = jnp.asarray(entry.scale)
+        else:
+            srows = jnp.zeros((1,), jnp.float32)
+        kv, scl = self._restore_block_jit(
+            self.cache.kv, self._kv_scale, jnp.int32(block), rows,
+            srows)
+        self._store_kv_state(kv, scl)
+        entry.staged_kv = None
+        entry.staged_scale = None
+        record_dma("host_restore", now() - t0, entry.nbytes,
+                   self.spool_name)
+        return True
+
+    def _stage_host_restores(self) -> None:
+        """Double-buffer half of the host tier: start the async
+        `device_put` of host-resident prefix extensions for the
+        waiting heads BEFORE admission, so the host→device DMA hides
+        inside the decode dispatch already in flight.  A staged entry
+        that loses the race to an eviction is refetched as a miss
+        (lossless recompute)."""
+        tier = self.host_tier
+        if tier is None or not self.scheduler.waiting \
+                or self.prefix_cache is None:
+            return
+        device = None
+        leaf = jax.tree_util.tree_leaves(self.params)[0]
+        if getattr(leaf, "committed", False):
+            device = next(iter(leaf.devices()))
+        for seq in list(self.scheduler.waiting)[:4]:
+            ctx = seq.prompt + seq.generated
+            try:
+                tier.stage_prefix(ctx, self.prefix_cache.peek(ctx),
+                                  device=device)
+            except Exception:
+                return   # advisory: staging must never block a round
+
     def _apply_cow(self) -> None:
         """Materialize the scheduler's copy-on-write decisions: copy
         each shared source block into the fresh exclusive block the
@@ -1224,6 +1340,8 @@ class GenerationEngine:
         with self._lock:
             did = False
             spec_budget = self.scheduler.prefill_token_budget
+            if self.host_tier is not None:
+                self._stage_host_restores()
             admitted = self.scheduler.admit()
             if self._use_chunks:
                 chunked, spec_budget = self._prefill_round()

@@ -41,12 +41,23 @@ metric index, docs/generation.md).
 `lookup` is also a fault-injection site (`generation.prefix_lookup`,
 resilience/faults.py): a "raise" there must surface as a failed
 admission, never a corrupted tree.
+
+Host tier (host_tier.py, `OrcaContext.kv_host_tier_bytes`): with a
+`HostKVTier` attached, `evict` copies each victim's KV rows to host
+RAM before freeing the block, and `restore` extends a device radix
+match with host-resident blocks — allocating a fresh pool block per
+entry and delegating the device write to the engine's
+`restore_writer`.  Both directions are advisory: any failure leaves
+the tree exactly as the no-tier path would, and the lane recomputes.
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
+from analytics_zoo_tpu.observability import now
 from analytics_zoo_tpu.resilience.faults import fault_point
 from analytics_zoo_tpu.serving.generation.kv_cache import PagedKVCache
 
@@ -71,10 +82,28 @@ class PrefixCache:
     to committed KV pool blocks.  Host-side only, engine-lock
     serialized like the scheduler (no locking here)."""
 
-    def __init__(self, cache: PagedKVCache, registry=None):
+    def __init__(self, cache: PagedKVCache, registry=None,
+                 host_tier=None):
         self.cache = cache
         self.allocator = cache.allocator
         self.block_size = cache.block_size
+        #: host-RAM spill tier (host_tier.HostKVTier) — None keeps
+        #: the legacy eviction path bitwise untouched
+        self.host_tier = host_tier
+        if host_tier is not None:
+            host_tier.bind_geometry(cache)
+        #: device-write callback for restores, set by the engine:
+        #: ``restore_writer(block, entry) -> bool`` lands a host
+        #: entry's rows in pool block `block` (False = fall back)
+        self.restore_writer = None
+        #: when True (the router's prefill replica), `commit` ALSO
+        #: copies newly-inserted blocks to the host tier so decode
+        #: replicas sharing it adopt them without waiting for an
+        #: eviction
+        self.host_write_through = False
+        #: DMA-lane label for the timeline — the engine points this at
+        #: itself so spills stamp the replica's spool name
+        self.owner = None
         self._root = _Node((), -1, None)
         self._n_blocks = 0
         #: monotonic use counter — LRU recency without wall time
@@ -172,6 +201,11 @@ class PrefixCache:
                 node.children[chunk] = child
                 self.allocator.share([child.block])
                 self._n_blocks += 1
+                if self.host_write_through and self.host_tier is not None:
+                    # disaggregation write-through: publish the fresh
+                    # block host-side NOW so decode replicas sharing
+                    # the tier adopt it (advisory, like any spill)
+                    self._spill_block(child)
             elif child.block != table[j]:
                 # duplicate prefill of an already-cached chunk: adopt
                 # the cached block (contents are the KV of the same
@@ -182,6 +216,117 @@ class PrefixCache:
             child.last_use = self._clock
             node = child
         return table
+
+    def peek(self, tokens: Sequence[int]) -> int:
+        """Length (in tokens) of the longest cached prefix of
+        `tokens`, capped like `lookup` — but READ-ONLY: no reference
+        pinned, no counters ticked, no LRU touch.  The router's phase
+        classifier and the engine's restore pre-stager call this on
+        paths that must not perturb cache accounting."""
+        bs = self.block_size
+        usable = (len(tokens) - 1) // bs
+        node = self._root
+        matched = 0
+        for j in range(usable):
+            child = node.children.get(
+                tuple(int(t) for t in tokens[j * bs:(j + 1) * bs]))
+            if child is None:
+                break
+            matched += 1
+            node = child
+        return matched * bs
+
+    # ------------------------------------------------------------------
+    # host tier (spill on evict, restore on miss) — all advisory
+    # ------------------------------------------------------------------
+
+    def _key_for(self, node: _Node) -> Tuple[int, ...]:
+        """The full token-id prefix `node` terminates (root→node chunk
+        concatenation) — the engine-independent host-tier key."""
+        chunks: List[Tuple[int, ...]] = []
+        while node is not self._root:
+            chunks.append(node.chunk)
+            node = node.parent
+        out: List[int] = []
+        for chunk in reversed(chunks):
+            out.extend(chunk)
+        return tuple(out)
+
+    def _spill_block(self, victim: _Node) -> None:
+        """Copy one tree block's KV rows (and int8 scales) to the host
+        tier.  Advisory: any failure — full tier, injected fault,
+        device read error — is swallowed and only costs a future
+        restore."""
+        tier = self.host_tier
+        if tier is None or tier.capacity_bytes <= 0:
+            return
+        bs = self.block_size
+        blk = victim.block
+        try:
+            t0 = now()
+            kv_np = np.asarray(
+                self.cache.kv[:, :, blk * bs:(blk + 1) * bs])
+            scale_np = (np.asarray(
+                self.cache.kv_scale[:, :, blk * bs:(blk + 1) * bs])
+                if self.cache.kv_scale is not None else None)
+            tier.put(self._key_for(victim), kv_np, scale_np,
+                     dur_s=now() - t0,
+                     lane=getattr(self.owner, "spool_name", "engine"))
+        except Exception:
+            pass
+
+    def restore(self, tokens: Sequence[int], blocks: List[int],
+                n_matched: int) -> Tuple[List[int], int]:
+        """Extend a device radix match with host-resident blocks: for
+        each tier entry continuing the matched prefix, allocate a pool
+        block, let the engine's `restore_writer` land the rows, and
+        insert the node exactly as a commit would — the caller ends
+        with one pinned reference per block (alloc) and the tree with
+        its own (share), identical to a device hit.  Stops at the
+        first miss/failed restore, freeing that failed block: a
+        partial extension is fine, the lane prefills the rest (the
+        tier is advisory).  Returns the extended (blocks, matched
+        token count)."""
+        tier = self.host_tier
+        if tier is None or self.restore_writer is None:
+            return blocks, n_matched
+        bs = self.block_size
+        usable = (len(tokens) - 1) // bs
+        blocks = list(blocks)
+        j = n_matched // bs
+        node = self._root
+        for i in range(j):
+            node = node.children[
+                tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])]
+        while j < usable:
+            chunk = tuple(
+                int(t) for t in tokens[j * bs:(j + 1) * bs])
+            entry = tier.fetch(tokens[:(j + 1) * bs])
+            if entry is None:
+                break
+            got = self.allocator.alloc(1)   # no evict: a restore must
+            if got is None:                 # never churn live entries
+                break
+            blk = got[0]
+            ok = False
+            try:
+                ok = bool(self.restore_writer(blk, entry))
+            except Exception:
+                ok = False
+            if not ok:
+                self.allocator.free([blk])
+                break
+            child = _Node(chunk, blk, node)
+            node.children[chunk] = child
+            child.last_use = self._clock
+            self.allocator.share([blk])     # tree ref; alloc ref is
+            self._n_blocks += 1             # the caller's pin
+            blocks.append(blk)
+            self._c_hit_tokens.inc(bs)
+            tier.count_restored()
+            node = child
+            j += 1
+        return blocks, j * bs
 
     # ------------------------------------------------------------------
 
@@ -209,6 +354,10 @@ class PrefixCache:
             if not leaves:
                 break
             victim = min(leaves, key=lambda n: n.last_use)
+            if self.host_tier is not None:
+                # spill BEFORE the free: once the block returns to the
+                # pool its rows may be overwritten any time
+                self._spill_block(victim)
             del victim.parent.children[victim.chunk]
             self.allocator.free([victim.block])
             self._n_blocks -= 1

@@ -361,6 +361,14 @@ class SlotScheduler:
             if self.prefix_cache is not None:
                 ctx = seq.prompt + seq.generated
                 cached_blocks, n_cached = self.prefix_cache.lookup(ctx)
+                if self.prefix_cache.host_tier is not None:
+                    # host-tier extension of the device match: each
+                    # restored block joins the table with the same
+                    # refcounts as a device hit; a failed restore just
+                    # shortens the match (the lane prefills the rest)
+                    cached_blocks, n_cached = \
+                        self.prefix_cache.restore(ctx, cached_blocks,
+                                                  n_cached)
             if not self.chunk_mode:
                 bucket = self.bucket_for(seq.context_len - n_cached)
                 if admitted and bucket > budget:

@@ -1760,6 +1760,254 @@ def router_metrics(n_requests: int = 16, slots: int = 4,
     return out
 
 
+def host_tier_metrics(slots: int = 4, seed: int = 3):
+    """Hierarchical KV cache window (PR 18): a repeated-prefix working
+    set LARGER than the device block pool, host tier on vs device-only
+    on the SAME traffic, plus the phase-routing disaggregation pair.
+
+    Working set: 6 distinct 128-token system prompts (48 blocks of
+    prefix at block_size 16) against a 24-block device pool — the
+    radix tree churns, so a device-only engine re-misses prefixes that
+    are still hot.  The tier engine runs the WHOLE armed stack (host
+    tier + prefix caching + chunked prefill + int8 KV + speculative
+    decoding + SLO judging + memory sampler + watchdog).  Hard gates:
+    effective hit rate AND tokens/s strictly above the device-only
+    baseline, TTFT p50 with hits-from-host <= the recompute path's,
+    every request completes in full (zero acked loss), and
+    decode_compiles == 1 with everything armed.
+
+    Disaggregation pair: the same repeated-prefix traffic through a
+    2-replica router, phase-aware (prefill replica write-through to
+    ONE shared tier, decode replicas adopt) vs phase-blind over the
+    same shared tier.  The hit-token gate (aware > blind, proven by
+    the per-replica `prefix_cache_hit_tokens_total` counters plus the
+    shared tier's `kv_host_restored_total`) runs everywhere; the
+    tokens/s gate arms only with >= 2 accelerator devices, recorded
+    with the honest skipped marker otherwise (the router window's
+    contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.common.context import OrcaContext
+    from analytics_zoo_tpu.observability import request_log
+    from analytics_zoo_tpu.observability.registry import MetricsRegistry
+    from analytics_zoo_tpu.serving.distributed import ReplicaRouter
+    from analytics_zoo_tpu.serving.generation import CausalLM
+    from analytics_zoo_tpu.serving.generation.host_tier import (
+        HostKVTier,
+        dma_events,
+        reset_dma,
+    )
+
+    model = CausalLM(vocab=512, hidden_size=128, n_head=4, n_block=2,
+                     intermediate_size=512, max_position_len=1024)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        jnp.arange(8)[None])["params"]
+    rng = np.random.default_rng(seed)
+    prefixes = [list(rng.integers(0, 512, 128)) for _ in range(6)]
+    # two passes over every prefix with distinct tails: pass 1 warms
+    # (and churns) the caches, pass 2 is the timed revisit
+    def make_reqs():
+        return [(p + list(rng.integers(0, 512, 16)), 8)
+                for p in prefixes for _ in range(2)]
+
+    prev_slo = OrcaContext.slo_targets
+    prev_wd = OrcaContext.watchdog_deadline_s
+    prev_mem = OrcaContext.memory_sample_interval_s
+    OrcaContext.slo_targets = {"ttft_s": 60.0, "e2e_s": 600.0}
+    OrcaContext.watchdog_deadline_s = 600.0
+    OrcaContext.memory_sample_interval_s = 0.0
+    try:
+        def run_tier(tier_bytes: int):
+            e = make_engine(model, params, slots=slots,
+                            num_blocks=24,
+                            cache_dtype=jnp.float16,
+                            kv_quantization="int8",
+                            prefix_caching=True,
+                            chunked_prefill=True,
+                            speculative_decoding=True,
+                            kv_host_tier=tier_bytes)
+            warm = [e.submit(p, max_new_tokens=n)
+                    for p, n in make_reqs()]
+            e.run_until_idle()
+            for s in warm:
+                got = len(s.tokens())
+                if got != 8:
+                    raise RuntimeError(
+                        f"warm request lost tokens: {got}/8")
+            hit0 = int(e.prefix_cache._c_hit_tokens.value)
+            reqs = make_reqs()
+            t0 = time.monotonic()
+            streams = [e.submit(p, max_new_tokens=n) for p, n in reqs]
+            e.run_until_idle()
+            wall = time.monotonic() - t0
+            tokens = 0
+            ttfts = []
+            for s in streams:
+                out = s.tokens()
+                if len(out) != 8:
+                    raise RuntimeError(
+                        f"request {s.request_id} lost tokens "
+                        f"({len(out)}/8) — acked loss")
+                tokens += len(out)
+                rec = request_log.get(s.request_id)
+                if rec and rec.get("ttft_s") is not None:
+                    ttfts.append(rec["ttft_s"])
+            hit_tokens = int(e.prefix_cache._c_hit_tokens.value) - hit0
+            prompt_tokens = sum(len(p) for p, _n in reqs)
+            if e.decode_compile_count != 1:
+                raise RuntimeError(
+                    f"decode compiled {e.decode_compile_count}x with "
+                    "host tier + prefix + chunked + int8 + speculation "
+                    "+ full telemetry armed")
+            if e.watchdog is None:
+                raise RuntimeError("watchdog not armed")
+            ttft_p50 = (float(np.percentile(ttfts, 50)) * 1e3
+                        if ttfts else 0.0)
+            return (e, tokens / wall, hit_tokens / prompt_tokens,
+                    ttft_p50)
+
+        reset_dma()
+        eng_ht, ht_tput, ht_hit, ht_ttft = run_tier(64 << 20)
+        eng_off, off_tput, off_hit, off_ttft = run_tier(0)
+    finally:
+        OrcaContext.slo_targets = prev_slo
+        OrcaContext.watchdog_deadline_s = prev_wd
+        OrcaContext.memory_sample_interval_s = prev_mem
+
+    tier = eng_ht.host_tier
+    if tier is None or eng_off.host_tier is not None:
+        raise RuntimeError("host-tier arming is inverted")
+    restored = int(tier._c_restored.value)
+    if restored <= 0:
+        raise RuntimeError(
+            "working set never restored from the host tier — the "
+            "window is not exercising the spill/restore path")
+    if not ht_hit > off_hit:
+        raise RuntimeError(
+            f"host-tier effective hit rate {ht_hit:.3f} not above the "
+            f"device-only baseline's {off_hit:.3f} — the tier added "
+            "no reuse on an over-capacity working set")
+    if not ht_tput > off_tput:
+        raise RuntimeError(
+            f"host-tier tokens/s {ht_tput:.1f} not above the device-"
+            f"only baseline's {off_tput:.1f}")
+    if ht_ttft > off_ttft:
+        raise RuntimeError(
+            f"hits-from-host TTFT p50 {ht_ttft:.1f}ms worse than the "
+            f"recompute path's {off_ttft:.1f}ms — restoring cost more "
+            "than the prefill it saved")
+    restore_ms = sorted(e["dur_s"] * 1e3 for e in dma_events()
+                        if e["kind"] == "host_restore")
+    restore_p50 = (float(np.percentile(restore_ms, 50))
+                   if restore_ms else 0.0)
+    # effective capacity: device pool blocks plus how many block slabs
+    # the host cap holds at this geometry (int8 rows + f32 scales)
+    L, bs, heads, hd, dt, quant = tier._geometry
+    per_block = (L * 2 * bs * heads * hd * np.dtype(dt).itemsize
+                 + (L * 2 * bs * 4 if quant else 0))
+    device_blocks = eng_ht.cache.allocator.capacity
+    out = {
+        "host_tier_tokens_per_sec": round(ht_tput, 1),
+        "host_tier_off_tokens_per_sec": round(off_tput, 1),
+        "host_tier_vs_off_tokens_per_sec": round(
+            ht_tput / off_tput, 3),
+        "host_tier_effective_hit_rate": round(ht_hit, 4),
+        "host_tier_off_effective_hit_rate": round(off_hit, 4),
+        "host_tier_ttft_p50_ms": round(ht_ttft, 3),
+        "host_tier_recompute_ttft_p50_ms": round(off_ttft, 3),
+        "host_tier_restore_p50_ms": round(restore_p50, 3),
+        "host_tier_restored_blocks": restored,
+        "host_tier_spilled_blocks": int(tier._c_spilled.value),
+        "kv_host_device_blocks": device_blocks,
+        "kv_host_effective_capacity_blocks": device_blocks + (
+            tier.capacity_bytes // per_block if per_block else 0),
+        "host_tier_decode_compiles": eng_ht.decode_compile_count,
+    }
+
+    # ---- phase-routing disaggregation over ONE shared tier ----
+    devices = jax.devices()
+    scale_armed = (len(devices) >= 2
+                   and devices[0].platform != "cpu")
+    shared_prefix = list(rng.integers(0, 512, 128))
+    warm_tail = list(rng.integers(0, 512, 16))
+    route_reqs = [(shared_prefix + list(rng.integers(0, 512, 16)), 8)
+                  for _ in range(12)]
+
+    def run_router(phase_aware: bool):
+        shared = HostKVTier(64 << 20, registry=MetricsRegistry())
+        engines = [make_engine(model, params, slots=slots,
+                               device=devices[i % len(devices)],
+                               registry=MetricsRegistry(),
+                               prefix_caching=True,
+                               chunked_prefill=True,
+                               kv_host_tier=shared)
+                   for i in range(2)]
+        router = ReplicaRouter(engines, phase_aware=phase_aware)
+        router.ensure_started()
+        # one warm request commits the shared prefix (and, phase-
+        # aware, write-through publishes it) BEFORE the timed loop so
+        # both runs classify/hit against settled state, not a race
+        # with the first commit
+        router.submit(shared_prefix + warm_tail,
+                      max_new_tokens=4).tokens()
+        hit0 = sum(int(r.engine.prefix_cache._c_hit_tokens.value)
+                   for r in router.replicas)
+        adopted0 = int(shared._c_restored.value)
+        t0 = time.monotonic()
+        streams = [router.submit(p, max_new_tokens=n)
+                   for p, n in route_reqs]
+        tokens = sum(len(s.tokens()) for s in streams)
+        wall = time.monotonic() - t0
+        for r in router.replicas:
+            if r.engine.decode_compile_count != 1:
+                raise RuntimeError(
+                    f"replica {r.name} decode compiled "
+                    f"{r.engine.decode_compile_count}x under phase "
+                    "routing")
+        hits = sum(int(r.engine.prefix_cache._c_hit_tokens.value)
+                   for r in router.replicas) - hit0
+        served = [row["served"]
+                  for row in router.stats()["replicas"]]
+        router.stop()
+        return tokens / wall, hits, \
+            int(shared._c_restored.value) - adopted0, served
+
+    aware_tput, aware_hits, aware_adopted, aware_served = \
+        run_router(True)
+    blind_tput, blind_hits, _blind_adopted, _ = run_router(False)
+    if not aware_hits > blind_hits:
+        raise RuntimeError(
+            f"phase-aware routing hit tokens {aware_hits} not above "
+            f"phase-blind's {blind_hits} on shared-prefix traffic — "
+            "disaggregation added no reuse")
+    if aware_adopted <= 0:
+        raise RuntimeError(
+            "decode replicas never adopted a prefill-replica block "
+            "through the shared tier")
+    out.update({
+        "router_phase_hit_tokens_aware": aware_hits,
+        "router_phase_hit_tokens_blind": blind_hits,
+        "router_phase_adopted_blocks": aware_adopted,
+        "router_phase_tokens_per_sec_aware": round(aware_tput, 1),
+        "router_phase_tokens_per_sec_blind": round(blind_tput, 1),
+        "router_phase_served": aware_served,
+    })
+    if scale_armed:
+        if aware_tput < blind_tput * 0.9:
+            raise RuntimeError(
+                f"phase-aware tokens/s {aware_tput:.1f} fell > 10% "
+                f"below phase-blind's {blind_tput:.1f} on a multi-"
+                "device host — the preference is mis-routing")
+    else:
+        out["router_phase_scale_gate"] = (
+            "skipped: needs >= 2 accelerator devices (replicas share "
+            "one chip here, so phase placement cannot change "
+            "throughput)")
+    return out
+
+
 def multi_tenant_metrics(slots: int = 4, seed: int = 5):
     """Multi-tenant admission under 2x open-loop overload through the
     control plane (docs/control-plane.md): the PR 11 harness replays a
@@ -2287,6 +2535,19 @@ def main():
     except Exception as e:
         routerw = {"router_error": f"{type(e).__name__}: {e}"[:120]}
 
+    hosttierw = {}
+    try:
+        # hierarchical KV cache window (PR 18): over-capacity working
+        # set with host tier on vs device-only, plus the phase-routing
+        # disaggregation pair over one shared tier — two armed engines
+        # + four router replicas, ~60s warm, budget-gated
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining < 150:
+            raise TimeoutError(f"only {remaining:.0f}s left")
+        hosttierw = host_tier_metrics()
+    except Exception as e:
+        hosttierw = {"host_tier_error": f"{type(e).__name__}: {e}"[:120]}
+
     tenantw = {}
     try:
         # multi-tenant admission window (control plane): 2x open-loop
@@ -2346,6 +2607,7 @@ def main():
             **generation,
             **specw,
             **routerw,
+            **hosttierw,
             **tenantw,
             **historyw,
             **bert_extra,

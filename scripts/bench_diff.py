@@ -56,6 +56,9 @@ TRACKED: Dict[str, str] = {
     "goodput_ratio": "higher",
     "prefix_cache_hit_rate": "higher",
     "prefix_hit_tokens_total": "higher",
+    "host_tier_restore_p50_ms": "lower",
+    "host_tier_effective_hit_rate": "higher",
+    "kv_host_effective_capacity_blocks": "higher",
     "kv_bytes_per_token_int8": "lower",
     "overload_gate_zero_acked_loss_pass": "higher",
     "overload_gate_2x_attainment_pass": "higher",

@@ -21,7 +21,8 @@ or `analytics_zoo_tpu/keras/layers/`:
 
 `analytics_zoo_tpu/serving/generation/` (the decode hot path —
 engine.py, model.py, scheduler.py, kv_cache.py, prefix_cache.py,
-speculation.py and anything that joins them) is held to the same
+speculation.py, host_tier.py and anything that joins them) is held
+to the same
 einsum rule PLUS a
 stricter one: no direct Pallas imports (`ops.pallas.*`,
 `jax.experimental.pallas`, `pallas_call`).  Decode attention must go

@@ -31,7 +31,8 @@ Usage: ``python scripts/check_timeline_schema.py [trace.json ...]``.
 With file arguments, each is validated.  With none, two synthetic
 scenarios run through the REAL exporters: the single-process one (a
 span, a fenced goodput step, a full request lifecycle incl.
-preemption, a memory sample) and a THREE-process fleet merge (the
+preemption, a memory sample, a host-tier DMA spill/restore pair on
+the kv_dma lane) and a THREE-process fleet merge (the
 local process plus two spooled snapshots sharing a trace_id, driven
 through `FleetAggregator`) — the self-contained tier-1 lint mode
 (tests/test_timeline_schema.py).  Exit code 0 = clean.
@@ -247,9 +248,13 @@ def _synthetic_timeline() -> Dict[str, Any]:
         trace,
     )
     from analytics_zoo_tpu.observability.goodput import step_clock
+    from analytics_zoo_tpu.serving.generation import host_tier
 
     with trace("lint.span", check="timeline_schema"):
         pass
+    host_tier.reset_dma()
+    host_tier.record_dma("host_spill", 0.002, 4096)
+    host_tier.record_dma("host_restore", 0.001, 4096, lane="lint")
     clock = step_clock("lint_clock")
     rec = clock.begin(force_fence=True)
     rec.lap("host_input")
@@ -324,6 +329,10 @@ def _synthetic_fleet_timeline() -> Dict[str, Any]:
             with open(os.path.join(pdir, "snapshot.json"), "w",
                       encoding="utf-8") as f:
                 _json.dump(doc, f)
+        from analytics_zoo_tpu.serving.generation import host_tier
+        host_tier.reset_dma()
+        host_tier.record_dma("host_spill", 0.002, 4096)
+        host_tier.record_dma("host_restore", 0.001, 4096, lane="lint")
         agg = FleetAggregator(observability_dir=tmp,
                               local_name="lint-local")
         return agg.fleet_timeline()
@@ -357,6 +366,13 @@ def main(argv: List[str]) -> int:
         return rc
     doc = _synthetic_timeline()
     errors = validate_timeline(doc)
+    kinds = {e.get("name") for e in doc["traceEvents"]
+             if e.get("ph") == "X" and e.get("cat") == "kv_dma"}
+    if not ({"host_spill", "host_restore"} <= kinds):
+        errors.append(
+            "single-process export lacks host-tier DMA slices "
+            "(expected X events host_spill and host_restore on the "
+            "kv_dma lane)")
     if errors:
         print("check_timeline_schema: the exporter emits schema "
               "violations:", file=sys.stderr)
@@ -379,6 +395,13 @@ def main(argv: List[str]) -> int:
         ferrors.append(
             "fleet merge has no stitched flow (expected s and f "
             "events for the shared trace_id)")
+    fkinds = {e.get("name") for e in fevents
+              if e.get("ph") == "X" and e.get("cat") == "kv_dma"}
+    if not ({"host_spill", "host_restore"} <= fkinds):
+        ferrors.append(
+            "fleet merge lacks the local source's host-tier DMA "
+            "slices (expected X events host_spill and host_restore "
+            "on the kv_dma lane)")
     if ferrors:
         print("check_timeline_schema: the fleet exporter emits schema "
               "violations:", file=sys.stderr)

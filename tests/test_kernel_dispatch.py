@@ -68,12 +68,12 @@ def test_lint_detects_violation():
         "paged_decode_attention")
     assert not gen_matches("a = paged_decode_attention(q, k, v, kp, "
                            "vp, tables, ctx_len)")
-    # serving/generation IS scanned — and the prefix-cache (PR 8) and
-    # speculation (PR 15) subsystems actually live under that root, so
-    # a raw einsum or a private Pallas wire in either would fail the
-    # build
+    # serving/generation IS scanned — and the prefix-cache (PR 8),
+    # speculation (PR 15) and host-tier (PR 18) subsystems actually
+    # live under that root, so a raw einsum or a private Pallas wire
+    # in any of them would fail the build
     gen_root = next(r for r in mod.SCANNED_DIRS
                     if r.endswith(os.path.join("serving", "generation")))
     for fn in ("engine.py", "model.py", "prefix_cache.py",
-               "speculation.py"):
+               "speculation.py", "host_tier.py"):
         assert os.path.exists(os.path.join(gen_root, fn)), fn
