@@ -81,6 +81,7 @@ class OrcaContextMeta(type):
     _tenant_quotas = None
     _metrics_history_interval_s = None
     _metrics_history_max_bytes = 8 * 1024 * 1024
+    _hardware_peak_flops = None
 
     # --- TPU runtime state ---
     _mesh = None
@@ -294,6 +295,23 @@ class OrcaContextMeta(type):
         if int(value) < 4096:
             raise ValueError("metrics_history_max_bytes must be >= 4096")
         cls._metrics_history_max_bytes = int(value)
+
+    @property
+    def hardware_peak_flops(cls):
+        """Hardware peak FLOP/s the profiling plane's MFU gauges
+        divide by (observability/profiling.py).  None (default) falls
+        back to `profiling.DEFAULT_PEAK_FLOPS` (1 TFLOP/s) — a
+        placeholder roofline so CPU-CI MFU numbers stay comparable
+        across rounds; set the accelerator's real dense peak (e.g.
+        ~275e12 for a v4 TPU chip in bf16) for meaningful ratios."""
+        return cls._hardware_peak_flops
+
+    @hardware_peak_flops.setter
+    def hardware_peak_flops(cls, value):
+        if value is not None and float(value) <= 0:
+            raise ValueError("hardware_peak_flops must be > 0 or None")
+        cls._hardware_peak_flops = (None if value is None
+                                    else float(value))
 
     @property
     def tenant_quotas(cls):

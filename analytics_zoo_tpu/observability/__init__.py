@@ -61,6 +61,7 @@ from analytics_zoo_tpu.observability import (  # noqa: F401
     flight_recorder,
     history,
     memory,
+    profiling,
     request_log,
     telemetry_spool,
     timeline,
@@ -99,6 +100,17 @@ from analytics_zoo_tpu.observability.request_log import (  # noqa: F401
     new_request_id,
     reset_request_log,
 )
+from analytics_zoo_tpu.observability.profiling import (  # noqa: F401
+    CausalLMFlops,
+    DISPATCH_FAMILIES,
+    compile_events,
+    diff_signatures,
+    instrument,
+    ledger_snapshot,
+    record_work,
+    reset_profiling,
+    train_step_flops,
+)
 from analytics_zoo_tpu.observability.slo import (  # noqa: F401
     SLOTracker,
     get_shadow_slo_tracker,
@@ -116,25 +128,31 @@ from analytics_zoo_tpu.observability.watchdog import (  # noqa: F401
 )
 
 __all__ = [
-    "AlertEngine", "AlertRule", "BUILTIN_ALERTS", "Counter",
+    "AlertEngine", "AlertRule", "BUILTIN_ALERTS", "CausalLMFlops",
+    "Counter", "DISPATCH_FAMILIES",
     "FleetAggregator", "Gauge", "Histogram", "HistoryReader",
     "MetricsRecorder", "MetricsRegistry", "RequestLog", "SLOTracker",
     "SampleLog", "Span", "StepClock",
     "TelemetrySpool", "TraceContext", "Watchdog", "annotate",
     "builtin_rules",
-    "clear_spans", "close_sink", "current_span",
-    "current_trace_context", "export_timeline", "flight_recorder",
+    "clear_spans", "close_sink", "compile_events", "current_span",
+    "current_trace_context", "diff_signatures", "export_timeline",
+    "flight_recorder",
     "get_recorder", "get_registry", "get_request_log",
     "get_shadow_slo_tracker", "get_slo_tracker",
-    "goodput_tables", "history", "labeled_prometheus_text",
-    "localize_nonfinite",
+    "goodput_tables", "history", "instrument",
+    "labeled_prometheus_text",
+    "ledger_snapshot", "localize_nonfinite",
     "log_event", "maybe_record", "maybe_spool", "maybe_watchdog",
     "memory",
     "merged_prometheus_text", "nearest_rank", "new_request_id",
     "nonfinite_leaves", "now", "parse_prometheus_text",
-    "parse_traceparent", "process_goodput_ratio", "recent_spans",
-    "request_log", "reset_recorder", "reset_registry",
+    "parse_traceparent", "process_goodput_ratio", "profiling",
+    "recent_spans",
+    "record_work", "request_log", "reset_recorder",
+    "reset_profiling", "reset_registry",
     "reset_request_log",
     "reset_slo_tracker", "sanitize_metric_name", "step_clock",
     "telemetry_spool", "timeline", "trace", "trace_context",
+    "train_step_flops",
 ]

@@ -42,6 +42,7 @@ BUILTIN_ALERTS = (
     "goodput_floor",
     "prefix_cache_collapse",
     "speculation_collapse",
+    "recompile_storm",
 )
 
 _KINDS = ("burn_rate", "slope", "floor")
@@ -113,6 +114,21 @@ def builtin_rules() -> Tuple[AlertRule, ...]:
             params={"floor": 0.1, "window_s": 30.0,
                     "clear_ratio": 1.5, "guard_min_rate": 0.5},
             for_s=5.0, clear_s=10.0, cooldown_s=60.0),
+        AlertRule(
+            # slope over the cumulative compile-event counter (the
+            # counter fallback in `_metric_points`) = compiles/sec.
+            # Steady state is ZERO new programs after warmup, so a
+            # sustained rate above one compile per ~5s means a
+            # signature is churning the jit cache — the profiling
+            # plane's compile-event diffs name the leaf
+            # (docs/observability.md, "reading a recompile
+            # post-mortem")
+            "recompile_storm", metric="compile_events_total",
+            kind="slope",
+            params={"min_slope": 0.2, "window_s": 30.0,
+                    "clear_ratio": 0.25},
+            for_s=5.0, clear_s=10.0, cooldown_s=60.0,
+            severity="page"),
     )
     rules[3].params["guard_counters"] = (
         "prefix_cache_hits_total", "prefix_cache_misses_total")

@@ -164,6 +164,8 @@ def dump(reason: str, extra: Optional[Dict[str, Any]] = None,
             "memory": _memory_snapshot_safe(),
             "history_tail": _history_tail_safe(),
             "alerts_active": _alerts_active_safe(),
+            "dispatch": _dispatch_safe(),
+            "compile_events": _compile_events_safe(),
             "thread_stacks": _thread_stacks(),
         }
         if exc is not None:
@@ -223,6 +225,29 @@ def _history_tail_safe(n: int = 64) -> List[Dict[str, Any]]:
         from analytics_zoo_tpu.observability import history
         rec = history.get_recorder()
         return rec.tail(n) if rec is not None else []
+    except Exception:
+        return []
+
+
+def _dispatch_safe() -> Dict[str, Any]:
+    """Per-family dispatch-ledger rows + MFU block (empty when no
+    ledgered program has dispatched)."""
+    try:
+        from analytics_zoo_tpu.observability import profiling
+        snap = profiling.ledger_snapshot()
+        snap.pop("compile_events", None)   # own bundle section below
+        return snap if snap.get("families") else {}
+    except Exception:
+        return {}
+
+
+def _compile_events_safe(n: int = 32) -> List[Dict[str, Any]]:
+    """The compile-forensics tail: the last `n` compile events with
+    their signature diffs — a recompile post-mortem names the guilty
+    leaf straight from the bundle."""
+    try:
+        from analytics_zoo_tpu.observability import profiling
+        return profiling.compile_events(n)
     except Exception:
         return []
 

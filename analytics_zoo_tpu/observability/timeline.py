@@ -32,6 +32,7 @@ Row layout (pids are stable so saved traces diff cleanly):
 | 5 `memory`    | ``memory_bytes`` + provider counter tracks |
 | 6 `replicas`  | one tid per router replica: dispatch instants (which replica served which request — serving/distributed/router.py) |
 | 7 `kv_dma`    | one tid per engine/replica lane: ``host_spill`` / ``host_restore`` X slices for host-tier KV copies (serving/generation/host_tier.py) |
+| 8 `dispatch`  | one tid per dispatch-ledger program family: fenced work X slices + ``compile`` instants with the signature diff (observability/profiling.py) |
 
 Serving: `ServingServer` exposes the export as ``GET /timeline``
 (forcing a fresh memory sample first), and every flight-recorder
@@ -51,6 +52,7 @@ PID_EVENTS = 4
 PID_MEMORY = 5
 PID_REPLICAS = 6
 PID_KV_DMA = 7
+PID_DISPATCH = 8
 
 _PROCESS_NAMES = {
     PID_SPANS: "spans",
@@ -60,6 +62,7 @@ _PROCESS_NAMES = {
     PID_MEMORY: "memory",
     PID_REPLICAS: "replicas",
     PID_KV_DMA: "kv_dma",
+    PID_DISPATCH: "dispatch",
 }
 
 #: total event cap per export — /timeline must stay a bounded payload
@@ -239,6 +242,41 @@ def _kv_dma_events(dma_n: Optional[int]
     return events, {tid: lane for lane, tid in tids.items()}
 
 
+def _dispatch_events(calls_n: Optional[int]) -> (List[Dict[str, Any]],
+                                                 Dict[int, str]):
+    from analytics_zoo_tpu.observability import profiling
+
+    events: List[Dict[str, Any]] = []
+    tids: Dict[str, int] = {}
+    for family, ts, dur, tokens in profiling.recent_calls(calls_n):
+        tid = tids.setdefault(family, len(tids) + 1)
+        args: Dict[str, Any] = {}
+        if tokens:
+            args["tokens"] = int(tokens)
+        events.append({
+            "ph": "X", "name": family, "cat": "dispatch",
+            "pid": PID_DISPATCH, "tid": tid,
+            "ts": _us(ts - dur), "dur": max(0, _us(dur)),
+            "args": args,
+        })
+    for ev in profiling.compile_events(calls_n):
+        family = ev.get("family", "?")
+        tid = tids.setdefault(family, len(tids) + 1)
+        args = {"n": ev.get("n"), "compile_s": ev.get("compile_s"),
+                "callsite": ev.get("callsite", "")}
+        diff = ev.get("diff")
+        if diff:
+            args["diff"] = "; ".join(
+                f"{d['path']}: {d['old']} -> {d['new']}"
+                for d in diff[:4])
+        events.append({
+            "ph": "i", "name": "compile", "cat": "dispatch",
+            "pid": PID_DISPATCH, "tid": tid,
+            "ts": _us(ev.get("ts", 0.0)), "s": "t", "args": args,
+        })
+    return events, {tid: family for family, tid in tids.items()}
+
+
 def _ring_events(ring_n: Optional[int]) -> List[Dict[str, Any]]:
     from analytics_zoo_tpu.observability.flight_recorder import (
         ring_contents,
@@ -309,6 +347,7 @@ def export_timeline(spans_n: int = 512,
     req_ev, req_tids = _section(_request_events, requests_n)
     repl_ev, repl_tids = _section(_replica_events, requests_n)
     dma_ev, dma_tids = _section(_kv_dma_events, None)
+    disp_ev, disp_tids = _section(_dispatch_events, None)
     try:
         ring_ev = _ring_events(ring_n)
     except Exception:
@@ -320,7 +359,7 @@ def export_timeline(spans_n: int = 512,
 
     used_pids = set()
     for ev_list in (span_ev, good_ev, req_ev, repl_ev, dma_ev,
-                    ring_ev, mem_ev):
+                    disp_ev, ring_ev, mem_ev):
         events.extend(ev_list)
         used_pids.update(e["pid"] for e in ev_list)
 
@@ -337,6 +376,8 @@ def export_timeline(spans_n: int = 512,
         metas.append(_meta(PID_REPLICAS, tid, "thread_name", name))
     for tid, name in sorted(dma_tids.items()):
         metas.append(_meta(PID_KV_DMA, tid, "thread_name", name))
+    for tid, name in sorted(disp_tids.items()):
+        metas.append(_meta(PID_DISPATCH, tid, "thread_name", name))
     if any(e["pid"] == PID_EVENTS for e in ring_ev):
         metas.append(_meta(PID_EVENTS, 1, "thread_name",
                            "flight_ring"))

@@ -40,6 +40,7 @@ from analytics_zoo_tpu.observability import (
     localize_nonfinite,
     log_event,
     now,
+    profiling,
     step_clock,
     trace,
 )
@@ -209,8 +210,18 @@ class SPMDEngine:
         #: it a heartbeat per dispatched step / per epoch program
         self.watchdog = None
 
-        self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
-        self._eval_step = jax.jit(self._eval_step_impl)
+        # dispatch-ledger registration (observability/profiling.py):
+        # the per-step train/eval programs join the same compile
+        # forensics + call accounting as the serving families — a
+        # recompile from a drifting batch signature names the exact
+        # leaf that forked the cache entry
+        self._train_step = profiling.instrument(
+            "train_step",
+            jax.jit(self._train_step_impl, donate_argnums=0),
+            argnames=("state", "batch"))
+        self._eval_step = profiling.instrument(
+            "eval_step", jax.jit(self._eval_step_impl),
+            argnames=("state", "batch"))
         self._predict_step = jax.jit(self._predict_step_impl)
 
         # device-cached dataset paths: index one step's batch out of the
@@ -219,12 +230,16 @@ class SPMDEngine:
         def _pick(data, i):
             return jax.tree_util.tree_map(lambda a: a[i], data)
 
-        self._train_step_cached = jax.jit(
-            lambda state, data, i: self._train_step_impl(
-                state, _pick(data, i)), donate_argnums=0)
-        self._eval_step_cached = jax.jit(
-            lambda state, data, i: self._eval_step_impl(
-                state, _pick(data, i)))
+        self._train_step_cached = profiling.instrument(
+            "train_step", jax.jit(
+                lambda state, data, i: self._train_step_impl(
+                    state, _pick(data, i)), donate_argnums=0),
+            argnames=("state", "data", "i"))
+        self._eval_step_cached = profiling.instrument(
+            "eval_step", jax.jit(
+                lambda state, data, i: self._eval_step_impl(
+                    state, _pick(data, i))),
+            argnames=("state", "data", "i"))
 
         # one-dispatch epoch: with the dataset HBM-resident, the whole
         # epoch is a lax.scan over the [steps, ...] axis — host dispatch
@@ -501,6 +516,7 @@ class SPMDEngine:
             # goodput: the whole epoch is one "step" of the clock,
             # always fenced (the totals fetch is a natural fence)
             rec = clock.begin(force_fence=True)
+            t_ep = now()
             key = ("epoch_scan", train, unroll)
             rec.cold = key not in self._jit_warm
             with trace("spmd.epoch_scan", steps=dds.steps, train=train,
@@ -536,6 +552,15 @@ class SPMDEngine:
                     self._jit_warm.add(key)
                     out = self._fetch_totals(totals)
                     rec.lap("device_compute")
+            # epoch-granular ledger work: the totals fetch above is the
+            # fence, so the epoch wall is honest; one record covers all
+            # dds.steps step-equivalents of analytic FLOPs
+            bsz = jax.tree_util.tree_leaves(data)[0].shape[1]
+            profiling.record_work(
+                "train_step" if train else "eval_step",
+                now() - t_ep, tokens=dds.steps * bsz,
+                flops=profiling.train_step_flops(
+                    self.param_count, dds.steps * bsz, train))
             flight_recorder.record("spmd_epoch_scan", train=train,
                                    steps=dds.steps)
             if self.watchdog is not None:
@@ -550,11 +575,12 @@ class SPMDEngine:
         step_fn = (self._train_step_cached if train
                    else self._eval_step_cached)
         kind = "train_cached" if train else "eval_cached"
+        bsz = jax.tree_util.tree_leaves(data)[0].shape[1]
         for i in range(dds.steps):
             fault_point("train.step" if train else "eval.step",
                         step=step + 1 if train else step)
             rec = clock.begin(force_fence=profile or sentinel)
-            t0 = now() if profile else 0.0
+            t0 = now()
             rec.cold = kind not in self._jit_warm
             with self._step_span(kind, step + 1 if train else step,
                                  train):
@@ -567,6 +593,14 @@ class SPMDEngine:
             if rec.fenced:
                 jax.block_until_ready(stats["_count"])
                 rec.lap("device_compute")
+                # ledger work rides the fenced samples only — warm
+                # unfenced dispatches return before the device does,
+                # so their wall would overstate MFU
+                profiling.record_work(
+                    "train_step" if train else "eval_step",
+                    now() - t0, tokens=bsz,
+                    flops=profiling.train_step_flops(
+                        self.param_count, bsz, train))
             if profile:
                 self.last_profile.append(
                     {"step": step,
@@ -779,7 +813,7 @@ class SPMDEngine:
                               step=step + 1 if train else step)
             if act == "nan" and train:
                 batch = _poison_batch_nan(batch)
-            t0 = now() if profile else 0.0
+            t0 = now()
             rec.cold = kind not in self._jit_warm
             with self._step_span(kind, step + 1 if train else step,
                                  train):
@@ -803,6 +837,12 @@ class SPMDEngine:
                 # the goodput device bucket
                 jax.block_until_ready(stats["_count"])
                 rec.lap("device_compute")
+                bsz = jax.tree_util.tree_leaves(batch)[0].shape[0]
+                profiling.record_work(
+                    "train_step" if train else "eval_step",
+                    now() - t0, tokens=bsz,
+                    flops=profiling.train_step_flops(
+                        self.param_count, bsz, train))
             if profile:
                 self.last_profile.append(
                     {"step": step,

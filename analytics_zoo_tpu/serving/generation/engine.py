@@ -75,6 +75,7 @@ from analytics_zoo_tpu.observability import (
     maybe_watchdog,
     memory,
     now,
+    profiling,
     request_log,
     step_clock,
 )
@@ -181,6 +182,14 @@ class GenerationEngine:
                 f"model.max_position_len {model.max_position_len} < "
                 f"max_context {max_context}")
         self.model = model
+        #: analytic FLOPs model for MFU accounting — the dispatch
+        #: ledger combines these with the fenced walls below; None when
+        #: the model doesn't carry the CausalLM dims (a stand-in model
+        #: in tests), which simply zeroes the MFU gauges
+        try:
+            self._flops = profiling.CausalLMFlops.from_model(model)
+        except (AttributeError, TypeError):
+            self._flops = None
         #: tensor-parallel decode (serving/distributed/tp.py) — "auto"
         #: reads OrcaContext.decode_tensor_parallel; 0 (the default)
         #: keeps the legacy single-device placement bitwise untouched
@@ -677,32 +686,91 @@ class GenerationEngine:
                     dst * bs, axis=2)
             return kv, kv_scale
 
+        # dispatch-ledger registration happens HERE, at jit-wrap time:
+        # every compiled program family the engine can dispatch gets a
+        # ledgered wrapper (signature forensics + call counting;
+        # `_cache_size` forwards so the compile-count pins below keep
+        # reading the real jit cache).  Argument names feed the
+        # compile-event differ so a recompile post-mortem names the
+        # guilty leaf as e.g. `tokens: int32[4] -> int32[5]`.
+        _ledger = profiling.instrument
+        _names_prefill = ("params", "kv", "kv_scale", "tokens",
+                          "length", "block_table", "temperature",
+                          "top_k", "rng")
+        _names_chunk = ("params", "kv", "kv_scale", "tokens", "start",
+                        "length", "block_table", "temperature",
+                        "top_k", "rng")
+        _names_decode = ("params", "kv", "kv_scale", "tokens",
+                         "block_tables", "ctx_len", "active",
+                         "temperature", "top_k", "rng")
+        _names_spec = ("params", "kv", "kv_scale", "tokens",
+                       "block_tables", "start", "length", "active")
         if self._tp is not None:
             # identical step functions; only placement differs — the
             # wrapper pins out_shardings (pool head-sharded, scales/
             # tokens/logits replicated) so every step's outputs feed
             # the next step in the same layout (zero-recompile holds)
-            self._prefill_jit = self._tp.jit_step(prefill, donate, 4)
-            self._chunk_jit = self._tp.jit_step(chunk_prefill,
-                                                donate, 4)
-            self._copy_block_jit = self._tp.jit_step(
-                copy_block, ((0, 1) if donate else ()), 2)
+            self._prefill_jit = _ledger(
+                "prefill", self._tp.jit_step(prefill, donate, 4),
+                argnames=_names_prefill)
+            self._chunk_jit = _ledger(
+                "chunk_prefill",
+                self._tp.jit_step(chunk_prefill, donate, 4),
+                argnames=_names_chunk)
+            self._copy_block_jit = _ledger(
+                "copy_block",
+                self._tp.jit_step(copy_block,
+                                  ((0, 1) if donate else ()), 2),
+                argnames=("kv", "kv_scale", "src", "dst"))
             self._restore_block_jit = None   # host tier off under TP
-            self._decode_jit = self._tp.jit_step(decode, donate, 4)
-            self._spec_jit = self._tp.jit_step(spec_verify, donate, 3)
+            self._decode_jit = _ledger(
+                "decode", self._tp.jit_step(decode, donate, 4),
+                argnames=_names_decode)
+            self._spec_jit = _ledger(
+                "spec_verify",
+                self._tp.jit_step(spec_verify, donate, 3),
+                argnames=_names_spec)
         else:
-            self._prefill_jit = jax.jit(prefill, donate_argnums=donate)
-            self._chunk_jit = jax.jit(chunk_prefill,
-                                      donate_argnums=donate)
-            self._copy_block_jit = jax.jit(
-                copy_block,
-                donate_argnums=((0, 1) if donate else ()))
-            self._restore_block_jit = jax.jit(
-                restore_block,
-                donate_argnums=((0, 1) if donate else ()))
-            self._decode_jit = jax.jit(decode, donate_argnums=donate)
-            self._spec_jit = jax.jit(spec_verify,
-                                     donate_argnums=donate)
+            self._prefill_jit = _ledger(
+                "prefill", jax.jit(prefill, donate_argnums=donate),
+                argnames=_names_prefill)
+            self._chunk_jit = _ledger(
+                "chunk_prefill",
+                jax.jit(chunk_prefill, donate_argnums=donate),
+                argnames=_names_chunk)
+            self._copy_block_jit = _ledger(
+                "copy_block",
+                jax.jit(copy_block,
+                        donate_argnums=((0, 1) if donate else ())),
+                argnames=("kv", "kv_scale", "src", "dst"))
+            self._restore_block_jit = _ledger(
+                "host_restore",
+                jax.jit(restore_block,
+                        donate_argnums=((0, 1) if donate else ())),
+                argnames=("kv", "kv_scale", "dst", "rows", "srows"))
+            self._decode_jit = _ledger(
+                "decode", jax.jit(decode, donate_argnums=donate),
+                argnames=_names_decode)
+            self._spec_jit = _ledger(
+                "spec_verify",
+                jax.jit(spec_verify, donate_argnums=donate),
+                argnames=_names_spec)
+
+        # compile budgets: how many program variants each family's
+        # call-site geometry implies — the ledger flags `over_budget`
+        # the moment a family compiles MORE (a recompile storm is then
+        # a budget breach in /dispatch, not just a counter rate)
+        n_buckets = self.scheduler.expected_prefill_variants()
+        profiling.declare_expected("prefill", n_buckets)
+        profiling.declare_expected("chunk_prefill", n_buckets)
+        profiling.declare_expected("decode", 1)
+        profiling.declare_expected("copy_block", 1)
+        if self._restore_block_jit is not None:
+            profiling.declare_expected("host_restore", 1)
+        if self.speculation is not None:
+            profiling.declare_expected(
+                "spec_verify",
+                self.speculation.expected_verify_variants())
 
     def _store_kv_state(self, kv, kv_scale) -> None:
         self.cache.kv = kv
@@ -960,7 +1028,11 @@ class GenerationEngine:
         nxt = int(nxt)            # token fetch = device fence
         rec.lap("device_compute")
         self._goodput_warm.add(("prefill", bucket))
-        self._h_prefill.record(now() - t0, L)
+        dur = now() - t0
+        self._h_prefill.record(dur, L)
+        profiling.record_work(
+            "prefill", dur, tokens=L,
+            flops=self._flops.prefill(L) if self._flops else 0.0)
         self._c_prefill_tokens.inc(L)
         request_log.event(seq.request_id, "prefill", bucket=bucket,
                           tokens=L, resumed=seq.n_preempted > 0)
@@ -1029,7 +1101,12 @@ class GenerationEngine:
         nxt = int(nxt)            # token fetch = device fence
         rec.lap("device_compute")
         self._goodput_warm.add(("chunk", bucket))
-        self._h_prefill.record(now() - t0, real)
+        dur = now() - t0
+        self._h_prefill.record(dur, real)
+        profiling.record_work(
+            "chunk_prefill", dur, tokens=real,
+            flops=(self._flops.prefill(real, ctx_start=start)
+                   if self._flops else 0.0))
         self._c_prefill_tokens.inc(real)
         seq.prefill_pos = start + real
         request_log.event(seq.request_id, "prefill", bucket=bucket,
@@ -1077,8 +1154,10 @@ class GenerationEngine:
         self._store_kv_state(kv, scl)
         entry.staged_kv = None
         entry.staged_scale = None
-        record_dma("host_restore", now() - t0, entry.nbytes,
+        dur = now() - t0
+        record_dma("host_restore", dur, entry.nbytes,
                    self.spool_name)
+        profiling.record_work("host_restore", dur)
         return True
 
     def _stage_host_restores(self) -> None:
@@ -1111,10 +1190,12 @@ class GenerationEngine:
         `SlotScheduler.resolve_write_conflicts`)."""
         for _seq, _idx, src, dst in \
                 self.scheduler.resolve_write_conflicts():
+            t0 = now()
             kv, scl = self._copy_block_jit(
                 self.cache.kv, self._kv_scale, jnp.int32(src),
                 jnp.int32(dst))
             self._store_kv_state(kv, scl)
+            profiling.record_work("copy_block", now() - t0)
             if self._c_cow is not None:
                 self._c_cow.inc()
 
@@ -1218,7 +1299,15 @@ class GenerationEngine:
         greedy = np.asarray(greedy)   # token fetch = device fence
         rec.lap("device_compute")
         self._goodput_warm.add(("spec", W - 1))
-        self._h_decode.record(now() - t0, len(drafted) + len(riders))
+        dur = now() - t0
+        self._h_decode.record(dur, len(drafted) + len(riders))
+        n_rows = len(drafted) + len(riders)
+        ctx_mean = (float(np.sum(start[active])) / n_rows
+                    if n_rows else 0.0)
+        profiling.record_work(
+            "spec_verify", dur, tokens=int(np.sum(length[active])),
+            flops=(self._flops.verify(n_rows, W, ctx_mean)
+                   if self._flops else 0.0))
         for seq in riders:
             # a rider's row is an ordinary decode in verify clothing:
             # it charges no speculation budget, ticks no speculation
@@ -1301,7 +1390,14 @@ class GenerationEngine:
         nxt = np.asarray(nxt)     # token fetch = device fence
         rec.lap("device_compute")
         self._goodput_warm.add("decode")
-        self._h_decode.record(now() - t0, len(lanes))
+        dur = now() - t0
+        self._h_decode.record(dur, len(lanes))
+        ctx_mean = (float(np.sum(ctx_len[active])) / len(lanes)
+                    if lanes else 0.0)
+        profiling.record_work(
+            "decode", dur, tokens=len(lanes),
+            flops=(self._flops.decode(len(lanes), ctx_mean)
+                   if self._flops else 0.0))
         for i, seq in lanes.items():
             request_log.decode_round(seq.request_id)
             self._emit(seq, nxt[i])

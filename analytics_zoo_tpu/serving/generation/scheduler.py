@@ -189,6 +189,13 @@ class SlotScheduler:
         raise ValueError(f"prompt length {n} exceeds the largest "
                          f"prefill bucket {self.prefill_buckets[-1]}")
 
+    def expected_prefill_variants(self) -> int:
+        """The compile budget the bucket geometry implies: any prompt
+        length maps onto exactly one of these programs, so the
+        dispatch ledger flags a prefill family exceeding this as
+        over-budget (observability/profiling.py `declare_expected`)."""
+        return len(self.prefill_buckets)
+
     # ------------------------------------------------------------------
 
     def _alloc_with_evict(self, n: int) -> Optional[List[int]]:

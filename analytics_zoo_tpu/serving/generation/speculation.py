@@ -152,6 +152,13 @@ class Speculator:
         raise ValueError(f"draft length {n} exceeds speculative_k "
                          f"{self.k}")
 
+    def expected_verify_variants(self) -> int:
+        """The verify compile budget the k-bucket geometry implies —
+        one program per pow2 bucket; the dispatch ledger flags the
+        spec_verify family exceeding this as over-budget
+        (observability/profiling.py `declare_expected`)."""
+        return len(self.buckets)
+
     def state(self, seq) -> SpecState:
         """The lane's draft state, created on first use."""
         if seq.spec is None:

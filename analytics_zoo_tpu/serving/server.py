@@ -119,6 +119,7 @@ from analytics_zoo_tpu.observability import (
     merged_prometheus_text,
     now,
     process_goodput_ratio,
+    profiling,
     recent_spans,
     request_log,
     trace,
@@ -434,6 +435,14 @@ class ServingServer:
                     # configured targets, rolling-window attainment
                     # overall and per dimension, violation counts
                     self._json(200, get_slo_tracker().snapshot())
+                    return
+                if self.path.startswith("/dispatch"):
+                    # dispatch-ledger block (observability/
+                    # profiling.py): per-program-family call/wall/
+                    # bytes rows, compile forensics (events + the
+                    # signature diffs naming the leaf that forked a
+                    # jit cache entry) and the MFU/roofline numbers
+                    self._json(200, profiling.ledger_snapshot())
                     return
                 if self.path.startswith("/timeline"):
                     # Chrome-trace-event export (observability/
@@ -1129,6 +1138,12 @@ class ServingServer:
                 "preemptions": eng.scheduler.n_preemptions,
                 "tokens_total": eng._c_tokens.value,
             }
+        ledger = profiling.ledger_snapshot()
+        if ledger["families"]:
+            # the summary half of GET /dispatch: family rows + MFU,
+            # without the compile-event tail
+            ledger.pop("compile_events", None)
+            out["dispatch"] = ledger
         if self.stream_hub is not None:
             # per-stream backlog + per-group lag rows
             # (serving/streaming/stream.py stats)

@@ -1183,7 +1183,8 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
     import jax
     import jax.numpy as jnp
 
-    from analytics_zoo_tpu.observability import get_registry, request_log
+    from analytics_zoo_tpu.observability import (
+        get_registry, profiling, request_log)
     from analytics_zoo_tpu.serving.generation import CausalLM
 
     model = CausalLM(vocab=512, hidden_size=128, n_head=4, n_block=2,
@@ -1367,6 +1368,17 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
     shared_peak = int(peak) if peak == peak else 0
 
     ntok = eng_int8.cache.num_blocks * eng_int8.cache.block_size
+    # dispatch ledger / MFU plane (PR 19): process-wide forensics over
+    # every engine this mode built.  MFU on CPU-tiny models is ~0
+    # against the analytic roofline; bench_diff tracks direction, not
+    # magnitude.  compile_seconds_total shrinking round-over-round is
+    # the recompile-storm early-warning this plane exists for.
+    ledger = profiling.ledger_snapshot()
+    dispatch_block = {
+        fam: {"calls": snap["calls"],
+              "wall_s": snap["wall_s"],
+              "compile_count": snap["compile_count"]}
+        for fam, snap in ledger["families"].items()}
     return {
         "generation_continuous_tokens_per_sec": round(cont_tput, 1),
         "generation_static_tokens_per_sec": round(static_tput, 1),
@@ -1424,6 +1436,12 @@ def generation_metrics(n_requests: int = 16, slots: int = 4,
         # holder — live proof the lanes actually shared, not copied
         "prefix_shared_blocks_peak": shared_peak,
         "prefix_decode_compiles": eng_pc.decode_compile_count,
+        # dispatch ledger / MFU (PR 19)
+        "mfu_decode": ledger["mfu"]["decode"],
+        "mfu_prefill": ledger["mfu"]["prefill"],
+        "compile_events_total": ledger["compile_events_total"],
+        "compile_seconds_total": ledger["compile_seconds_total"],
+        "dispatch": dispatch_block,
     }
 
 

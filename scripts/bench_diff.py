@@ -64,6 +64,11 @@ TRACKED: Dict[str, str] = {
     "overload_gate_2x_attainment_pass": "higher",
     "overload_gate_sheds_carry_retry_after_pass": "higher",
     "serving_queue_wait_gate_40ms_pass": "higher",
+    # dispatch ledger / MFU plane (PR 19): decode roofline utilisation
+    # should only climb; cumulative compile seconds over the bench run
+    # should only shrink (recompile storms show up here first)
+    "mfu_decode": "higher",
+    "compile_seconds_total": "lower",
 }
 
 DEFAULT_THRESHOLD = 0.10

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Lint: dispatch-ledger families ↔ docs/observability.md table.
+
+The profiling plane's dispatch ledger (observability/profiling.py)
+accepts a CLOSED set of program-family names — the
+``DISPATCH_FAMILIES`` tuple; `instrument()`/`record_work()` reject
+anything else.  docs/observability.md's "## Dispatch ledger" section
+carries a table with one row per family (what the program does, where
+it dispatches).  This check parses BOTH sides from source — the module
+is never imported — and fails on drift in either direction:
+
+* a family registered in ``DISPATCH_FAMILIES`` but missing from the
+  docs table (undocumented program family), or
+* a documented family that no longer exists in the tuple (stale row).
+
+Run directly (``python scripts/check_compiled_families.py``) or via
+the tier-1 wrapper ``tests/test_check_compiled_families.py``.  Exit
+code 0 = clean.  Same contract as the sibling checks
+(check_alert_rules, check_metric_names, check_context_knobs, ...).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SOURCE = os.path.join(REPO, "analytics_zoo_tpu", "observability",
+                      "profiling.py")
+DOC = os.path.join(REPO, "docs", "observability.md")
+SECTION = "## Dispatch ledger"
+
+REGISTRY = re.compile(r"DISPATCH_FAMILIES\s*=\s*\((.*?)\)", re.DOTALL)
+NAME = re.compile(r"[\"']([A-Za-z0-9_]+)[\"']")
+ROW_TOKEN = re.compile(r"^\|\s*`([A-Za-z0-9_]+)`")
+
+
+def registered_families(source_text: str = None) -> List[str]:
+    """Family names in the ``DISPATCH_FAMILIES`` tuple, source-parsed
+    (from `source_text` when given — the drift tests feed synthetic
+    sources)."""
+    if source_text is None:
+        with open(SOURCE, encoding="utf-8") as f:
+            source_text = f.read()
+    m = REGISTRY.search(source_text)
+    if not m:
+        raise AssertionError(
+            f"DISPATCH_FAMILIES tuple not found in {SOURCE}")
+    return NAME.findall(m.group(1))
+
+
+def documented_families(docs_text: str = None) -> Set[str]:
+    """Backticked first-cell tokens of the table rows inside the
+    "## Dispatch ledger" section."""
+    if docs_text is None:
+        with open(DOC, encoding="utf-8") as f:
+            docs_text = f.read()
+    out: Set[str] = set()
+    in_section = False
+    for line in docs_text.splitlines():
+        if line.startswith("## "):
+            in_section = line.strip() == SECTION
+            continue
+        if not in_section:
+            continue
+        m = ROW_TOKEN.match(line.strip())
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+def find_violations(source_text: str = None,
+                    docs_text: str = None) -> List[Tuple[str, str]]:
+    registered = registered_families(source_text)
+    documented = documented_families(docs_text)
+    violations: List[Tuple[str, str]] = []
+    for fam in registered:
+        if fam not in documented:
+            violations.append(
+            ("undocumented", f"family {fam!r} is registered in "
+             "profiling.DISPATCH_FAMILIES but has no row in the "
+             f"'{SECTION}' table of docs/observability.md"))
+    for fam in sorted(documented):
+        if fam not in registered:
+            violations.append(
+                ("stale", f"family {fam!r} is documented in "
+                 f"'{SECTION}' but absent from "
+                 "profiling.DISPATCH_FAMILIES"))
+    return violations
+
+
+def main() -> int:
+    violations = find_violations()
+    if not violations:
+        print("check_compiled_families: clean "
+              f"({len(registered_families())} families)")
+        return 0
+    print(f"check_compiled_families: {len(violations)} violation(s)",
+          file=sys.stderr)
+    for kind, msg in violations:
+        print(f"  [{kind}] {msg}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

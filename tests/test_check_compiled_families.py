@@ -1,0 +1,89 @@
+"""Tier-1 wrapper for scripts/check_compiled_families.py: the repo is
+clean in both directions, and the lint actually catches synthetic
+drift (registered family with no docs row; documented family no longer
+in the tuple)."""
+
+import importlib.util
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_compiled_families",
+        os.path.join(ROOT, "scripts", "check_compiled_families.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+ccf = _load()
+
+SOURCE_OK = 'DISPATCH_FAMILIES = (\n    "decode",\n)\n'
+DOCS_OK = """\
+# observability
+
+## Dispatch ledger
+
+| family | program |
+| --- | --- |
+| `decode` | the one-signature batched decode step |
+
+## Metric index
+
+| metric | kind |
+| --- | --- |
+| `dispatch_calls_total` | counter |
+"""
+
+
+def test_repo_is_clean():
+    assert ccf.find_violations() == []
+    assert ccf.main() == 0
+
+
+def test_registry_matches_import():
+    """The source-parsed tuple equals the importable one — the lint
+    reads source (no import-time deps) but must track reality."""
+    from analytics_zoo_tpu.observability.profiling import (
+        DISPATCH_FAMILIES)
+    assert tuple(ccf.registered_families()) == DISPATCH_FAMILIES
+
+
+def test_synthetic_pair_is_clean():
+    assert ccf.find_violations(SOURCE_OK, DOCS_OK) == []
+
+
+def test_detects_undocumented_family():
+    drifted = SOURCE_OK.replace(
+        '"decode",', '"decode",\n    "ghost_family",')
+    viol = ccf.find_violations(drifted, DOCS_OK)
+    assert len(viol) == 1
+    assert viol[0][0] == "undocumented"
+    assert "ghost_family" in viol[0][1]
+
+
+def test_detects_stale_documented_family():
+    drifted = DOCS_OK.replace(
+        "| `decode` | the one-signature batched decode step |",
+        "| `decode` | the one-signature batched decode step |\n"
+        "| `phantom_family` | never existed |")
+    viol = ccf.find_violations(SOURCE_OK, drifted)
+    assert len(viol) == 1
+    assert viol[0][0] == "stale"
+    assert "phantom_family" in viol[0][1]
+
+
+def test_parse_stops_at_next_section():
+    """Backticked tokens in OTHER sections (e.g. the metric index)
+    never count as documented families."""
+    docs = ccf.documented_families(DOCS_OK)
+    assert docs == {"decode"}
+    assert "dispatch_calls_total" not in docs
+
+
+def test_subheadings_do_not_end_the_section():
+    docs = DOCS_OK.replace(
+        "| family | program |",
+        "### Families\n\n| family | program |")
+    assert ccf.documented_families(docs) == {"decode"}

@@ -158,6 +158,10 @@ def test_ae_detector():
     assert any(110 <= i <= 129 for i in idx), idx
 
 
+@pytest.mark.slow   # ~9s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_search_engine_halving keeps the AutoTS search-engine
+# contract (successive halving over configs) in the gate; the full
+# estimator-returns-fitted-pipeline flow moves out.
 def test_autots_estimator_returns_pipeline(tmp_path):
     from analytics_zoo_tpu.chronos.autots import AutoTSEstimator, TSPipeline
     from analytics_zoo_tpu.orca.automl import hp

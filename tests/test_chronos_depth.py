@@ -19,7 +19,31 @@ def _sine_series(n_samples, lookback, horizon, seed=0):
     return x, y
 
 
-def test_mtnet_learns_sine():
+@pytest.fixture()
+def fresh_compile_no_persistent_cache():
+    """Compile this test's programs fresh instead of loading persisted
+    XLA:CPU executables.  Root cause of the historical nan flake here:
+    XLA:CPU compiles are not bit-deterministic across runs, and this
+    test's training trajectory (adam @ 5e-3 over GRU + attention) sits
+    close enough to a float-sensitivity boundary that an unlucky
+    compile variant tips steps non-finite (the estimator's skip-guard
+    then freezes params and evaluate() is nan).  In isolation the
+    train-step compile is < the 5s persistence floor so nothing is
+    ever cached — but a CONTENDED full-suite run can push it past 5s
+    and freeze an unlucky variant into .jax_cache_tests, after which
+    every warm run deterministically reloads it and fails (observed:
+    one jit__train_step_impl entry reproduced the failure alone; the
+    same r6-revert signature documented in tests/conftest.py).
+    Disabling the persistent cache for this test makes its behavior a
+    function of the code, not of cache-dir history."""
+    import jax
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_mtnet_learns_sine(fresh_compile_no_persistent_cache):
     from analytics_zoo_tpu.chronos.forecaster import MTNetForecaster
 
     init_orca_context(cluster_mode="local")

@@ -314,6 +314,12 @@ def test_hot_swap_mid_decode_finishes_on_old_version(lm):
         == "ready"
 
 
+@pytest.mark.slow   # ~10s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_hot_swap_mid_decode_finishes_on_old_version and
+# test_server_hot_swap_live keep swap/rollback in the gate, and the
+# compiles-stay-bounded contract is pinned tier-1 by
+# test_router_zero_recompile_fully_armed (test_distributed_serving)
+# and the dispatch-ledger composition test in test_profiling.
 def test_swap_then_rollback_keeps_compiles_bounded(lm):
     """Version engines persist across swap/rollback cycles: one jitted
     decode family per loaded version, no matter how often traffic

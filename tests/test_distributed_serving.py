@@ -216,6 +216,11 @@ def test_router_requires_distinct_registries(lm):
         e.stop()
 
 
+@pytest.mark.slow   # ~11s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_replica_death_mid_stream_requeues_once and
+# test_all_draining_sheds_503_with_retry_after keep the router serve
+# path in the gate, and test_router_zero_recompile_fully_armed keeps
+# routed generation end-to-end; the load-spread statistics move out.
 def test_router_serves_and_spreads_load(lm, router, server):
     from analytics_zoo_tpu.serving import InputQueue
     from urllib.request import urlopen

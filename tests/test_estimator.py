@@ -116,6 +116,11 @@ def test_binary_accuracy_logits_convention():
     assert float(vals.mean()) == 1.0
 
 
+@pytest.mark.slow   # ~12s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_checkpoint_save_load_roundtrip,
+# test_async_checkpoint_gate_and_roundtrip and
+# test_find_latest_skips_torn_checkpoint keep the checkpoint plane in
+# the gate; only the pre-scan -> scanned layout migration moves out.
 def test_pre_scan_checkpoint_loads_into_scanned_transformer(tmp_path):
     """Checkpoints written with the unrolled block_i layout restore into
     scan-over-layers modules (load_checkpoint stacks the subtrees)."""

@@ -312,6 +312,12 @@ def test_defaults_off_is_legacy_eviction_path(lm):
 # router: phase-aware prefill/decode disaggregation
 # ----------------------------------------------------------------------
 
+@pytest.mark.slow   # ~11s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_phase_blind_router_has_no_phase_state keeps the
+# phase-state plumbing honest, and spill/restore correctness stays in
+# the gate via test_spill_restore_round_trip_matches_greedy and
+# test_staged_restore_race_falls_back_to_recompute; the end-to-end
+# two-replica phase-routing drive moves out.
 def test_router_phase_routing_over_shared_tier(lm):
     from analytics_zoo_tpu.serving.distributed import ReplicaRouter
 

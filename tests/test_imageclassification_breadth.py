@@ -54,6 +54,12 @@ def test_backbones_registered_in_image_classifier():
     assert clf.get_config()["model_name"] == "mobilenet-v2"
 
 
+@pytest.mark.slow   # ~12s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_backbone_fit_predict keeps VGG16 as the
+# representative backbone in the gate, and
+# test_backbones_registered_in_image_classifier keeps the mobilenet
+# constructor/registration; the residual-shape walk moves out
+# alongside the already-slow mobilenet fit.
 def test_mobilenet_residual_shapes():
     import jax
     m = MobileNetV2(num_classes=4, width=0.25)

@@ -22,7 +22,7 @@ import urllib.request
 import pytest
 
 from analytics_zoo_tpu.common.context import OrcaContext
-from analytics_zoo_tpu.observability import alerts, history
+from analytics_zoo_tpu.observability import alerts, history, slo
 from analytics_zoo_tpu.observability.alerts import (
     BUILTIN_ALERTS,
     AlertEngine,
@@ -59,8 +59,19 @@ def hist_env(tmp_path):
     OrcaContext.observability_dir = str(tmp_path / "obs")
     OrcaContext.metrics_history_interval_s = 0.05
     history.reset_recorder()
+    # Any earlier test that touched get_slo_tracker() left the
+    # registry's `slo_attainment_ratio` gauge backed by that tracker's
+    # attainment() callback — and Gauge reads prefer `fn` over set(),
+    # so this fixture's scenario writes would be silently shadowed by
+    # the stale tracker (the order-dependence behind the flight-bundle
+    # flake).  Re-create the tracker and detach the callback for the
+    # fixture's lifetime; teardown re-creates it again, re-attaching
+    # the callback for whoever runs next.
+    slo.reset_slo_tracker()
+    get_registry().gauge("slo_attainment_ratio").fn = None
     yield str(tmp_path / "obs")
     history.reset_recorder()
+    slo.reset_slo_tracker()
     OrcaContext.observability_dir = prev_dir
     OrcaContext.metrics_history_interval_s = prev_int
     OrcaContext.metrics_history_max_bytes = prev_max
@@ -459,12 +470,14 @@ def test_step_emits_metrics_once_and_flight_instant(hist_env):
 # flight-recorder bundles embed the history tail + active alerts
 # ----------------------------------------------------------------------
 
-def test_flight_bundle_embeds_history_and_alerts(hist_env):
+def _flight_bundle_scenario():
+    """Record an SLO collapse into the live recorder, dump a flight
+    bundle, and assert the history tail + active burn alert rode it.
+    Shared by the plain test and the order-independence pin below."""
     from analytics_zoo_tpu.observability import flight_recorder
     rec = history.get_recorder()
     assert rec is not None
-    reg = get_registry()
-    g = reg.gauge("slo_attainment_ratio")
+    g = get_registry().gauge("slo_attainment_ratio")
     for i in range(30):
         g.set(1.0 if i < 10 else 0.0)
         rec.sample(wall_ts=T0 + i * 3.0)
@@ -475,6 +488,28 @@ def test_flight_bundle_embeds_history_and_alerts(hist_env):
     assert bundle["history_tail"][-1]["proc"] == rec.proc
     assert "slo_burn_rate" in bundle["alerts_active"], \
         "active alerts must ride the post-mortem bundle"
+
+
+def test_flight_bundle_embeds_history_and_alerts(hist_env):
+    _flight_bundle_scenario()
+
+
+def test_flight_bundle_scenario_is_order_independent(hist_env):
+    """Same-process double-run pin for the fixed flake: instantiating
+    the global SLO tracker re-attaches its attainment() callback to
+    the `slo_attainment_ratio` gauge (exactly what any earlier SLO
+    test does), which would shadow the scenario's set() writes.  The
+    fixture's remedy — detach the callback — must neutralise that
+    pollution, and the scenario must be re-runnable in-process."""
+    # the pollution an earlier test leaves: a freshly built tracker
+    # re-attaches its callback (the fixture's get is cached, so force
+    # a rebuild the way test-ordered SLO suites do)
+    slo.reset_slo_tracker()
+    assert get_registry().gauge("slo_attainment_ratio").fn is not None
+    get_registry().gauge("slo_attainment_ratio").fn = None
+    _flight_bundle_scenario()
+    history.reset_recorder()        # second run: fresh recorder, same proc
+    _flight_bundle_scenario()
 
 
 # ----------------------------------------------------------------------

@@ -64,6 +64,10 @@ def test_session_recommender():
     assert preds.shape == (120, 51)
 
 
+@pytest.mark.slow   # ~10s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_zoo_model_save_load trains the cnn TextClassifier
+# and round-trips it through save/load in the gate at ~5s; only the
+# cnn-vs-gru encoder comparison moves out.
 def test_text_classifier_cnn_and_gru():
     from analytics_zoo_tpu.models.textclassification import TextClassifier
     rng = np.random.default_rng(0)
@@ -93,6 +97,10 @@ def test_knrm_forward_and_rank():
     assert scores.shape == (32, 1)
 
 
+@pytest.mark.slow   # ~13s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_seq2seq_infer_closed_loop keeps the seq2seq
+# encode/decode contract (and the closed-loop inference path) in the
+# gate; teacher-forcing training convergence moves out.
 def test_seq2seq_teacher_forcing():
     from analytics_zoo_tpu.models.seq2seq import Seq2Seq
     rng = np.random.default_rng(0)

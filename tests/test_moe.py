@@ -78,6 +78,11 @@ def test_capacity_drops_tokens(dense_mesh):
     assert _capacity(32, 2, 0.25) == 4
 
 
+@pytest.mark.slow   # ~12s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_moe_trains_on_ep_mesh keeps the EP dispatch path
+# executing (and training) in the gate at ~7s and
+# test_dense_path_math pins the reference math; the exact EP-vs-dense
+# parity sweep moves out.
 def test_ep_path_matches_dense(ep_mesh):
     """With ample capacity (no drops anywhere) the grouped expert-
     parallel path computes the same per-token outputs as the dense

@@ -93,6 +93,10 @@ def _bert_kwargs():
                 hidden_drop=0.0)
 
 
+@pytest.mark.slow   # ~10s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_bert_squad_trains_span_extraction keeps a bert task
+# head training end-to-end in the gate at ~7s; the token-tagging head
+# variant moves out.
 def test_bert_ner_trains_token_tagging():
     from analytics_zoo_tpu.models.bert import BERTNER
 

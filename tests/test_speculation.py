@@ -222,6 +222,11 @@ def test_spec_stream_identical_to_legacy_composed(cyc, spec_pair):
     assert len(rec["events"]) <= request_log.MAX_EVENTS_PER_REQUEST
 
 
+@pytest.mark.slow   # ~8s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_spec_stream_identical_to_legacy_composed keeps
+# accept/rollback output parity in the gate and
+# test_spec_preemption_lossless keeps rollback-across-preemption;
+# the exact per-round ledger accounting moves out.
 def test_spec_rollback_ledger_exact_after_mixed_rounds(cyc):
     """100+ mixed accept/reject verify rounds, then drain: every
     speculative block came back through the free list — available ==

@@ -4,6 +4,7 @@
 import os
 
 import numpy as np
+import pytest
 
 from analytics_zoo_tpu import init_orca_context
 from analytics_zoo_tpu.utils.summary import SummaryWriter, load_scalars
@@ -64,6 +65,10 @@ def test_estimator_tensorboard_and_profile(tmp_path):
     assert all(p["step_time_s"] > 0 for p in est.profile_stats)
 
 
+@pytest.mark.slow   # ~23s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_estimator_tensorboard_and_profile keeps the
+# profile=True path (per-step profile_stats + event files) in the
+# gate at ~5s; this test only adds the jax.profiler trace-dir write.
 def test_profiler_dir_writes_trace(tmp_path):
     import os
     import flax.linen as nn

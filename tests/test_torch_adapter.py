@@ -125,6 +125,11 @@ def test_embedding_parity():
     np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
 
 
+@pytest.mark.slow   # ~15s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_from_torch_batchnorm_stats_update keeps the
+# from_torch fit path (and mutable-state updates) in the gate at ~4s,
+# test_from_torch_predict_and_checkpoint keeps predict/checkpoint;
+# only the trains-to-high-accuracy bar itself moves out.
 def test_from_torch_trains_to_accuracy():
     """BASELINE config #3 analog: torch CNN classifier through
     Estimator.fit on the 8-device mesh."""

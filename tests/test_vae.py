@@ -60,6 +60,10 @@ def test_vae_trains_elbo_and_generates():
     assert not np.array_equal(gen, gen2)
 
 
+@pytest.mark.slow   # ~8s warm (PR 19 budget trim): sibling tier-1
+# coverage: test_vae_trains_elbo_and_generates keeps the VAE
+# train/generate contract in the gate; the beta-KL monotonicity
+# refinement (which trains twice) moves out.
 def test_vae_beta_scales_kl_pressure():
     """beta-VAE: a large beta pushes the posterior toward the prior —
     final KL must be smaller than with beta=0.01 on the same data."""
