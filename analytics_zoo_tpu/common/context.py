@@ -63,6 +63,9 @@ class OrcaContextMeta(type):
     _nonfinite_watchdog = False
     _slo_targets = None
     _request_log_size = 256
+    _blame_tolerance = 0.05
+    _exemplar_count = 16
+    _exemplar_max_bytes = 64 * 1024
     _memory_sample_interval_s = 1.0
     _fault_plan = None
     _background_checkpointing = False
@@ -466,6 +469,51 @@ class OrcaContextMeta(type):
         if int(value) < 1:
             raise ValueError("request_log_size must be >= 1")
         cls._request_log_size = int(value)
+
+    @property
+    def blame_tolerance(cls):
+        """Relative slack of the phase-ledger additivity invariant
+        (observability/blame.py): a finished request's ledger must sum
+        to its e2e within this fraction (an absolute 0.1 ms floor
+        covers sub-millisecond e2e).  Violations flip the ledger's
+        `additive_ok` flag and tick
+        `blame_additivity_violations_total`; the bench overload gate
+        hard-fails on any violation at the default 5%."""
+        return cls._blame_tolerance
+
+    @blame_tolerance.setter
+    def blame_tolerance(cls, value):
+        if not (0.0 < float(value) <= 1.0):
+            raise ValueError("blame_tolerance must be in (0, 1]")
+        cls._blame_tolerance = float(value)
+
+    @property
+    def exemplar_count(cls):
+        """Max tail exemplars held by the per-process store
+        (observability/exemplars.py).  SLO violators displace
+        non-violators; otherwise classic top-k-slowest.  0 disables
+        capture entirely."""
+        return cls._exemplar_count
+
+    @exemplar_count.setter
+    def exemplar_count(cls, value):
+        if int(value) < 0:
+            raise ValueError("exemplar_count must be >= 0")
+        cls._exemplar_count = int(value)
+
+    @property
+    def exemplar_max_bytes(cls):
+        """JSON byte bound per captured exemplar: span/dispatch/
+        scheduler/event tails are halved (newest kept) until the
+        document fits — degrade, don't die, same idiom as the
+        telemetry spool."""
+        return cls._exemplar_max_bytes
+
+    @exemplar_max_bytes.setter
+    def exemplar_max_bytes(cls, value):
+        if int(value) < 2048:
+            raise ValueError("exemplar_max_bytes must be >= 2048")
+        cls._exemplar_max_bytes = int(value)
 
     @property
     def memory_sample_interval_s(cls):

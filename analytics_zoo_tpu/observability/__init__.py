@@ -58,6 +58,8 @@ from analytics_zoo_tpu.observability.goodput import (  # noqa: F401
     step_clock,
 )
 from analytics_zoo_tpu.observability import (  # noqa: F401
+    blame,
+    exemplars,
     flight_recorder,
     history,
     memory,
@@ -66,6 +68,19 @@ from analytics_zoo_tpu.observability import (  # noqa: F401
     telemetry_spool,
     timeline,
     trace_context,
+)
+from analytics_zoo_tpu.observability.blame import (  # noqa: F401
+    BlameTracker,
+    PHASES,
+    blame_payload,
+    get_blame_tracker,
+    phase_ledger,
+    reset_blame_tracker,
+)
+from analytics_zoo_tpu.observability.exemplars import (  # noqa: F401
+    ExemplarStore,
+    get_exemplar_store,
+    reset_exemplar_store,
 )
 from analytics_zoo_tpu.observability.alerts import (  # noqa: F401
     AlertEngine,
@@ -128,16 +143,20 @@ from analytics_zoo_tpu.observability.watchdog import (  # noqa: F401
 )
 
 __all__ = [
-    "AlertEngine", "AlertRule", "BUILTIN_ALERTS", "CausalLMFlops",
-    "Counter", "DISPATCH_FAMILIES",
+    "AlertEngine", "AlertRule", "BUILTIN_ALERTS", "BlameTracker",
+    "CausalLMFlops",
+    "Counter", "DISPATCH_FAMILIES", "ExemplarStore",
     "FleetAggregator", "Gauge", "Histogram", "HistoryReader",
-    "MetricsRecorder", "MetricsRegistry", "RequestLog", "SLOTracker",
+    "MetricsRecorder", "MetricsRegistry", "PHASES", "RequestLog",
+    "SLOTracker",
     "SampleLog", "Span", "StepClock",
     "TelemetrySpool", "TraceContext", "Watchdog", "annotate",
-    "builtin_rules",
+    "blame", "blame_payload", "builtin_rules",
     "clear_spans", "close_sink", "compile_events", "current_span",
-    "current_trace_context", "diff_signatures", "export_timeline",
+    "current_trace_context", "diff_signatures", "exemplars",
+    "export_timeline",
     "flight_recorder",
+    "get_blame_tracker", "get_exemplar_store",
     "get_recorder", "get_registry", "get_request_log",
     "get_shadow_slo_tracker", "get_slo_tracker",
     "goodput_tables", "history", "instrument",
@@ -147,9 +166,11 @@ __all__ = [
     "memory",
     "merged_prometheus_text", "nearest_rank", "new_request_id",
     "nonfinite_leaves", "now", "parse_prometheus_text",
-    "parse_traceparent", "process_goodput_ratio", "profiling",
+    "parse_traceparent", "phase_ledger", "process_goodput_ratio",
+    "profiling",
     "recent_spans",
-    "record_work", "request_log", "reset_recorder",
+    "record_work", "request_log", "reset_blame_tracker",
+    "reset_exemplar_store", "reset_recorder",
     "reset_profiling", "reset_registry",
     "reset_request_log",
     "reset_slo_tracker", "sanitize_metric_name", "step_clock",

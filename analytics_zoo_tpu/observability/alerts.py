@@ -3,7 +3,8 @@
 The `AlertEngine` consumes the sample lists the metrics history plane
 records (observability/history.py) and evaluates a fixed set of
 declarative rules — multi-window SLO burn rate, queue-growth slope,
-floor collapses — with hysteresis and cooldown.  Two-layer design:
+floor collapses, dominant-blame-phase shifts — with hysteresis and
+cooldown.  Two-layer design:
 
 * `evaluate(samples)` is a PURE function of the samples: every
   timestamp in the state machine comes from the samples themselves
@@ -43,9 +44,10 @@ BUILTIN_ALERTS = (
     "prefix_cache_collapse",
     "speculation_collapse",
     "recompile_storm",
+    "blame_shift",
 )
 
-_KINDS = ("burn_rate", "slope", "floor")
+_KINDS = ("burn_rate", "slope", "floor", "shift")
 
 
 @dataclass
@@ -65,6 +67,12 @@ class AlertRule:
       counters' combined rate over the window to exceed the guard
       (a cache with no traffic is not "collapsed"); clears once the
       mean >= ``floor * clear_ratio``.
+    * ``shift`` — a categorical gauge (e.g. the blame plane's
+      ``blame_tail_phase_code``) whose latest value differs from the
+      modal value of the older points in ``window_s`` (needs >=
+      ``min_points`` points; negative values are the no-data
+      sentinel).  Clears — resolving naturally — once the new value
+      has persisted long enough to BECOME the window's mode.
     """
     name: str
     metric: str
@@ -129,6 +137,16 @@ def builtin_rules() -> Tuple[AlertRule, ...]:
                     "clear_ratio": 0.25},
             for_s=5.0, clear_s=10.0, cooldown_s=60.0,
             severity="page"),
+        AlertRule(
+            # the dominant p99-tail blame phase changed (queue-
+            # dominated ↔ compute-dominated ↔ ...): exactly the
+            # distinction the SLO autoscaler keys scale-out vs
+            # scale-up decisions on, so a shift is worth a page-less
+            # heads-up even before any SLO burns
+            "blame_shift", metric="blame_tail_phase_code",
+            kind="shift",
+            params={"window_s": 60.0, "min_points": 3.0},
+            for_s=5.0, clear_s=10.0, cooldown_s=60.0),
     )
     rules[3].params["guard_counters"] = (
         "prefix_cache_hits_total", "prefix_cache_misses_total")
@@ -248,6 +266,24 @@ class AlertEngine:
             thr = p["min_slope"]
             return (round(slope, 9), slope > thr,
                     slope < thr * p.get("clear_ratio", 0.5))
+        if rule.kind == "shift":
+            pts = _window(_metric_points(samples, rule.metric), ts,
+                          p["window_s"])
+            if len(pts) < int(p.get("min_points", 3)):
+                return None, False, False
+            latest = pts[-1][1]
+            older = [v for _t, v in pts[:-1] if v >= 0]
+            if latest < 0 or not older:
+                return None, False, False   # no-data sentinel
+            counts: Dict[float, int] = {}
+            for v in older:
+                counts[v] = counts.get(v, 0) + 1
+            peak = max(counts.values())
+            # ties broken by smallest value — deterministic under
+            # replay regardless of dict iteration history
+            baseline = min(v for v, c in counts.items() if c == peak)
+            changed = latest != baseline
+            return round(latest, 9), changed, not changed
         # floor
         pts = _window(_metric_points(samples, rule.metric), ts,
                       p["window_s"])

@@ -25,9 +25,15 @@ all of it back into one pane:
 * **SLO** (`fleet_slo`): per-source attainment snapshots, per-replica
   attainment derived from the request log's ``replica_dispatch``
   events, and a judged-request-weighted fleet rollup.
+* **blame** (`fleet_blame` / `fleet_exemplar`): the exact fleet sum of
+  the ``blame_*_seconds_total`` counters plus every source's tail
+  exemplars — a SIGKILL'd replica's worst-request forensics arrive
+  through its spool snapshot like its counters do.
 
 Served by `ServingServer` as ``GET /metrics?fleet=1``,
-``GET /timeline?fleet=1`` and the ``"fleet"`` block of ``GET /stats``.
+``GET /timeline?fleet=1``, ``GET /blame?fleet=1``,
+``GET /debug/requests/<id>`` and the ``"fleet"`` block of
+``GET /stats``.
 """
 
 from __future__ import annotations
@@ -146,6 +152,9 @@ class FleetAggregator:
         local source also carries the span ring / request log; spooled
         sources carry their snapshot doc verbatim."""
         from analytics_zoo_tpu.observability import request_log, tracing
+        from analytics_zoo_tpu.observability.exemplars import (
+            get_exemplar_store,
+        )
         from analytics_zoo_tpu.observability.slo import get_slo_tracker
         import time
 
@@ -159,6 +168,7 @@ class FleetAggregator:
             "requests": request_log.get_request_log().records(
                 SPOOL_REQUEST_TAIL, include_active=True),
             "slo": get_slo_tracker().snapshot(),
+            "exemplars": get_exemplar_store().snapshot(),
         }]
         live = list(self._live)
         if self._router is not None:
@@ -169,7 +179,8 @@ class FleetAggregator:
         for name, regs in live:
             srcs.append({"name": name, "kind": "live",
                          "pid": os.getpid(), "regs": regs,
-                         "spans": [], "requests": [], "slo": None})
+                         "spans": [], "requests": [], "slo": None,
+                         "exemplars": []})
         if self._include_spooled:
             me = os.getpid()
             for doc in read_snapshots(self._dir):
@@ -184,6 +195,7 @@ class FleetAggregator:
                     "spans": doc.get("spans") or [],
                     "requests": doc.get("requests") or [],
                     "slo": doc.get("slo"),
+                    "exemplars": doc.get("exemplars") or [],
                 })
         self._g_sources.set(len(srcs))
         self._g_spooled.set(
@@ -431,6 +443,76 @@ class FleetAggregator:
             window_s=window_s, fleet=True,
             enabled=OrcaContext.metrics_history_interval_s is not None
             or bool(merged))
+
+    # ------------------------------------------------------------------
+    # blame
+    # ------------------------------------------------------------------
+
+    def fleet_blame(self) -> Dict[str, Any]:
+        """The GET /blame?fleet=1 body: the local rollup plus the
+        EXACT fleet counter merge — `blame_<phase>_seconds_total` /
+        `blame_requests_total` are float counters, so summing the
+        per-source expositions reproduces the per-replica registries'
+        totals bit-for-bit (same contract as `fleet_prometheus_text`)
+        — and every source's tail-exemplar index (a SIGKILL'd
+        replica's exemplars arrive via its spool snapshot)."""
+        from analytics_zoo_tpu.observability import blame
+
+        srcs = self.sources()
+        counters: Dict[str, float] = {}
+        for s in srcs:
+            parsed = parse_prometheus_text(self._exposition(s))
+            for mname, entry in parsed.items():
+                if not mname.startswith(("blame_", "exemplars_")):
+                    continue
+                if entry.get("type") != "counter":
+                    continue
+                counters[mname] = (counters.get(mname, 0.0)
+                                   + float(entry.get("value", 0.0)))
+        exemplar_rows: List[Dict[str, Any]] = []
+        for s in srcs:
+            for d in s.get("exemplars") or []:
+                led = d.get("ledger") or {}
+                phases = led.get("phases") or {}
+                exemplar_rows.append({
+                    "request_id": d.get("request_id"),
+                    "source": s["name"],
+                    "reason": d.get("reason"),
+                    "e2e_s": led.get("e2e_s"),
+                    "dominant_phase": (max(phases.items(),
+                                           key=lambda kv: kv[1])[0]
+                                       if phases else None),
+                })
+        exemplar_rows.sort(key=lambda r: -(r.get("e2e_s") or 0.0))
+        return {
+            "local": blame.blame_payload(),
+            "counters": {k: counters[k] for k in sorted(counters)},
+            "sources": len(srcs),
+            "exemplars": exemplar_rows[:64],
+        }
+
+    def fleet_exemplar(self, request_id: str
+                       ) -> Optional[Dict[str, Any]]:
+        """One exemplar by request id, searched across every source
+        (live store first, then spooled snapshots) — the fleet half of
+        GET /debug/requests/<id>."""
+        from analytics_zoo_tpu.observability.exemplars import (
+            get_exemplar_store,
+        )
+
+        doc = get_exemplar_store().get(request_id)
+        if doc is not None:
+            doc["source"] = self.local_name
+            return doc
+        for s in self.sources():
+            if s["kind"] != "spool":
+                continue
+            for d in s.get("exemplars") or []:
+                if str(d.get("request_id")) == str(request_id):
+                    d = dict(d)
+                    d["source"] = s["name"]
+                    return d
+        return None
 
     # ------------------------------------------------------------------
     # SLO

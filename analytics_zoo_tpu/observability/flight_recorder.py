@@ -166,6 +166,7 @@ def dump(reason: str, extra: Optional[Dict[str, Any]] = None,
             "alerts_active": _alerts_active_safe(),
             "dispatch": _dispatch_safe(),
             "compile_events": _compile_events_safe(),
+            "exemplars": _exemplars_safe(),
             "thread_stacks": _thread_stacks(),
         }
         if exc is not None:
@@ -248,6 +249,19 @@ def _compile_events_safe(n: int = 32) -> List[Dict[str, Any]]:
     try:
         from analytics_zoo_tpu.observability import profiling
         return profiling.compile_events(n)
+    except Exception:
+        return []
+
+
+def _exemplars_safe(n: int = 8) -> List[Dict[str, Any]]:
+    """The worst `n` tail exemplars (observability/exemplars.py) — a
+    post-mortem opens with the requests that were already hurting
+    before the process died (empty when none were captured)."""
+    try:
+        from analytics_zoo_tpu.observability.exemplars import (
+            get_exemplar_store,
+        )
+        return get_exemplar_store().snapshot()[:n]
     except Exception:
         return []
 

@@ -21,30 +21,41 @@ schedulers are judged on (Orca/vLLM-style):
 * **e2e** — `finish - enqueue`,
 
 feeding the `request_ttft_seconds` / `request_tpot_seconds` /
-`request_queue_wait_seconds` / `request_e2e_seconds` histograms and the
-SLO tracker (observability/slo.py).
+`request_queue_wait_seconds` / `request_e2e_seconds` histograms, the
+SLO tracker (observability/slo.py), and — via `blame.observe_finished`
+— the latency blame plane (observability/blame.py), which decomposes
+the e2e into an additive phase ledger from the record's exact `blame`
+second-accumulators (`attribute()` below).
+
+Round accounting is speculation-exact: `n_rounds` counts every
+scheduling round (prefill chunks + decode participations) for
+backwards compatibility, and splits decode participations into
+`n_decode_rounds` (non-speculative rounds and rider lanes inside a
+verify dispatch — exactly one emitted token each) and `n_spec_rounds`
+(speculative verify rounds on drafted lanes, which emit up to k+1
+tokens each, counted exactly in `n_spec_tokens` at emission time so an
+eos mid-burst is respected).  The invariant the tests pin: a cleanly
+finished request satisfies
+``n_tokens == 1 + n_decode_rounds + n_spec_tokens``
+(the leading 1 is the token prefill emits) — replacing the PR 15 note
+that `n_rounds >= n_tokens` "deliberately flips" under speculation
+with bookkeeping the blame ledger can trust.
 
 Boundedness: finished records live in a ring of
 `OrcaContext.request_log_size` entries; per record at most
 `MAX_EVENTS_PER_REQUEST` events are stored (overflow is counted, not
 kept), and decode rounds are sampled at powers of two (rounds 1, 2, 4,
 8, ...) so a 10k-token generation stores O(log n) events while
-`n_rounds` / `n_tokens` stay exact.  Invariants the tests pin: event
-timestamps are monotone per record, `ttft <= e2e`, and a
-preempted-then-resumed request keeps ONE id.  Without speculative
-decoding `n_rounds >= n_tokens`; a speculative verify round
-(engine.py `_spec_round`) counts as ONE round but can emit up to k+1
-accepted tokens (its `spec_propose`/`spec_accept` events are
-pow2-sampled like decode rounds), so under
-`OrcaContext.speculative_decoding` that inequality deliberately
-flips.
+`n_rounds` / `n_tokens` / the blame accumulators stay exact.
+Invariants the tests pin: event timestamps are monotone per record,
+`ttft <= e2e`, and a preempted-then-resumed request keeps ONE id.
 
 Everything here is observability: the hot-loop entry points
-(`event`/`decode_round`/`token`/`finish`) never raise into the engine.
-Timestamps are taken on the monotonic `observability.now` clock for
-durations/ordering, with one wall-clock anchor per request at enqueue
-so the timeline exporter (observability/timeline.py) can place records
-on the shared wall-time axis.
+(`event`/`decode_round`/`token`/`attribute`/`finish`) never raise into
+the engine.  Timestamps are taken on the monotonic `observability.now`
+clock for durations/ordering, with one wall-clock anchor per request
+at enqueue so the timeline exporter (observability/timeline.py) can
+place records on the shared wall-time axis.
 """
 
 from __future__ import annotations
@@ -69,6 +80,11 @@ MAX_EVENTS_PER_REQUEST = 48
 #: of the request); decode rounds are counted via `decode_round`
 _ROUND_KINDS = ("prefill",)
 
+#: blame phases `start(blame_seed=...)` accepts: waits that happened
+#: BEFORE this record existed (quota retry loops, replica-death
+#: requeue) and must still land inside the e2e decomposition
+_SEEDABLE_PHASES = ("quota_throttle", "requeue")
+
 
 def new_request_id() -> str:
     return uuid.uuid4().hex[:16]
@@ -90,7 +106,11 @@ class RequestRecord:
                  "finish_reason", "wall_enqueue", "t_enqueue", "t_admit",
                  "t_first_token", "t_last_token", "t_finish", "n_tokens",
                  "n_rounds", "n_preempts", "events", "n_events_dropped",
-                 "model", "tenant", "request_class")
+                 "model", "tenant", "request_class",
+                 # blame plane (observability/blame.py)
+                 "blame", "replica", "t_paused", "paused_phase",
+                 "n_decode_rounds", "n_spec_rounds", "n_spec_tokens",
+                 "in_spec_round")
 
     def __init__(self, request_id: str, prompt_len: int,
                  max_new_tokens: int, model: Optional[str] = None,
@@ -120,6 +140,19 @@ class RequestRecord:
         self.events: List[Dict[str, Any]] = [
             {"kind": "enqueue", "t": t, "prompt_len": prompt_len}]
         self.n_events_dropped = 0
+        #: exact attributed seconds per blame phase — the measured side
+        #: of the additive e2e decomposition (blame.phase_ledger)
+        self.blame: Dict[str, float] = {}
+        #: router attribution (set by the replica_dispatch event)
+        self.replica: Optional[str] = None
+        #: open not-running interval (preempt → resume/finish)
+        self.t_paused: Optional[float] = None
+        self.paused_phase: Optional[str] = None
+        #: speculation-exact decode accounting (module docstring)
+        self.n_decode_rounds = 0
+        self.n_spec_rounds = 0
+        self.n_spec_tokens = 0
+        self.in_spec_round = False
 
     # ------------------------------------------------------------------
 
@@ -136,6 +169,19 @@ class RequestRecord:
         if t is None:
             return None
         return self.wall_enqueue + (t - self.t_enqueue)
+
+    def _attribute(self, phase: str, dur_s: float) -> None:
+        self.blame[phase] = (self.blame.get(phase, 0.0)
+                             + max(0.0, float(dur_s)))
+
+    def _close_pause(self, t: float) -> None:
+        """Fold an open preempt/pause interval into the blame dict."""
+        if self.t_paused is None:
+            return
+        self._attribute(self.paused_phase or "preempted",
+                        t - self.t_paused)
+        self.t_paused = None
+        self.paused_phase = None
 
     # derived latencies (None until the defining events exist) --------
 
@@ -177,6 +223,7 @@ class RequestRecord:
             "model": self.model,
             "tenant": self.tenant,
             "request_class": self.request_class,
+            "replica": self.replica,
             "wall_enqueue": round(self.wall_enqueue, 6),
             "t_enqueue": self.t_enqueue,
             "t_admit": self.t_admit,
@@ -185,12 +232,17 @@ class RequestRecord:
             "t_finish": self.t_finish,
             "n_tokens": self.n_tokens,
             "n_rounds": self.n_rounds,
+            "n_decode_rounds": self.n_decode_rounds,
+            "n_spec_rounds": self.n_spec_rounds,
+            "n_spec_tokens": self.n_spec_tokens,
             "n_preempts": self.n_preempts,
             "n_events_dropped": self.n_events_dropped,
             "queue_wait_s": rnd(self.queue_wait_s),
             "ttft_s": rnd(self.ttft_s),
             "tpot_s": rnd(self.tpot_s),
             "e2e_s": rnd(self.e2e_s),
+            "blame": {k: round(v, 6)
+                      for k, v in sorted(self.blame.items())},
             "events": [
                 dict(e, ts=round(self._wall(e["t"]), 6))
                 for e in self.events],
@@ -241,27 +293,53 @@ class RequestLog:
               prompt_len: int = 0, max_new_tokens: int = 0,
               model: Optional[str] = None,
               tenant: Optional[str] = None,
-              request_class: str = "interactive") -> str:
+              request_class: str = "interactive",
+              blame_seed: Optional[Dict[str, float]] = None) -> str:
         """Create the record at enqueue time; returns the (possibly
         uniquified) request id the engine should carry.  `model` /
         `tenant` / `request_class` attribute the record to the control
-        plane's dimensions (SLO judging keys on them at finish)."""
+        plane's dimensions (SLO judging keys on them at finish).
+
+        `blame_seed` ({phase: seconds} over `_SEEDABLE_PHASES`) records
+        wall the request already spent waiting BEFORE this record was
+        created — a quota-throttled retry loop, a replica-death
+        requeue.  The enqueue anchor is backdated by the seeded total
+        so e2e includes that wait, and the seconds land in the blame
+        dict so the phase ledger stays additive."""
         rid = (sanitize_request_id(request_id)
                if request_id is not None else new_request_id())
         with self._lock:
             if rid in self._active:   # client-supplied duplicate
                 rid = f"{rid}-{new_request_id()[:4]}"
-            self._active[rid] = RequestRecord(
+            rec = RequestRecord(
                 rid, int(prompt_len), int(max_new_tokens),
                 model=model, tenant=tenant,
                 request_class=str(request_class))
+            if blame_seed:
+                seeded = 0.0
+                for phase in _SEEDABLE_PHASES:
+                    v = float(blame_seed.get(phase, 0.0) or 0.0)
+                    if v <= 0.0:
+                        continue
+                    rec._attribute(phase, v)
+                    rec._append(phase, {"seconds": round(v, 6),
+                                        "seeded": True})
+                    seeded += v
+                # backdate the anchors: the request's clock started
+                # when the CLIENT's wait did, not at this resubmit.
+                # Event timestamps stay untouched (still monotone, and
+                # _wall maps them to their true wall moments).
+                rec.t_enqueue -= seeded
+                rec.wall_enqueue -= seeded
+            self._active[rid] = rec
         return rid
 
     def event(self, request_id: Optional[str], kind: str,
               **fields) -> None:
         """Append one lifecycle event.  `admit` stamps the queue-wait
         boundary (first admission only), `preempt` bumps the preemption
-        count, round-bearing kinds bump `n_rounds`."""
+        count and opens a paused interval, `admit`/`resume` close it
+        into the blame dict, round-bearing kinds bump `n_rounds`."""
         if request_id is None:
             return
         try:
@@ -277,15 +355,29 @@ class RequestLog:
                 elif kind == "preempt":
                     rec.n_preempts += 1
                     rec.status = "preempted"
+                    if rec.t_paused is None:
+                        rec.t_paused = now()
+                        rec.paused_phase = "preempted"
+                elif kind == "replica_dispatch":
+                    rec.replica = fields.get("replica") or rec.replica
+                if kind in ("admit", "resume"):
+                    rec._close_pause(now())
                 if kind in _ROUND_KINDS:
                     rec.n_rounds += 1
                 rec._append(kind, fields)
         except Exception:
             pass
 
-    def decode_round(self, request_id: Optional[str]) -> None:
+    def decode_round(self, request_id: Optional[str],
+                     spec: bool = False) -> None:
         """One decode-step participation.  Counted exactly; stored as
-        an event only at power-of-two round numbers (bounded log)."""
+        an event only at power-of-two round numbers (bounded log).
+
+        `spec=True` marks a speculative verify round on a drafted lane
+        (counts into `n_spec_rounds`; the tokens the engine emits until
+        the next round boundary count into `n_spec_tokens`).  Rider
+        lanes and plain decode rounds use the default (one emitted
+        token each, counted into `n_decode_rounds`)."""
         if request_id is None:
             return
         try:
@@ -294,14 +386,21 @@ class RequestLog:
                 if rec is None:
                     return
                 rec.n_rounds += 1
+                if spec:
+                    rec.n_spec_rounds += 1
+                else:
+                    rec.n_decode_rounds += 1
+                rec.in_spec_round = spec
                 n = rec.n_rounds
                 if n & (n - 1) == 0:   # 1, 2, 4, 8, ...
-                    rec._append("decode", {"round": n})
+                    rec._append("decode", {"round": n, "spec": spec})
         except Exception:
             pass
 
     def token(self, request_id: Optional[str]) -> None:
-        """One emitted token: first/last timestamps + exact count."""
+        """One emitted token: first/last timestamps + exact count (and,
+        inside a speculative verify round, the exact spec-token count —
+        emission-time counting respects an eos mid-burst)."""
         if request_id is None:
             return
         try:
@@ -311,6 +410,8 @@ class RequestLog:
                     return
                 t = now()
                 rec.n_tokens += 1
+                if rec.in_spec_round:
+                    rec.n_spec_tokens += 1
                 rec.t_last_token = t
                 if rec.t_first_token is None:
                     rec.t_first_token = t
@@ -318,9 +419,28 @@ class RequestLog:
         except Exception:
             pass
 
+    def attribute(self, request_id: Optional[str], phase: str,
+                  dur_s: float) -> None:
+        """Add `dur_s` seconds of `phase` to the request's blame dict —
+        the exact accumulators the phase ledger is derived from (the
+        pow2-sampled events are forensic, not the math).  Callers: the
+        engine's prefill/decode/verify loops and the host-tier restore
+        path."""
+        if request_id is None or dur_s <= 0.0:
+            return
+        try:
+            with self._lock:
+                rec = self._active.get(request_id)
+                if rec is None:
+                    return
+                rec._attribute(phase, dur_s)
+        except Exception:
+            pass
+
     def finish(self, request_id: Optional[str], reason: str) -> None:
-        """Close the record: derive latencies, feed the histograms and
-        the SLO tracker, move it to the finished ring."""
+        """Close the record: derive latencies, feed the histograms, the
+        SLO tracker, and the blame plane, move it to the finished
+        ring."""
         if request_id is None:
             return
         try:
@@ -329,6 +449,7 @@ class RequestLog:
                 if rec is None:
                     return
                 rec.t_finish = now()
+                rec._close_pause(rec.t_finish)
                 rec.finish_reason = reason
                 rec.status = ("error" if reason.startswith("error")
                               else "finished")
@@ -344,11 +465,13 @@ class RequestLog:
                 }
                 model, tenant = rec.model, rec.tenant
                 is_shadow = rec.request_class == "shadow"
-            # metric/SLO work outside the lock: nothing below touches
-            # the record again.  Shadow duplicates keep their latency
-            # OUT of the primary histograms and SLO window — the
-            # shadow tracker judges them under the shadow_ metric
-            # prefix (non-interference, docs/control-plane.md)
+                snap = rec.snapshot()
+            # metric/SLO/blame work outside the lock: nothing below
+            # touches the record again.  Shadow duplicates keep their
+            # latency OUT of the primary histograms, SLO window and
+            # blame rollup — the shadow tracker judges them under the
+            # shadow_ metric prefix (non-interference,
+            # docs/control-plane.md)
             from analytics_zoo_tpu.observability.slo import (
                 get_shadow_slo_tracker,
                 get_slo_tracker,
@@ -367,6 +490,8 @@ class RequestLog:
                 self._h_e2e.record(measures["e2e_s"])
             get_slo_tracker().observe(measures, model=model,
                                       tenant=tenant)
+            from analytics_zoo_tpu.observability import blame
+            blame.observe_finished(snap)
         except Exception:
             pass
 
@@ -457,23 +582,30 @@ def reset_request_log() -> RequestLog:
 def start(request_id: Optional[str] = None, prompt_len: int = 0,
           max_new_tokens: int = 0, model: Optional[str] = None,
           tenant: Optional[str] = None,
-          request_class: str = "interactive") -> str:
+          request_class: str = "interactive",
+          blame_seed: Optional[Dict[str, float]] = None) -> str:
     return get_request_log().start(request_id, prompt_len,
                                    max_new_tokens, model=model,
                                    tenant=tenant,
-                                   request_class=request_class)
+                                   request_class=request_class,
+                                   blame_seed=blame_seed)
 
 
 def event(request_id: Optional[str], kind: str, **fields) -> None:
     get_request_log().event(request_id, kind, **fields)
 
 
-def decode_round(request_id: Optional[str]) -> None:
-    get_request_log().decode_round(request_id)
+def decode_round(request_id: Optional[str], spec: bool = False) -> None:
+    get_request_log().decode_round(request_id, spec=spec)
 
 
 def token(request_id: Optional[str]) -> None:
     get_request_log().token(request_id)
+
+
+def attribute(request_id: Optional[str], phase: str,
+              dur_s: float) -> None:
+    get_request_log().attribute(request_id, phase, dur_s)
 
 
 def finish(request_id: Optional[str], reason: str) -> None:

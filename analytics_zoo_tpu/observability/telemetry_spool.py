@@ -7,7 +7,8 @@ file::
     <OrcaContext.observability_dir>/telemetry/<proc>/snapshot.json
 
 containing its metric exposition text, a span-ring tail, a request-log
-tail and its SLO snapshot, plus wall/monotonic clock anchors.  Writes
+tail, its SLO snapshot and its tail exemplars (observability/
+exemplars.py), plus wall/monotonic clock anchors.  Writes
 use the crash-consistent idiom of the PR 7 checkpoint commit and the
 stream group cursor (tmp → flush → fsync → rename), so a SIGKILL at any
 instant leaves either the previous or the new *complete* snapshot —
@@ -120,6 +121,10 @@ class TelemetrySpool:
         from analytics_zoo_tpu.observability import request_log, tracing
         from analytics_zoo_tpu.observability.slo import get_slo_tracker
 
+        from analytics_zoo_tpu.observability.exemplars import (
+            get_exemplar_store,
+        )
+
         regs = (get_registry(),) + self.registries
         doc: Dict[str, Any] = {
             "proc": self.proc,
@@ -131,22 +136,30 @@ class TelemetrySpool:
             "requests": request_log.get_request_log().records(
                 SPOOL_REQUEST_TAIL, include_active=True),
             "slo": get_slo_tracker().snapshot(),
+            # tail exemplars ride the same crash-safe commit: a
+            # SIGKILL'd replica's worst-request forensics survive and
+            # merge into the fleet /blame view
+            "exemplars": get_exemplar_store().snapshot(),
         }
         return doc
 
     def _encode_bounded(self, doc: Dict[str, Any]) -> bytes:
-        """JSON-encode, halving the span/request tails until the blob
-        fits ``max_bytes`` (exposition is never trimmed)."""
+        """JSON-encode, halving the span/request/exemplar tails until
+        the blob fits ``max_bytes`` (exposition is never trimmed)."""
         while True:
             blob = json.dumps(doc, default=str).encode("utf-8")
             if len(blob) <= self.max_bytes:
                 return blob
             spans = doc.get("spans") or []
             reqs = doc.get("requests") or []
-            if not spans and not reqs:
+            exemplars = doc.get("exemplars") or []
+            if not spans and not reqs and not exemplars:
                 return blob  # exposition-only floor; kept whole
             doc["spans"] = spans[: len(spans) // 2]
             doc["requests"] = reqs[: len(reqs) // 2]
+            # exemplars are sorted slowest-first: halving keeps the
+            # worst offenders
+            doc["exemplars"] = exemplars[: len(exemplars) // 2]
             doc["truncated"] = True
 
     def write(self) -> bool:

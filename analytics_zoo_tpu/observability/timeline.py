@@ -33,6 +33,7 @@ Row layout (pids are stable so saved traces diff cleanly):
 | 6 `replicas`  | one tid per router replica: dispatch instants (which replica served which request — serving/distributed/router.py) |
 | 7 `kv_dma`    | one tid per engine/replica lane: ``host_spill`` / ``host_restore`` X slices for host-tier KV copies (serving/generation/host_tier.py) |
 | 8 `dispatch`  | one tid per dispatch-ledger program family: fenced work X slices + ``compile`` instants with the signature diff (observability/profiling.py) |
+| 9 `blame`     | one tid per captured tail exemplar: its blame-ledger phases drawn as a sequential waterfall from enqueue (observability/blame.py + exemplars.py) |
 
 Serving: `ServingServer` exposes the export as ``GET /timeline``
 (forcing a fresh memory sample first), and every flight-recorder
@@ -53,6 +54,7 @@ PID_MEMORY = 5
 PID_REPLICAS = 6
 PID_KV_DMA = 7
 PID_DISPATCH = 8
+PID_BLAME = 9
 
 _PROCESS_NAMES = {
     PID_SPANS: "spans",
@@ -63,6 +65,7 @@ _PROCESS_NAMES = {
     PID_REPLICAS: "replicas",
     PID_KV_DMA: "kv_dma",
     PID_DISPATCH: "dispatch",
+    PID_BLAME: "blame",
 }
 
 #: total event cap per export — /timeline must stay a bounded payload
@@ -277,6 +280,53 @@ def _dispatch_events(calls_n: Optional[int]) -> (List[Dict[str, Any]],
     return events, {tid: family for family, tid in tids.items()}
 
 
+def _blame_events(exemplars_n: Optional[int]
+                  ) -> (List[Dict[str, Any]], Dict[int, str]):
+    """Per-request blame waterfalls (pid 9): each captured tail
+    exemplar gets one row with its ledger phases laid end-to-end from
+    the request's wall enqueue.  The phases are *attribution buckets*,
+    not re-measured intervals — drawing them sequentially in canonical
+    phase order turns the additive decomposition (which sums to e2e by
+    contract) into a waterfall whose total width IS the request's e2e,
+    directly comparable against the raw pid-3 request slices above."""
+    from analytics_zoo_tpu.observability.blame import PHASES
+    from analytics_zoo_tpu.observability.exemplars import (
+        get_exemplar_store,
+    )
+
+    events: List[Dict[str, Any]] = []
+    tid_names: Dict[int, str] = {}
+    docs = get_exemplar_store().snapshot()
+    if exemplars_n is not None:
+        docs = docs[:int(exemplars_n)]
+    for i, doc in enumerate(docs):
+        ledger = doc.get("ledger") or {}
+        phases = ledger.get("phases") or {}
+        rec = doc.get("record") or {}
+        anchor = rec.get("wall_enqueue")
+        if anchor is None:
+            continue
+        tid = i + 1
+        tid_names[tid] = str(doc.get("request_id", "?"))
+        cursor = float(anchor)
+        for phase in PHASES:
+            dur = float(phases.get(phase, 0.0))
+            if dur <= 0.0:
+                continue
+            events.append({
+                "ph": "X", "name": phase, "cat": "blame",
+                "pid": PID_BLAME, "tid": tid,
+                "ts": _us(cursor), "dur": max(0, _us(dur)),
+                "args": {"request_id": str(doc.get("request_id", "?")),
+                         "reason": doc.get("reason", "?"),
+                         "share": round(
+                             dur / max(ledger.get("e2e_s") or dur,
+                                       1e-9), 4)},
+            })
+            cursor += dur
+    return events, tid_names
+
+
 def _ring_events(ring_n: Optional[int]) -> List[Dict[str, Any]]:
     from analytics_zoo_tpu.observability.flight_recorder import (
         ring_contents,
@@ -348,6 +398,7 @@ def export_timeline(spans_n: int = 512,
     repl_ev, repl_tids = _section(_replica_events, requests_n)
     dma_ev, dma_tids = _section(_kv_dma_events, None)
     disp_ev, disp_tids = _section(_dispatch_events, None)
+    blame_ev, blame_tids = _section(_blame_events, None)
     try:
         ring_ev = _ring_events(ring_n)
     except Exception:
@@ -359,7 +410,7 @@ def export_timeline(spans_n: int = 512,
 
     used_pids = set()
     for ev_list in (span_ev, good_ev, req_ev, repl_ev, dma_ev,
-                    disp_ev, ring_ev, mem_ev):
+                    disp_ev, blame_ev, ring_ev, mem_ev):
         events.extend(ev_list)
         used_pids.update(e["pid"] for e in ev_list)
 
@@ -378,6 +429,8 @@ def export_timeline(spans_n: int = 512,
         metas.append(_meta(PID_KV_DMA, tid, "thread_name", name))
     for tid, name in sorted(disp_tids.items()):
         metas.append(_meta(PID_DISPATCH, tid, "thread_name", name))
+    for tid, name in sorted(blame_tids.items()):
+        metas.append(_meta(PID_BLAME, tid, "thread_name", name))
     if any(e["pid"] == PID_EVENTS for e in ring_ev):
         metas.append(_meta(PID_EVENTS, 1, "thread_name",
                            "flight_ring"))

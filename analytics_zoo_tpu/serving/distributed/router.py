@@ -49,6 +49,7 @@ from analytics_zoo_tpu.observability import (
     flight_recorder,
     get_registry,
     log_event,
+    now,
     request_log,
     trace,
 )
@@ -497,6 +498,7 @@ class ReplicaRouter:
         from the tokens already streamed — greedy decode makes the
         continuation exactly the sequence the dead replica would have
         produced — under the SAME request_id."""
+        t_detect = now()
         self.heartbeat()
         failed = rs._replica
         death = ReplicaDiedMidPredict(
@@ -525,9 +527,15 @@ class ReplicaRouter:
                        link_span_id=(rs._dispatch_spans[-1]
                                      if rs._dispatch_spans
                                      else None)) as qsp:
-                stream = target.engine.submit(rs._prompt + rs._got,
-                                              request_id=rs.request_id,
-                                              **kwargs)
+                # the new record's blame ledger charges the death-
+                # detection + re-placement gap to the "requeue" phase
+                # (the dying engine's error finish closed the old
+                # record; the seed keeps the client's wait additive)
+                stream = target.engine.submit(
+                    rs._prompt + rs._got,
+                    request_id=rs.request_id,
+                    blame_seed={"requeue": now() - t_detect},
+                    **kwargs)
         except Exception:
             return None
         rs._dispatch_spans.append(qsp.span_id)

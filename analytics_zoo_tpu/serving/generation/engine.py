@@ -60,7 +60,7 @@ from __future__ import annotations
 import queue
 import threading
 from functools import partial
-from typing import List, Optional, Sequence as Seq, Tuple
+from typing import Dict, List, Optional, Sequence as Seq, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -915,7 +915,9 @@ class GenerationEngine:
                stream_timeout: float = 120.0,
                request_id: Optional[str] = None,
                tenant: Optional[str] = None,
-               request_class: str = "interactive") -> GenerationStream:
+               request_class: str = "interactive",
+               blame_seed: Optional[Dict[str, float]] = None
+               ) -> GenerationStream:
         """Queue one request; returns its token stream.  Raises up
         front when the request can never run: ValueError for malformed
         prompts, `RequestTooLarge` (a ValueError; HTTP 413) when the
@@ -928,7 +930,12 @@ class GenerationEngine:
         stream's `.request_id`.  `tenant` attributes the request to a
         quota bucket (`OrcaContext.tenant_quotas`); `request_class`
         ("interactive" | "batch" | "shadow") sets its scheduler
-        priority — lower classes admit first and preempt last."""
+        priority — lower classes admit first and preempt last.
+        `blame_seed` ({phase: seconds}) records wait the request
+        already served BEFORE this submit — a quota-throttled retry
+        loop ("quota_throttle") or a replica-death requeue
+        ("requeue") — so the blame ledger's e2e decomposition covers
+        the client's whole wait, not just this engine's share."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -950,7 +957,8 @@ class GenerationEngine:
         rid = request_log.start(request_id, prompt_len=len(prompt),
                                 max_new_tokens=int(max_new_tokens),
                                 model=self.model_label, tenant=tenant,
-                                request_class=request_class)
+                                request_class=request_class,
+                                blame_seed=blame_seed)
         seq = Sequence(prompt, max_new_tokens=max_new_tokens,
                        temperature=temperature, top_k=top_k,
                        eos_id=eos_id, request_id=rid,
@@ -1034,8 +1042,10 @@ class GenerationEngine:
             "prefill", dur, tokens=L,
             flops=self._flops.prefill(L) if self._flops else 0.0)
         self._c_prefill_tokens.inc(L)
+        request_log.attribute(seq.request_id, "prefill_compute", dur)
         request_log.event(seq.request_id, "prefill", bucket=bucket,
-                          tokens=L, resumed=seq.n_preempted > 0)
+                          tokens=L, dur_s=round(dur, 6),
+                          resumed=seq.n_preempted > 0)
         self._emit(seq, nxt)
         rec.end()
 
@@ -1109,8 +1119,10 @@ class GenerationEngine:
                    if self._flops else 0.0))
         self._c_prefill_tokens.inc(real)
         seq.prefill_pos = start + real
+        request_log.attribute(seq.request_id, "prefill_compute", dur)
         request_log.event(seq.request_id, "prefill", bucket=bucket,
                           tokens=real, start=start,
+                          dur_s=round(dur, 6),
                           resumed=seq.n_preempted > 0)
         if seq.prefill_pos >= L:
             if self.prefix_cache is not None:
@@ -1158,6 +1170,11 @@ class GenerationEngine:
         record_dma("host_restore", dur, entry.nbytes,
                    self.spool_name)
         profiling.record_work("host_restore", dur)
+        # blame attribution: the scheduler threads the beneficiary's
+        # request id through the prefix cache while restore runs
+        request_log.attribute(
+            getattr(self.prefix_cache, "restoring_for", None),
+            "host_restore", dur)
         return True
 
     def _stage_host_restores(self) -> None:
@@ -1314,6 +1331,7 @@ class GenerationEngine:
             # counters, and needs no rollback — position 0's argmax is
             # the round's one token
             request_log.decode_round(seq.request_id)
+            request_log.attribute(seq.request_id, "decode_active", dur)
             done.add(seq)
             self._emit(seq, int(greedy[seq.slot, 0]))
         for seq, st, draft in drafted:
@@ -1332,7 +1350,18 @@ class GenerationEngine:
                                   round=n, proposed=len(draft))
                 request_log.event(seq.request_id, "spec_accept",
                                   round=n, accepted=m)
-            request_log.decode_round(seq.request_id)
+            request_log.decode_round(seq.request_id, spec=True)
+            # blame split of the verify round's wall: the accepted
+            # prefix + bonus token are useful decode ((m+1) of the
+            # (k+1) scored positions); the rejected remainder is
+            # speculation overhead.  The two shares sum to `dur`, so
+            # ledger additivity survives any acceptance rate.
+            k1 = 1 + len(draft)
+            request_log.attribute(seq.request_id, "decode_active",
+                                  dur * (m + 1) / k1)
+            request_log.attribute(seq.request_id,
+                                  "spec_verify_overhead",
+                                  dur * (len(draft) - m) / k1)
             done.add(seq)
             # emit the accepted prefix + the bonus token — exactly the
             # tokens greedy single-step decode would have produced —
@@ -1400,6 +1429,9 @@ class GenerationEngine:
                    if self._flops else 0.0))
         for i, seq in lanes.items():
             request_log.decode_round(seq.request_id)
+            # per-request wall-clock experience: every riding lane
+            # waited out the whole fenced round
+            request_log.attribute(seq.request_id, "decode_active", dur)
             self._emit(seq, nxt[i])
         rec.end()
 

@@ -372,10 +372,22 @@ class SlotScheduler:
                     # host-tier extension of the device match: each
                     # restored block joins the table with the same
                     # refcounts as a device hit; a failed restore just
-                    # shortens the match (the lane prefills the rest)
-                    cached_blocks, n_cached = \
-                        self.prefix_cache.restore(ctx, cached_blocks,
-                                                  n_cached)
+                    # shortens the match (the lane prefills the rest).
+                    # `restoring_for` threads the beneficiary through
+                    # to the engine's restore writer so the DMA wall
+                    # lands in THIS request's blame ledger.
+                    dev_cached = n_cached
+                    self.prefix_cache.restoring_for = seq.request_id
+                    try:
+                        cached_blocks, n_cached = \
+                            self.prefix_cache.restore(ctx, cached_blocks,
+                                                      n_cached)
+                    finally:
+                        self.prefix_cache.restoring_for = None
+                    if n_cached > dev_cached:
+                        request_log.event(
+                            seq.request_id, "host_restore",
+                            tokens=n_cached - dev_cached)
             if not self.chunk_mode:
                 bucket = self.bucket_for(seq.context_len - n_cached)
                 if admitted and bucket > budget:

@@ -90,6 +90,17 @@ Endpoints:
   GET  /stats    — JSON operational snapshot: records_served, batcher
                    queue depth, worker-pool utilization, per-op timer
                    summaries, process goodput ratio
+  GET  /blame    — latency blame rollup (observability/blame.py):
+                   per-phase share + p50/p99/p99.9 over the finished-
+                   request window, sliced by model/tenant/replica;
+                   ?fleet=1 adds exactly-summed blame counters across
+                   live + spooled sources and the fleet's worst
+                   exemplars
+  GET  /debug/requests       — captured tail-exemplar index
+  GET  /debug/requests/<id>  — one request's bounded forensics dossier:
+                   blame ledger, event tail, span/dispatch/scheduler
+                   slices (observability/exemplars.py; spooled dead-
+                   worker exemplars included)
 """
 
 from __future__ import annotations
@@ -107,9 +118,12 @@ import numpy as np
 from analytics_zoo_tpu.observability import (
     FleetAggregator,
     MetricsRegistry,
+    blame_payload,
     current_span,
     export_timeline,
     flight_recorder,
+    get_blame_tracker,
+    get_exemplar_store,
     get_registry,
     get_slo_tracker,
     goodput_tables,
@@ -443,6 +457,44 @@ class ServingServer:
                     # signature diffs naming the leaf that forked a
                     # jit cache entry) and the MFU/roofline numbers
                     self._json(200, profiling.ledger_snapshot())
+                    return
+                if self.path.startswith("/blame"):
+                    # latency blame rollup (observability/blame.py):
+                    # per-phase share/p50/p99/p99.9 over the finished-
+                    # request window, sliced by model/tenant/replica,
+                    # plus the dominant tail phase and queue share at
+                    # p99.  ?fleet=1 additionally sums the blame_*/
+                    # exemplars_* counters exactly across every live
+                    # AND spooled source and lists the fleet's worst
+                    # exemplars (observability/fleet.py fleet_blame).
+                    if "fleet=1" in self.path:
+                        self._json(200, server.fleet().fleet_blame())
+                    else:
+                        self._json(200, blame_payload())
+                    return
+                if self.path.startswith("/debug/requests"):
+                    # tail exemplar forensics (observability/
+                    # exemplars.py): bare path lists the captured
+                    # exemplar index (slowest first); /debug/requests/
+                    # <id> serves one request's full bounded dossier —
+                    # blame ledger, event tail, span slice, dispatch-
+                    # ledger slice, scheduler-decision slice — checked
+                    # against the local store first, then every
+                    # spooled snapshot (a SIGKILL'd replica's
+                    # exemplars stay servable).
+                    rest = (self.path[len("/debug/requests"):]
+                            .partition("?")[0].strip("/"))
+                    if not rest:
+                        self._json(200, get_exemplar_store().index())
+                        return
+                    from urllib.parse import unquote
+                    doc = server.fleet().fleet_exemplar(unquote(rest))
+                    if doc is None:
+                        self._json(404, {
+                            "error": "no exemplar for request id",
+                            "request_id": unquote(rest)})
+                        return
+                    self._json(200, doc)
                     return
                 if self.path.startswith("/timeline"):
                     # Chrome-trace-event export (observability/
@@ -1178,6 +1230,10 @@ class ServingServer:
                 "slo_attainment_by_tenant": slo["attainment_by_tenant"],
                 "slo_targets": slo["targets"],
             }
+            # compact latency-blame block (observability/blame.py):
+            # phase shares + dominant tail phase + exemplar count —
+            # the full rollup lives at GET /blame
+            out["blame"] = get_blame_tracker().stats_block()
         from analytics_zoo_tpu.common.context import OrcaContext
         if (self.router is not None
                 or OrcaContext.observability_dir is not None):

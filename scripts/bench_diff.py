@@ -19,7 +19,13 @@ r06↔r07), so gating on it would cry wolf every round.  Directions are
 per-key: ``higher`` means a drop is a regression, ``lower`` means a
 rise is.  A tracked key missing from either record warns but does not
 fail (new gates appear over time; old ones must never silently vanish
-INTO the tracked list without a record carrying them).
+INTO the tracked list without a record carrying them).  A third
+direction, ``stable``, tracks a key *informationally*: its row always
+prints in the diff and its absence still warns, but no change in it is
+ever a regression — for quantities worth watching round-over-round
+(the p99 queue-wait blame share, exemplar capture counts) whose
+"good" direction depends on where the latency went, not which way the
+number moved.
 
 Usage::
 
@@ -45,7 +51,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _ROUND = re.compile(r"^BENCH_r(\d+)\.json$")
 
 #: curated regression gates: key -> direction ("higher" = bigger is
-#: better, a drop regresses; "lower" = smaller is better)
+#: better, a drop regresses; "lower" = smaller is better; "stable" =
+#: informational — printed and missing-warned, never a regression)
 TRACKED: Dict[str, str] = {
     # NOT tracked: "value" (the headline samples/s) — raw throughput
     # is exactly the ±30% noise this list exists to avoid gating on;
@@ -69,6 +76,13 @@ TRACKED: Dict[str, str] = {
     # should only shrink (recompile storms show up here first)
     "mfu_decode": "higher",
     "compile_seconds_total": "lower",
+    # latency blame plane (PR 20): the additivity gate must hold; the
+    # queue share of the p99 tail and the exemplar-capture count are
+    # watched but direction-free — a queue-share drop just means the
+    # blame moved to another phase, not that the system got better
+    "blame_additivity_gate_pass": "higher",
+    "blame_queue_share_p99": "stable",
+    "blame_exemplars_captured": "stable",
 }
 
 DEFAULT_THRESHOLD = 0.10
@@ -134,6 +148,8 @@ def find_regressions(old: Dict[str, float], new: Dict[str, float],
             warnings.append(f"tracked key {key!r} missing from "
                             f"{missing} record")
             continue
+        if direction == "stable":
+            continue            # informational: never a regression
         a, b = old[key], new[key]
         if a == 0.0:
             if direction == "lower" and b > 0.0:

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Lint: the blame plane's phase attribution is CLOSED — in both
+directions (the check_alert_rules / check_metric_names contract,
+applied to latency blame).
+
+The additivity contract in observability/blame.py only means anything
+if every request-lifecycle happening the package can emit lands in
+exactly one ledger phase.  A new `request_log.event(rid, "...")` call
+site whose kind is missing from ``EVENT_PHASE_MAP`` is latency that
+silently drains into the ``decode_blocked_on_batch`` residual — blame
+that points at the batcher when the real culprit is the new subsystem.
+Three checks close the loop statically (ast-parsed, not imported: the
+lint must run without the package's import-time dependencies):
+
+1. every event kind the package emits — string-literal (or
+   conditional-expression) kind arguments at ``request_log.event`` /
+   ``rec._append`` call sites, literal ``{"kind": ...}`` seeds, and
+   the ``_SEEDABLE_PHASES`` blame-seed kinds — appears as a key in
+   ``observability/blame.py::EVENT_PHASE_MAP``;
+2. every ``EVENT_PHASE_MAP`` key is actually emitted somewhere (a
+   stale map entry documents an event that can never happen), and
+   every mapped value is a member of ``PHASES``;
+3. every ``PHASES`` member appears as a backticked first-cell token in
+   the phase table of docs/observability.md's '## Latency blame'
+   section, and every phase documented there exists in ``PHASES``.
+
+Run directly (`python scripts/check_blame_phases.py`) or via the
+tier-1 wrapper `tests/test_check_blame_phases.py`.  Exit code 0 =
+clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "analytics_zoo_tpu")
+BLAME = os.path.join(PKG, "observability", "blame.py")
+REQUEST_LOG = os.path.join(PKG, "observability", "request_log.py")
+DOCS = os.path.join(REPO, "docs", "observability.md")
+
+SECTION = "## Latency blame"
+
+#: a phase / event-kind token: lowercase snake_case
+TOKEN = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _parse(path):
+    with open(path, encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _str_consts(node):
+    """String constants reachable from a kind-argument expression —
+    handles the plain literal and the `"a" if cond else "b"` form."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _str_consts(node.body) + _str_consts(node.orelse)
+    return []
+
+
+def _assigned_literal(tree, name):
+    """The tuple/dict literal bound to module-level `name`."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets)):
+            return node.value
+        if (isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == name and node.value is not None):
+            return node.value
+    raise AssertionError(f"{name} not found")
+
+
+def phase_map():
+    """EVENT_PHASE_MAP, parsed from blame.py source."""
+    val = _assigned_literal(_parse(BLAME), "EVENT_PHASE_MAP")
+    out = {}
+    for k, v in zip(val.keys, val.values):
+        out[k.value] = v.value
+    return out
+
+
+def canonical_phases():
+    """PHASES, parsed from blame.py source."""
+    val = _assigned_literal(_parse(BLAME), "PHASES")
+    return [e.value for e in val.elts]
+
+
+def emitted_kinds():
+    """Every event kind the package can emit, found statically:
+    `*.event(rid, <kind>)` and `*._append(<kind>, ...)` call sites
+    anywhere in the package, dict literals carrying a constant "kind"
+    entry inside request_log.py itself (the enqueue seed), and the
+    `_SEEDABLE_PHASES` blame-seed kinds."""
+    kinds = set()
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            tree = _parse(path)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call):
+                    func = node.func
+                    attr = (func.attr if isinstance(func, ast.Attribute)
+                            else func.id if isinstance(func, ast.Name)
+                            else None)
+                    if attr == "event" and len(node.args) >= 2:
+                        kinds.update(_str_consts(node.args[1]))
+                    elif attr == "_append" and node.args:
+                        kinds.update(_str_consts(node.args[0]))
+                elif (isinstance(node, ast.Dict)
+                      and os.path.samefile(path, REQUEST_LOG)):
+                    for k, v in zip(node.keys, node.values):
+                        if (isinstance(k, ast.Constant)
+                                and k.value == "kind"):
+                            kinds.update(_str_consts(v))
+    seedable = _assigned_literal(_parse(REQUEST_LOG),
+                                 "_SEEDABLE_PHASES")
+    kinds.update(e.value for e in seedable.elts)
+    return sorted(k for k in kinds if TOKEN.match(k))
+
+
+def documented_phases(docs_text=None):
+    """Backticked first-cell tokens of the phase-table rows inside
+    docs/observability.md's '## Latency blame' section."""
+    if docs_text is None:
+        with open(DOCS, encoding="utf-8") as f:
+            docs_text = f.read()
+    in_section = False
+    phases = []
+    for line in docs_text.splitlines():
+        if line.startswith("## "):
+            in_section = line.startswith(SECTION)
+            continue
+        if not (in_section and line.lstrip().startswith("|")):
+            continue
+        cells = line.split("|")
+        if len(cells) < 2:
+            continue
+        for tok in re.findall(r"`([^`]+)`", cells[1]):
+            if TOKEN.match(tok):
+                phases.append(tok)
+    return sorted(set(phases))
+
+
+def find_violations():
+    mapping = phase_map()
+    phases = canonical_phases()
+    emitted = set(emitted_kinds())
+    documented = set(documented_phases())
+    violations = []
+    for kind in sorted(emitted - set(mapping)):
+        violations.append(
+            f"emitted event kind {kind!r} has no EVENT_PHASE_MAP "
+            f"entry — its latency would drain into the "
+            f"decode_blocked_on_batch residual unattributed")
+    for kind in sorted(set(mapping) - emitted):
+        violations.append(
+            f"EVENT_PHASE_MAP entry {kind!r} is never emitted by any "
+            f"call site (stale map entry)")
+    for kind, phase in sorted(mapping.items()):
+        if phase not in phases:
+            violations.append(
+                f"EVENT_PHASE_MAP maps {kind!r} to {phase!r} which is "
+                f"not a member of PHASES")
+    for phase in sorted(set(phases) - documented):
+        violations.append(
+            f"ledger phase {phase!r} missing from "
+            f"docs/observability.md's '{SECTION}' phase table")
+    for phase in sorted(documented - set(phases)):
+        violations.append(
+            f"docs/observability.md documents blame phase {phase!r} "
+            f"that is not in observability/blame.py PHASES")
+    return violations
+
+
+def main() -> int:
+    violations = find_violations()
+    if not violations:
+        print(f"check_blame_phases: clean "
+              f"({len(emitted_kinds())} event kinds, "
+              f"{len(canonical_phases())} phases)")
+        return 0
+    print("check_blame_phases: blame phase attribution is not closed:",
+          file=sys.stderr)
+    for v in violations:
+        print(f"  {v}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,716 @@
+"""Latency blame plane (observability/blame.py + exemplars.py): the
+additive phase-ledger contract (measured phases + clamped residual sum
+to e2e within OrcaContext.blame_tolerance), blame_seed backdating,
+speculation-exact round accounting, exact fleet counter merging, the
+blame_shift alert's replay-deterministic fire/resolve, bounded tail
+exemplar capture/eviction, spool crash-safety plumbing, and the HTTP
+surfaces (GET /blame, /debug/requests, the /stats blame block)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.common.context import OrcaContext
+from analytics_zoo_tpu.observability import blame, request_log
+from analytics_zoo_tpu.observability.alerts import (
+    AlertEngine,
+    builtin_rules,
+)
+from analytics_zoo_tpu.observability.blame import (
+    PHASES,
+    BlameTracker,
+    phase_ledger,
+)
+from analytics_zoo_tpu.observability.exemplars import (
+    ExemplarStore,
+    get_exemplar_store,
+    reset_exemplar_store,
+)
+from analytics_zoo_tpu.observability.fleet import FleetAggregator
+from analytics_zoo_tpu.observability.registry import (
+    MetricsRegistry,
+    merged_prometheus_text,
+    parse_prometheus_text,
+)
+from analytics_zoo_tpu.observability.request_log import RequestLog
+
+T0 = 1_700_000_000.0
+
+
+def _snap(e2e=10.0, admit=2.0, blame_acc=None, **fields):
+    """A minimal finished-record snapshot: enqueued at t=100, admitted
+    `admit` seconds later, finished at t=100+e2e."""
+    snap = {
+        "request_id": fields.pop("request_id", "req-1"),
+        "status": "finished",
+        "finish_reason": "eos",
+        "model": None,
+        "tenant": None,
+        "replica": None,
+        "request_class": "interactive",
+        "wall_enqueue": T0,
+        "t_enqueue": 100.0,
+        "t_admit": 100.0 + admit,
+        "t_finish": 100.0 + e2e,
+        "e2e_s": e2e,
+        "blame": dict(blame_acc or {}),
+        "events": [],
+    }
+    snap.update(fields)
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# the ledger: additive by construction, pure, violation-flagging
+# ---------------------------------------------------------------------------
+
+def test_phase_ledger_additive_by_construction():
+    led = phase_ledger(_snap(
+        e2e=10.0, admit=2.0,
+        blame_acc={"prefill_compute": 1.0, "decode_active": 4.0,
+                   "host_restore": 0.5, "spec_verify_overhead": 0.25,
+                   "preempted": 1.0}))
+    p = led["phases"]
+    assert led["e2e_s"] == 10.0
+    # restore runs inside admission: its 0.5s is carved out of the
+    # 2.0s pre-admit window, never charged against the running wall
+    assert p["queue_wait"] == pytest.approx(1.5)
+    assert p["host_restore"] == pytest.approx(0.5)
+    assert p["preempted"] == 1.0
+    # residual: 10 - 2 (pre-admit) - 1 (paused) - 5.25 attributed
+    # (prefill + decode + spec; restore lives in the pre-admit carve)
+    assert p["decode_blocked_on_batch"] == pytest.approx(1.75)
+    assert sum(p.values()) == pytest.approx(led["e2e_s"])
+    assert led["total_s"] == pytest.approx(10.0)
+    assert led["additive_ok"] is True
+    assert set(p) == set(PHASES)
+
+
+def test_phase_ledger_seeded_waits_carve_queue_wait():
+    """Seeded quota/requeue seconds come OUT of the pre-admission wall
+    (they backdated the enqueue anchor), and a bogus oversized seed is
+    clamped so no phase goes negative."""
+    led = phase_ledger(_snap(
+        e2e=10.0, admit=4.0,
+        blame_acc={"quota_throttle": 1.5, "requeue": 0.5,
+                   "decode_active": 6.0}))
+    p = led["phases"]
+    assert p["quota_throttle"] == 1.5
+    assert p["requeue"] == 0.5
+    assert p["queue_wait"] == pytest.approx(2.0)
+    assert led["additive_ok"] is True
+    # oversized seed: clamped into the pre-admit window, never negative
+    led2 = phase_ledger(_snap(
+        e2e=10.0, admit=1.0, blame_acc={"quota_throttle": 50.0}))
+    p2 = led2["phases"]
+    assert p2["quota_throttle"] == pytest.approx(1.0)
+    assert p2["queue_wait"] == 0.0
+    assert all(v >= 0.0 for v in p2.values())
+
+
+def test_phase_ledger_restore_carves_pre_running_windows():
+    """Host-tier restores run inside scheduler.admit() (before the
+    admit stamp) or inside a preempt→resume gap — their wall comes out
+    of queue_wait / preempted, NEVER the running window.  A restore
+    wall bigger than both windows is genuine over-attribution and
+    still flips the flag."""
+    # 0.3s restore inside a 0.5s pre-admit window: the window's first
+    # restore paying a compile-cache reload must not flip additivity
+    # even when the blocked residual is smaller than the restore wall
+    led = phase_ledger(_snap(
+        e2e=2.0, admit=0.5,
+        blame_acc={"host_restore": 0.3, "decode_active": 1.45}))
+    p = led["phases"]
+    assert p["queue_wait"] == pytest.approx(0.2)
+    assert p["host_restore"] == pytest.approx(0.3)
+    assert p["decode_blocked_on_batch"] == pytest.approx(0.05)
+    assert led["additive_ok"] is True
+    # restore overflowing pre-admit spills into the preempt gap
+    # (resumed lanes restore during re-admission)
+    led2 = phase_ledger(_snap(
+        e2e=4.0, admit=0.1,
+        blame_acc={"host_restore": 0.6, "preempted": 1.0,
+                   "decode_active": 2.9}))
+    p2 = led2["phases"]
+    assert p2["host_restore"] == pytest.approx(0.6)
+    assert p2["queue_wait"] == 0.0
+    assert p2["preempted"] == pytest.approx(0.5)
+    assert sum(p2.values()) == pytest.approx(4.0)
+    assert led2["additive_ok"] is True
+    # leftover restore that fits neither window counts against the
+    # running wall: nothing hides
+    led3 = phase_ledger(_snap(
+        e2e=1.0, admit=0.1,
+        blame_acc={"host_restore": 0.5, "decode_active": 0.85}))
+    assert led3["additive_ok"] is False
+
+
+def test_phase_ledger_flags_over_attribution():
+    """Attributed compute exceeding the observed running wall is the
+    'blame math is wrong' signal: additive_ok flips, nothing hides."""
+    led = phase_ledger(_snap(
+        e2e=2.0, admit=1.0, blame_acc={"decode_active": 5.0}))
+    assert led["phases"]["decode_blocked_on_batch"] == 0.0
+    assert led["total_s"] > led["e2e_s"]
+    assert led["additive_ok"] is False
+
+
+def test_phase_ledger_is_replay_deterministic():
+    """Pure function of the snapshot: live, recomputed, and a
+    JSON-roundtripped (spooled) copy all yield the identical ledger."""
+    snap = _snap(e2e=7.0, admit=1.0,
+                 blame_acc={"prefill_compute": 0.5,
+                            "decode_active": 3.0})
+    a = json.dumps(phase_ledger(snap), sort_keys=True)
+    b = json.dumps(phase_ledger(snap), sort_keys=True)
+    spooled = json.loads(json.dumps(snap))
+    c = json.dumps(phase_ledger(spooled), sort_keys=True)
+    assert a == b == c
+
+
+def test_phase_ledger_abs_slack_for_tiny_e2e():
+    """Sub-millisecond e2e: the relative tolerance is meaningless, the
+    1e-4 s absolute slack keeps honest ledgers additive."""
+    led = phase_ledger(_snap(e2e=0.0005, admit=0.0002,
+                             blame_acc={"decode_active": 0.00035}))
+    assert led["additive_ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# blame_seed: pre-record waits land inside the e2e decomposition
+# ---------------------------------------------------------------------------
+
+def test_blame_seed_backdates_enqueue_anchor():
+    reg = MetricsRegistry()
+    log = RequestLog(capacity=8, registry=reg)
+    rid = log.start(prompt_len=4, max_new_tokens=2,
+                    blame_seed={"quota_throttle": 0.8, "requeue": 0.2})
+    log.event(rid, "admit")
+    log.token(rid)
+    log.finish(rid, "eos")
+    snap = log.get(rid)
+    assert snap["blame"]["quota_throttle"] == pytest.approx(0.8)
+    assert snap["blame"]["requeue"] == pytest.approx(0.2)
+    # the record's clock starts when the CLIENT's wait did
+    assert snap["e2e_s"] >= 1.0
+    assert snap["queue_wait_s"] >= 1.0
+    led = phase_ledger(snap)
+    assert led["phases"]["quota_throttle"] == pytest.approx(0.8)
+    assert led["phases"]["requeue"] == pytest.approx(0.2)
+    assert led["additive_ok"] is True
+    # event timestamps stay monotone despite the backdated anchor
+    ts = [e["t"] for e in snap["events"]]
+    assert ts == sorted(ts)
+
+
+def test_blame_seed_ignores_unseedable_phases():
+    reg = MetricsRegistry()
+    log = RequestLog(capacity=8, registry=reg)
+    rid = log.start(blame_seed={"decode_active": 99.0,
+                                "prefill_compute": 99.0})
+    log.finish(rid, "eos")
+    snap = log.get(rid)
+    assert "decode_active" not in snap["blame"]
+    assert snap["e2e_s"] < 1.0, "nothing was backdated"
+
+
+# ---------------------------------------------------------------------------
+# speculation-exact round accounting (the PR 15 debt, repaid)
+# ---------------------------------------------------------------------------
+
+def test_spec_aware_round_accounting_invariant():
+    """A cleanly finished request satisfies
+    n_tokens == 1 + n_decode_rounds + n_spec_tokens: the leading 1 is
+    prefill's token, plain/rider rounds emit exactly one each, and
+    spec-round tokens are counted at emission (eos mid-burst safe)."""
+    reg = MetricsRegistry()
+    log = RequestLog(capacity=8, registry=reg)
+    rid = log.start(prompt_len=8, max_new_tokens=16)
+    log.event(rid, "admit")
+    log.event(rid, "prefill", chunk=0)
+    log.token(rid)                       # prefill's token
+    for _ in range(3):                   # plain decode rounds
+        log.decode_round(rid)
+        log.token(rid)
+    log.decode_round(rid, spec=True)     # verify round, k+1=4 emitted
+    for _ in range(4):
+        log.token(rid)
+    log.decode_round(rid, spec=True)     # verify round cut by eos: 2
+    for _ in range(2):
+        log.token(rid)
+    log.finish(rid, "eos")
+    snap = log.get(rid)
+    assert snap["n_tokens"] == 10
+    assert snap["n_decode_rounds"] == 3
+    assert snap["n_spec_rounds"] == 2
+    assert snap["n_spec_tokens"] == 6
+    assert snap["n_tokens"] == (1 + snap["n_decode_rounds"]
+                                + snap["n_spec_tokens"])
+    # n_rounds keeps its legacy meaning: every scheduling round
+    assert snap["n_rounds"] == 1 + 3 + 2
+
+
+# ---------------------------------------------------------------------------
+# the tracker: exact counters, rollup slices, tail gauges
+# ---------------------------------------------------------------------------
+
+def test_tracker_counters_merge_exactly_across_registries():
+    """blame_<phase>_seconds_total are float counters: summing two
+    replicas' expositions reproduces the per-registry totals exactly
+    (the fleet /blame merge contract)."""
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    t1 = BlameTracker(registry=r1)
+    t2 = BlameTracker(registry=r2)
+    t1.observe(phase_ledger(_snap(
+        e2e=3.0, admit=1.0, blame_acc={"decode_active": 1.5})))
+    t2.observe(phase_ledger(_snap(
+        e2e=5.0, admit=2.0, request_id="req-2",
+        blame_acc={"decode_active": 2.25, "prefill_compute": 0.5})))
+    # the fleet merge parses each source's exposition and sums in
+    # float — reproduce it and pin exactness
+    summed = {}
+    for reg in (r1, r2):
+        for name, entry in parse_prometheus_text(
+                merged_prometheus_text(reg)).items():
+            if entry.get("type") == "counter":
+                summed[name] = summed.get(name, 0.0) + entry["value"]
+    assert summed["blame_requests_total"] == 2.0
+    assert summed["blame_decode_active_seconds_total"] == \
+        t1._c_phase["decode_active"].value \
+        + t2._c_phase["decode_active"].value == 3.75
+    assert summed["blame_prefill_compute_seconds_total"] == 0.5
+
+
+def test_tracker_rollup_slices_and_tail_gauges():
+    tr = BlameTracker(registry=MetricsRegistry())
+    # 9 fast queue-dominated requests, one slow decode-dominated tail
+    for i in range(9):
+        tr.observe(phase_ledger(_snap(
+            e2e=1.0, admit=0.8, request_id=f"fast-{i}",
+            model="m@1", tenant="acme", replica="r0",
+            blame_acc={"decode_active": 0.2})))
+    tr.observe(phase_ledger(_snap(
+        e2e=30.0, admit=1.0, request_id="slow-0",
+        model="m@1", tenant="acme", replica="r1",
+        blame_acc={"decode_active": 28.0})))
+    roll = tr.rollup()
+    assert roll["requests_in_window"] == 10
+    assert roll["requests_total"] == 10
+    assert roll["additivity_violations"] == 0
+    assert roll["phases"] == list(PHASES)
+    # the p99 tail IS the slow request: decode dominates it
+    assert roll["dominant_tail_phase"] == "decode_active"
+    assert roll["queue_share_p99"] == pytest.approx(1.0 / 30.0,
+                                                    abs=1e-6)
+    assert tr.tail_phase_code() == float(PHASES.index("decode_active"))
+    # slices exist and carry per-phase share/percentile stats
+    assert set(roll["by_model"]) == {"m@1"}
+    assert set(roll["by_tenant"]) == {"acme"}
+    assert set(roll["by_replica"]) == {"r0", "r1"}
+    dec = roll["rollup"]["decode_active"]
+    assert set(dec) == {"share", "p50", "p99", "p999"}
+    # shares over the window sum to ~1 (additivity, aggregated)
+    assert sum(s["share"] for s in roll["rollup"].values()) \
+        == pytest.approx(1.0, abs=0.01)
+    sb = tr.stats_block()
+    assert sb["dominant_tail_phase"] == "decode_active"
+    assert sb["requests"] == 10
+
+
+def test_tracker_empty_window_sentinels():
+    tr = BlameTracker(registry=MetricsRegistry())
+    assert tr.tail_phase_code() == -1.0
+    assert tr.queue_share_p99() == 0.0
+    assert tr.rollup()["dominant_tail_phase"] is None
+
+
+def test_additivity_violation_ticks_counter():
+    reg = MetricsRegistry()
+    tr = BlameTracker(registry=reg)
+    tr.observe(phase_ledger(_snap(
+        e2e=2.0, admit=1.0, blame_acc={"decode_active": 5.0})))
+    assert tr._c_violations.value == 1.0
+
+
+# ---------------------------------------------------------------------------
+# blame_shift alert: replay-deterministic fire/resolve, poisoned clock
+# ---------------------------------------------------------------------------
+
+def _shift_samples():
+    """blame_tail_phase_code: queue_wait (0) for 30 s, decode_active
+    (5) for 20 s — the shift — then back to 0 for 30 s (the mode of
+    the older in-window points recovers, clearing the alert)."""
+    vals = [0.0] * 30 + [5.0] * 20 + [0.0] * 30
+    return [{"ts": T0 + i, "proc": "p0", "seq": i + 1,
+             "counters": {}, "gauges": {"blame_tail_phase_code": v}}
+            for i, v in enumerate(vals)]
+
+
+def test_blame_shift_fires_and_resolves_replay_deterministic(
+        monkeypatch):
+    samples = _shift_samples()
+
+    def boom(*_a, **_k):
+        raise AssertionError("clock read inside the evaluation path")
+    monkeypatch.setattr(time, "time", boom)
+    monkeypatch.setattr(time, "monotonic", boom)
+    monkeypatch.setattr(time, "perf_counter", boom)
+    outs = []
+    for _ in range(2):
+        verdict = AlertEngine(builtin_rules()).evaluate(samples)
+        outs.append(json.dumps(verdict, sort_keys=True))
+    assert outs[0] == outs[1], "replay must be byte-identical"
+    shift = [e for e in json.loads(outs[0])["events"]
+             if e["rule"] == "blame_shift"]
+    assert [e["state"] for e in shift] == ["firing", "resolved"]
+    fired, resolved = shift
+    assert fired["severity"] == "warn"
+    assert fired["value"] == 5.0          # the new dominant phase code
+    assert resolved["ts"] > fired["ts"]
+
+
+def test_blame_shift_ignores_no_data_sentinel():
+    """-1 (empty window) never participates: an idle process coming
+    alive is not a 'shift'."""
+    vals = [-1.0] * 20 + [2.0] * 40
+    samples = [{"ts": T0 + i, "proc": "p0", "seq": i + 1,
+                "counters": {}, "gauges": {"blame_tail_phase_code": v}}
+               for i, v in enumerate(vals)]
+    events = AlertEngine(builtin_rules()).evaluate(samples)["events"]
+    assert not [e for e in events if e["rule"] == "blame_shift"]
+
+
+# ---------------------------------------------------------------------------
+# tail exemplars: bounded capture, eviction policy, byte bound
+# ---------------------------------------------------------------------------
+
+def _offer(store, rid, e2e, **fields):
+    snap = _snap(e2e=e2e, admit=min(1.0, e2e / 2), request_id=rid,
+                 **fields)
+    return store.consider(phase_ledger(snap), snap)
+
+
+def test_exemplar_topk_capture_and_eviction(monkeypatch):
+    monkeypatch.setattr(OrcaContext, "_exemplar_count", 2)
+    store = ExemplarStore()
+    base_cap = store._c_captured.value   # global-registry counters:
+    base_ev = store._c_evicted.value     # assert deltas, not levels
+    assert _offer(store, "a", 5.0)
+    assert _offer(store, "b", 3.0)
+    assert not _offer(store, "c", 1.0), "faster than everything held"
+    assert _offer(store, "d", 9.0), "slower: evicts the fastest"
+    assert store.ids() == ["d", "a"]     # slowest first
+    assert store.count() == 2
+    assert store.get("b") is None
+    assert store.get("d")["ledger"]["e2e_s"] == 9.0
+    assert store._c_captured.value - base_cap == 3.0
+    assert store._c_evicted.value - base_ev == 1.0
+    idx = store.index()
+    assert idx["count"] == 2
+    assert idx["exemplars"][0]["request_id"] == "d"
+    assert idx["exemplars"][0]["dominant_phase"]
+
+
+def test_exemplar_capture_disabled_at_zero(monkeypatch):
+    monkeypatch.setattr(OrcaContext, "_exemplar_count", 0)
+    store = ExemplarStore()
+    assert not _offer(store, "a", 5.0)
+    assert store.count() == 0
+
+
+def test_exemplar_byte_bound_truncates_tails(monkeypatch):
+    monkeypatch.setattr(OrcaContext, "_exemplar_max_bytes", 2048)
+    store = ExemplarStore()
+    snap = _snap(e2e=5.0, admit=1.0, request_id="big")
+    snap["events"] = [{"kind": "decode", "t": 100.0 + i, "round": i,
+                       "padding": "x" * 64} for i in range(200)]
+    assert store.consider(phase_ledger(snap), snap)
+    doc = store.get("big")
+    blob = json.dumps(doc, default=str).encode()
+    assert len(blob) <= 4096, "way below the unbounded ~20 KiB"
+    # the ledger itself is never dropped
+    assert doc["ledger"]["phases"]
+    assert len(doc["record"]["events"]) < 200
+
+
+def test_exemplar_slo_violators_displace_topk(monkeypatch):
+    """An SLO-violating request is ALWAYS captured: it evicts the
+    fastest non-violator even when its own e2e is smaller."""
+    from analytics_zoo_tpu.observability.slo import reset_slo_tracker
+    monkeypatch.setattr(OrcaContext, "_exemplar_count", 2)
+    monkeypatch.setattr(OrcaContext, "_slo_targets", {"e2e_s": 4.0})
+    reset_slo_tracker()
+    try:
+        store = ExemplarStore()
+        assert _offer(store, "slow-a", 20.0)   # violator (e2e > 4)
+        assert _offer(store, "slow-b", 30.0)   # violator
+        assert store.get("slow-a")["reason"] == "slo_violation"
+        assert store.get("slow-a")["violations"] == ["e2e_s"]
+        # a faster violator cannot displace slower violators
+        assert not _offer(store, "v-small", 10.0)
+        assert store.ids() == ["slow-b", "slow-a"]
+    finally:
+        reset_slo_tracker()
+
+
+def test_exemplar_violator_beats_nonviolator(monkeypatch):
+    from analytics_zoo_tpu.observability.slo import reset_slo_tracker
+    monkeypatch.setattr(OrcaContext, "_exemplar_count", 2)
+    monkeypatch.setattr(OrcaContext, "_slo_targets", {"ttft_s": 1.0})
+    reset_slo_tracker()
+    try:
+        store = ExemplarStore()
+        assert _offer(store, "ok-a", 5.0)      # non-violator (no ttft)
+        assert _offer(store, "ok-b", 6.0)      # non-violator
+        # TTFT violator with a SMALLER e2e than everything held
+        assert _offer(store, "viol", 2.0, ttft_s=1.5)
+        assert "viol" in store.ids()
+        assert "ok-a" not in store.ids(), "fastest non-violator left"
+    finally:
+        reset_slo_tracker()
+
+
+# ---------------------------------------------------------------------------
+# finish() feeds the plane end-to-end (global path)
+# ---------------------------------------------------------------------------
+
+def test_finish_feeds_tracker_and_exemplars():
+    from analytics_zoo_tpu.observability import reset_request_log
+    reset_request_log()
+    tr = blame.reset_blame_tracker()
+    reset_exemplar_store()
+    # the tracker's counters live on the process-global registry and
+    # survive resets — assert deltas, the window is what resets
+    base = tr._c_requests.value
+    rid = request_log.start(prompt_len=4, max_new_tokens=2)
+    request_log.event(rid, "admit")
+    # attributed seconds must fit inside the ACTUAL running wall for
+    # the ledger to stay additive — keep them far below it
+    request_log.attribute(rid, "prefill_compute", 1e-6)
+    request_log.token(rid)
+    request_log.decode_round(rid)
+    request_log.token(rid)
+    request_log.attribute(rid, "decode_active", 1e-6)
+    request_log.finish(rid, "eos")
+    payload = blame.blame_payload()
+    assert payload["requests_total"] == base + 1
+    assert payload["requests_in_window"] == 1
+    assert get_exemplar_store().get(rid)["ledger"]["additive_ok"]
+    # errored requests are exemplar candidates but stay OUT of the
+    # rollup window (they would poison the shares)
+    rid2 = request_log.start(prompt_len=4, max_new_tokens=2)
+    request_log.finish(rid2, "error:boom")
+    assert blame.blame_payload()["requests_total"] == base + 1
+    assert blame.blame_payload()["requests_in_window"] == 1
+    assert get_exemplar_store().get(rid2) is not None
+
+
+# ---------------------------------------------------------------------------
+# fleet merge: live + spooled sources, exact counters, exemplar harvest
+# ---------------------------------------------------------------------------
+
+def _fake_spool_doc(tmp_path, proc="replB", rid="dead-req"):
+    reg = MetricsRegistry()
+    reg.counter("blame_requests_total").inc(3)
+    reg.counter("blame_decode_active_seconds_total").inc(1.25)
+    reg.counter("exemplars_captured_total").inc(1)
+    doc = {
+        "proc": proc, "pid": 999_999_999, "seq": 1, "wall_ts": T0,
+        "exposition": reg.prometheus_text(),
+        "spans": [], "requests": [], "slo": None,
+        "exemplars": [{
+            "request_id": rid, "reason": "slowest", "violations": [],
+            "ledger": {"e2e_s": 9.9,
+                       "phases": {"queue_wait": 9.0,
+                                  "decode_active": 0.9}},
+        }],
+    }
+    d = tmp_path / "telemetry" / proc
+    d.mkdir(parents=True)
+    (d / "snapshot.json").write_text(json.dumps(doc))
+
+
+def test_fleet_blame_exact_merge_and_spooled_exemplars(tmp_path):
+    blame.reset_blame_tracker()
+    reset_exemplar_store()
+    local = MetricsRegistry()
+    local.counter("blame_requests_total").inc(2)
+    _fake_spool_doc(tmp_path)
+    agg = FleetAggregator(local_registries=(local,),
+                          observability_dir=str(tmp_path),
+                          include_spooled=True)
+    fb = agg.fleet_blame()
+    assert fb["sources"] == 2
+    # EXACT counter merge: 2 (local) + 3 (spooled SIGKILL casualty)
+    assert fb["counters"]["blame_requests_total"] == 5.0
+    assert fb["counters"]["blame_decode_active_seconds_total"] == 1.25
+    assert fb["counters"]["exemplars_captured_total"] == 1.0
+    rows = {r["request_id"]: r for r in fb["exemplars"]}
+    assert rows["dead-req"]["source"] == "spool:replB"
+    assert rows["dead-req"]["dominant_phase"] == "queue_wait"
+    assert "local" in fb and "rollup" in fb["local"]
+
+
+def test_fleet_exemplar_lookup_live_then_spooled(tmp_path):
+    blame.reset_blame_tracker()
+    store = reset_exemplar_store()
+    snap = _snap(e2e=3.0, admit=1.0, request_id="live-req")
+    store.consider(phase_ledger(snap), snap)
+    _fake_spool_doc(tmp_path)
+    agg = FleetAggregator(observability_dir=str(tmp_path),
+                          include_spooled=True)
+    live = agg.fleet_exemplar("live-req")
+    assert live is not None and live["source"] == "local"
+    dead = agg.fleet_exemplar("dead-req")
+    assert dead is not None and dead["source"] == "spool:replB"
+    assert dead["ledger"]["e2e_s"] == 9.9
+    assert agg.fleet_exemplar("never-seen") is None
+    reset_exemplar_store()
+
+
+def test_spool_snapshot_carries_exemplars(tmp_path, monkeypatch):
+    """The in-process half of crash-safety: the spool's committed doc
+    embeds the exemplar store's snapshot (slowest first)."""
+    from analytics_zoo_tpu.observability import telemetry_spool
+    monkeypatch.setattr(OrcaContext, "_observability_dir",
+                        str(tmp_path))
+    telemetry_spool.reset_spools()
+    store = reset_exemplar_store()
+    for rid, e2e in [("s1", 2.0), ("s2", 8.0)]:
+        snap = _snap(e2e=e2e, admit=1.0, request_id=rid)
+        store.consider(phase_ledger(snap), snap)
+    sp = telemetry_spool.get_spool("unit-test-proc")
+    assert sp is not None and sp.write()
+    docs = telemetry_spool.read_snapshots(str(tmp_path))
+    mine = [d for d in docs if d["proc"] == "unit-test-proc"]
+    assert len(mine) == 1
+    got = [e["request_id"] for e in mine[0]["exemplars"]]
+    assert got == ["s2", "s1"], "slowest first survives the spool"
+    telemetry_spool.reset_spools()
+    reset_exemplar_store()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces: GET /blame, /debug/requests[/id], /stats blame block
+# ---------------------------------------------------------------------------
+
+def _get(srv, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}{path}", timeout=30) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_blame_endpoints_end_to_end():
+    from analytics_zoo_tpu.serving import ServingServer
+    from analytics_zoo_tpu.serving.generation import (
+        CausalLM,
+        GenerationEngine,
+    )
+    blame.reset_blame_tracker()
+    reset_exemplar_store()
+    model = CausalLM(vocab=31, hidden_size=16, n_head=2, n_block=1,
+                     intermediate_size=32, max_position_len=64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        jnp.arange(8)[None])["params"]
+    engine = GenerationEngine(model, params, max_slots=2, block_size=8,
+                              max_context=64)
+    srv = None
+    try:
+        srv = ServingServer(generation_engine=engine).start()
+        rng = np.random.default_rng(3)
+        s = engine.submit(list(rng.integers(0, 31, 6)),
+                          max_new_tokens=3)
+        assert len(s.tokens()) == 3
+        code, body = _get(srv, "/blame")
+        assert code == 200
+        roll = json.loads(body)
+        assert roll["requests_total"] >= 1
+        assert roll["phases"] == list(PHASES)
+        assert roll["dominant_tail_phase"] in PHASES
+        code, body = _get(srv, "/blame?fleet=1")
+        assert code == 200
+        fleet = json.loads(body)
+        assert fleet["counters"]["blame_requests_total"] >= 1.0
+        assert fleet["local"]["requests_total"] >= 1
+        code, body = _get(srv, "/debug/requests")
+        assert code == 200
+        idx = json.loads(body)
+        assert idx["count"] >= 1
+        rid = idx["exemplars"][0]["request_id"]
+        code, body = _get(srv, f"/debug/requests/{rid}")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["request_id"] == rid
+        assert doc["ledger"]["additive_ok"] is True
+        assert doc["record"]["n_tokens"] == 3
+        code, body = _get(srv, "/debug/requests/no-such-req")
+        assert code == 404
+        assert json.loads(body)["request_id"] == "no-such-req"
+        code, body = _get(srv, "/stats")
+        stats = json.loads(body)
+        assert stats["blame"]["requests"] >= 1
+        assert stats["blame"]["dominant_tail_phase"] in PHASES
+    finally:
+        if srv is not None:
+            srv.stop()
+        blame.reset_blame_tracker()
+        reset_exemplar_store()
+
+
+def test_timeline_renders_blame_waterfall():
+    from analytics_zoo_tpu.observability import timeline
+    blame.reset_blame_tracker()
+    store = reset_exemplar_store()
+    snap = _snap(e2e=6.0, admit=2.0, request_id="wf-req",
+                 blame_acc={"prefill_compute": 1.0,
+                            "decode_active": 2.5})
+    store.consider(phase_ledger(snap), snap)
+    doc = timeline.export_timeline()
+    ev = doc["traceEvents"]
+    metas = [e for e in ev if e.get("ph") == "M"
+             and e["name"] == "process_name"
+             and e["pid"] == timeline.PID_BLAME]
+    assert metas, "pid 9 (blame) missing its process_name meta"
+    slices = [e for e in ev if e.get("cat") == "blame"
+              and e.get("ph") == "X"]
+    assert slices, "no blame waterfall slices"
+    mine = [e for e in slices
+            if e["args"].get("request_id") == "wf-req"]
+    names = [e["name"] for e in mine]
+    # waterfall in PHASES order, zero-second phases skipped
+    assert names == [p for p in PHASES
+                     if phase_ledger(snap)["phases"][p] > 0]
+    # slices tile the request's wall window contiguously
+    mine.sort(key=lambda e: e["ts"])
+    assert mine[0]["ts"] == pytest.approx(T0 * 1e6, rel=1e-9)
+    for a, b in zip(mine, mine[1:]):
+        assert a["ts"] + a["dur"] == pytest.approx(b["ts"], abs=1.0)
+    reset_exemplar_store()
+
+
+def test_flight_bundle_embeds_worst_exemplars(tmp_path):
+    from analytics_zoo_tpu.observability import flight_recorder
+    prev_dir = OrcaContext.observability_dir
+    OrcaContext.observability_dir = str(tmp_path / "obs")
+    try:
+        store = reset_exemplar_store()
+        for rid, e2e in [("w1", 4.0), ("w2", 11.0)]:
+            snap = _snap(e2e=e2e, admit=1.0, request_id=rid)
+            store.consider(phase_ledger(snap), snap)
+        bundle = json.load(open(flight_recorder.dump("blame-test")))
+        got = [e["request_id"] for e in bundle["exemplars"]]
+        assert got == ["w2", "w1"], "worst first, embedded whole"
+    finally:
+        OrcaContext.observability_dir = prev_dir
+        reset_exemplar_store()

@@ -1,0 +1,100 @@
+"""Tier-1 wrapper for scripts/check_blame_phases.py: the repo's blame
+phase attribution is closed in both directions, and the lint actually
+catches synthetic drift (an emitted kind with no map entry; a
+documented phase that does not exist)."""
+
+import importlib.util
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_blame_phases",
+        os.path.join(ROOT, "scripts", "check_blame_phases.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+cbp = _load()
+
+
+def test_repo_is_clean():
+    assert cbp.find_violations() == []
+    assert cbp.main() == 0
+
+
+def test_parsed_map_matches_import():
+    """The source-parsed map/phases equal the importable ones — the
+    lint reads source (no import-time deps) but must track reality."""
+    from analytics_zoo_tpu.observability.blame import (
+        EVENT_PHASE_MAP,
+        PHASES,
+    )
+    assert cbp.phase_map() == EVENT_PHASE_MAP
+    assert tuple(cbp.canonical_phases()) == PHASES
+
+
+def test_every_emitted_kind_is_mapped_exactly_once():
+    """The closure the additivity contract stands on: every emitted
+    kind has exactly one phase, and that phase is canonical."""
+    mapping = cbp.phase_map()
+    phases = set(cbp.canonical_phases())
+    emitted = cbp.emitted_kinds()
+    assert emitted, "the scan found the package's event call sites"
+    for kind in emitted:
+        assert kind in mapping, f"unmapped event kind {kind!r}"
+        assert mapping[kind] in phases
+    # core lifecycle kinds must be among the discovered emissions —
+    # if the ast scan ever goes blind, this fails before the
+    # directions could vacuously pass
+    for kind in ("enqueue", "admit", "prefill", "decode", "finish",
+                 "preempt", "resume", "host_restore", "requeue"):
+        assert kind in emitted
+
+
+def test_scan_finds_conditional_kind_expressions():
+    """The scheduler emits `"resume" if ... else "admit"` — both arms
+    must be discovered, not just one."""
+    emitted = set(cbp.emitted_kinds())
+    assert {"resume", "admit"} <= emitted
+
+
+def test_detects_documented_phase_drift():
+    docs = """\
+# observability
+
+## Latency blame
+
+| phase | what it measures |
+| --- | --- |
+| `queue_wait` | waiting |
+| `phantom_phase` | never |
+
+## Metric index
+
+| metric | kind |
+| --- | --- |
+| `blame_requests_total` | counter |
+"""
+    documented = cbp.documented_phases(docs)
+    assert "phantom_phase" in documented
+    assert "blame_requests_total" not in documented, \
+        "tokens in other sections never count as phases"
+
+
+def test_lint_would_catch_an_unmapped_kind(tmp_path, monkeypatch):
+    """Drop the real map down to one entry: the missing-kind direction
+    must light up for the other emitted kinds."""
+    with open(cbp.BLAME, encoding="utf-8") as f:
+        src = f.read()
+    import re
+    m = re.search(r"^EVENT_PHASE_MAP", src, re.MULTILINE)
+    crippled = src[:m.start()] + (
+        'EVENT_PHASE_MAP = {"enqueue": "queue_wait"}\n')
+    p = tmp_path / "blame.py"
+    p.write_text(crippled)
+    monkeypatch.setattr(cbp, "BLAME", str(p))
+    viol = cbp.find_violations()
+    assert any("no EVENT_PHASE_MAP entry" in v for v in viol)

@@ -697,6 +697,101 @@ def test_e2e_one_trace_three_processes_sigkill_harvest(lm, tmp_path):
 
 
 # ----------------------------------------------------------------------
+# tail-exemplar crash-safety: SIGKILL mid-decode, forensics survive
+# ----------------------------------------------------------------------
+
+_EXEMPLAR_CODE = """
+import os, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from analytics_zoo_tpu.common.context import OrcaContext
+OrcaContext.observability_dir = {obs!r}
+OrcaContext.slo_targets = {{"e2e_s": 1e-4}}
+import jax, jax.numpy as jnp
+from analytics_zoo_tpu.observability.exemplars import get_exemplar_store
+from analytics_zoo_tpu.observability.telemetry_spool import get_spool
+from analytics_zoo_tpu.serving.generation import CausalLM, GenerationEngine
+model = CausalLM(vocab=31, hidden_size=16, n_head=2, n_block=1,
+                 intermediate_size=32, max_position_len=128)
+params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                    jnp.arange(8)[None])["params"]
+eng = GenerationEngine(model, params, max_slots=2, block_size=8,
+                       max_context=96)
+s = eng.submit([3, 1, 4, 1, 5, 9, 2, 6], max_new_tokens=4,
+               request_id="victim-done")
+eng.run_until_idle()
+assert len(s.tokens()) == 4
+doc = get_exemplar_store().get("victim-done")
+assert doc is not None, "finished request was not exemplared"
+assert doc["reason"] == "slo_violation", doc["reason"]
+# a second request is mid-decode when the SIGKILL lands
+eng.submit([2, 7, 1, 8], max_new_tokens=64, request_id="victim-live")
+for _ in range(3):
+    eng.step()
+assert get_spool("victim-replica").write()
+print("READY victim-done", flush=True)
+time.sleep(120)
+"""
+
+
+@pytest.mark.slow   # spawns a JAX child process (~20s cold compile)
+def test_sigkill_mid_decode_exemplar_survives_via_spool(tmp_path):
+    """Satellite of the blame plane: a replica process finishes one
+    SLO-violating request (captured as a tail exemplar), spools, and is
+    SIGKILL'd mid-decode of a second request.  The exemplar — full
+    phase ledger attached — survives on disk and merges into the fleet
+    /blame view; the in-flight victim's lifecycle record survives too."""
+    obs = str(tmp_path / "obs")
+    child = _spawn(_EXEMPLAR_CODE.format(obs=obs))
+    try:
+        ready = _wait_ready(child, timeout=240.0)
+        assert ready.split()[1] == "victim-done"
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=10)
+
+        docs = {d["proc"]: d for d in read_snapshots(obs)}
+        assert "victim-replica" in docs
+        doc = docs["victim-replica"]
+        ex = {e["request_id"]: e for e in doc["exemplars"]}
+        assert "victim-done" in ex
+        led = ex["victim-done"]["ledger"]
+        assert led["additive_ok"] is True
+        assert led["phases"]["decode_active"] > 0.0
+        assert ex["victim-done"]["violations"] == ["e2e_s"]
+        # the mid-decode victim's record rode the same commit
+        live = {r["request_id"]: r for r in doc["requests"]}
+        assert live["victim-live"]["status"] in ("queued", "running")
+
+        # fleet /blame: counters sum from the dead replica's spool,
+        # its exemplar is harvested and fetchable by id
+        from analytics_zoo_tpu.observability.blame import (
+            reset_blame_tracker,
+        )
+        from analytics_zoo_tpu.observability.exemplars import (
+            reset_exemplar_store,
+        )
+        reset_blame_tracker()
+        reset_exemplar_store()
+        agg = FleetAggregator(local_registries=(MetricsRegistry(),),
+                              observability_dir=obs,
+                              include_spooled=True)
+        fb = agg.fleet_blame()
+        assert fb["counters"]["blame_requests_total"] >= 1.0
+        assert fb["counters"]["blame_decode_active_seconds_total"] > 0.0
+        rows = {r["request_id"]: r for r in fb["exemplars"]}
+        assert rows["victim-done"]["source"] == "spool:victim-replica"
+        fetched = agg.fleet_exemplar("victim-done")
+        assert fetched is not None
+        assert fetched["source"] == "spool:victim-replica"
+        assert fetched["ledger"]["e2e_s"] == led["e2e_s"]
+    finally:
+        if child.poll() is None:
+            child.kill()
+        child.wait(timeout=10)
+        child.stdout.close()
+        child.stderr.close()
+
+
+# ----------------------------------------------------------------------
 # knobs
 # ----------------------------------------------------------------------
 

@@ -287,11 +287,19 @@ def test_recompile_storm_ignores_warmup_burst():
 def test_fully_armed_composition_decode_compiles_once(lm, tmp_path):
     """prefix caching × chunked prefill × int8 KV × speculation × host
     KV tier × SLO judging × watchdog × history recorder × dispatch
-    ledger: the decode pin holds, the ledger agrees with it, and the
-    compile budget is respected (tp rides in
-    tests/test_distributed_serving.py — host tier is off under tp)."""
+    ledger × blame plane × tail exemplars: the decode pin holds, the
+    ledger agrees with it, and the compile budget is respected (tp
+    rides in tests/test_distributed_serving.py — host tier is off
+    under tp)."""
+    from analytics_zoo_tpu.observability import blame
+    from analytics_zoo_tpu.observability.exemplars import (
+        reset_exemplar_store,
+    )
     from analytics_zoo_tpu.serving.generation import GenerationEngine
     model, params = lm
+    tracker = blame.reset_blame_tracker()
+    reset_exemplar_store()
+    base_violations = tracker._c_violations.value
     prev_slo = OrcaContext.slo_targets
     prev_wd = OrcaContext.watchdog_deadline_s
     prev_mem = OrcaContext.memory_sample_interval_s
@@ -338,6 +346,17 @@ def test_fully_armed_composition_decode_compiles_once(lm, tmp_path):
         dec = [e for e in snap["compile_events"]
                if e["family"] == "decode"]
         assert len(dec) == 1 and "diff" not in dec[0]
+        # the blame plane rode the whole composed run: every finished
+        # request got an additive ledger, the tail got exemplared, and
+        # none of it cost a recompile (the pin above)
+        payload = blame.blame_payload()
+        assert payload["requests_in_window"] == 5
+        assert tracker._c_violations.value == base_violations
+        assert payload["dominant_tail_phase"] is not None
+        from analytics_zoo_tpu.observability.exemplars import (
+            get_exemplar_store,
+        )
+        assert get_exemplar_store().count() >= 1
     finally:
         OrcaContext.slo_targets = prev_slo
         OrcaContext.watchdog_deadline_s = prev_wd

@@ -220,6 +220,18 @@ def test_spec_stream_identical_to_legacy_composed(cyc, spec_pair):
     kinds = [e["kind"] for e in rec["events"]]
     assert "spec_propose" in kinds and "spec_accept" in kinds
     assert len(rec["events"]) <= request_log.MAX_EVENTS_PER_REQUEST
+    # speculation-exact round accounting: every cleanly finished
+    # request satisfies n_tokens == 1 + n_decode_rounds + n_spec_tokens
+    # (the leading 1 is prefill's token; spec tokens are counted at
+    # emission so an eos mid-burst is respected), and a SPEC lane
+    # really used verify rounds — the invariant is not vacuous
+    finished = [r for r in request_log.records(None)
+                if r["status"] == "finished" and r["n_tokens"] > 0]
+    assert finished
+    for r in finished:
+        assert r["n_tokens"] == 1 + r["n_decode_rounds"] \
+            + r["n_spec_tokens"], r["request_id"]
+    assert any(r["n_spec_rounds"] > 0 for r in finished)
 
 
 @pytest.mark.slow   # ~8s warm (PR 19 budget trim): sibling tier-1
